@@ -1,0 +1,46 @@
+"""Device-side data augmentation, jittable.
+
+The reference augments on the host inside DataLoader worker processes
+(reference: main.py:71-78 — RandomCrop(32, padding=4), RandomHorizontalFlip,
+ToTensor, per-channel Normalize).  TPU-first design moves this into the
+compiled step: raw uint8 batches cross host->device once, and the crop / flip
+/ normalize run as a fused XLA prologue to the conv stack — vectorised with
+``vmap`` over per-sample PRNG keys, no Python per-image loop, static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cifar10 import MEAN, STD
+
+PAD = 4  # reference main.py:72 RandomCrop(32, padding=4)
+
+
+def normalize(images: jax.Array) -> jax.Array:
+    """uint8 NHWC -> normalized float32 (ToTensor + Normalize, main.py:73-77)."""
+    x = images.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(MEAN)) / jnp.asarray(STD)
+
+
+def _crop_flip_one(key: jax.Array, img: jax.Array) -> jax.Array:
+    """Random 32x32 crop from a zero-padded 40x40 canvas + horizontal flip."""
+    h = img.shape[0]
+    ck, fk = jax.random.split(key)
+    padded = jnp.pad(img, ((PAD, PAD), (PAD, PAD), (0, 0)))
+    off = jax.random.randint(ck, (2,), 0, 2 * PAD + 1)
+    img = jax.lax.dynamic_slice(padded, (off[0], off[1], 0), (h, h, img.shape[2]))
+    flip = jax.random.bernoulli(fk)
+    return jax.lax.cond(flip, lambda i: i[:, ::-1, :], lambda i: i, img)
+
+
+def augment(key: jax.Array, images: jax.Array) -> jax.Array:
+    """Train-time augmentation: uint8 NHWC batch -> normalized float32.
+
+    Equivalent to the reference's train transform stack (main.py:71-78).
+    One key per sample via ``jax.random.split``; fully vmapped.
+    """
+    keys = jax.random.split(key, images.shape[0])
+    images = jax.vmap(_crop_flip_one)(keys, images)
+    return normalize(images)

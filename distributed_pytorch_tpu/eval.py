@@ -1,0 +1,74 @@
+"""Evaluation: the reference's ``test_model`` semantics, compiled.
+
+Reference (main.py:51-66): model.eval(), no grad, sum per-batch mean losses,
+divide by the *number of batches*, and argmax accuracy over the full test set.
+The test set is NOT sharded — every rank evaluates all 10k images redundantly
+(SURVEY.md section 2.1 item 10); here one evaluation runs on device with BN
+running statistics (rank 0's, matching DDP's buffer-broadcast convention).
+
+Batches are padded to a static shape with a validity mask so every batch
+compiles to the same program (XLA: static shapes), instead of a second
+compilation for the ragged last batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .data import augment as aug
+from .models import vgg
+from .ops import nn as ops
+
+PyTree = Any
+
+
+@partial(jax.jit, static_argnames=("model_name", "dtype"))
+def _eval_batch(params, state, images, labels, mask, *, model_name, dtype):
+    x = aug.normalize(images)  # test transform: ToTensor+Normalize (main.py:80-82)
+    logits, _ = vgg.apply(params, state, x, name=model_name, train=False,
+                          dtype=dtype)
+    ce = ops.cross_entropy_per_sample(logits, labels) * mask
+    correct = jnp.sum((jnp.argmax(logits, axis=-1) == labels) * mask)
+    # per-batch mean over real samples == torch CrossEntropyLoss reduction
+    return jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1), correct
+
+
+def evaluate(params: PyTree, state: PyTree, loader, *,
+             model_name: str = "VGG11",
+             compute_dtype: jnp.dtype | None = None,
+             log=print) -> tuple[float, float]:
+    """Full-test-set eval; returns (avg_loss, accuracy).
+
+    ``avg_loss`` is the sum of per-batch mean losses divided by the batch
+    count — the reference's exact (slightly unusual) definition
+    (main.py:59,63)."""
+    total_loss, correct, total, n_batches = 0.0, 0, 0, 0
+    batch_size = None
+    for images, labels in loader:
+        if batch_size is None:
+            batch_size = len(labels)
+        n = len(labels)
+        if n < batch_size:  # pad ragged last batch to the static shape
+            pad = batch_size - n
+            images = np.concatenate([images, np.zeros((pad,) + images.shape[1:],
+                                                      images.dtype)])
+            labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+        mask = (np.arange(batch_size) < n).astype(np.float32)
+        loss, corr = _eval_batch(params, state, jnp.asarray(images),
+                                 jnp.asarray(labels), jnp.asarray(mask),
+                                 model_name=model_name, dtype=compute_dtype)
+        total_loss += float(loss)
+        correct += int(corr)
+        total += n
+        n_batches += 1
+    avg_loss = total_loss / max(n_batches, 1)
+    acc = correct / max(total, 1)
+    if log:
+        log(f"Test set: Average loss: {avg_loss:.4f}, "
+            f"Accuracy: {correct}/{total} ({100.0 * acc:.0f}%)\n")
+    return avg_loss, acc

@@ -1,0 +1,4 @@
+from . import vgg
+from .vgg import VGG11, VGG13, VGG16, VGG19
+
+__all__ = ["vgg", "VGG11", "VGG13", "VGG16", "VGG19"]

@@ -1,0 +1,144 @@
+"""VGG model family for CIFAR (3x32x32 input, 10 classes), TPU-native.
+
+Re-design of the reference's ``model.py`` (reference: model.py:3-50): the same
+cfg-list idea — integers are Conv3x3(+bias) -> BatchNorm2d -> ReLU blocks,
+``'M'`` is MaxPool2d(2,2) — but expressed as a pure function over an explicit
+parameter pytree instead of an ``nn.Module``:
+
+- params/state are plain nested dicts (a JAX pytree), so the whole model
+  composes with ``jax.grad``/``jit``/``shard_map`` with no framework layer;
+- layout is NHWC (TPU-native; the reference uses torch's NCHW);
+- BatchNorm running statistics live in a separate ``state`` pytree returned
+  from ``apply`` (pure function, no in-place buffer mutation);
+- the static cfg loop is unrolled at trace time: XLA sees one flat graph of
+  8 convs (VGG11) and fuses BN+ReLU into the conv epilogues.
+
+Parity facts preserved from the reference (checked by tests/test_model.py):
+VGG11 has exactly 34 trainable parameter tensors (8x conv w+b, 8x BN scale
++bias, fc w+b) and ~9.23M parameters — the per-step gradient-sync payload
+(SURVEY.md section 2.1 item 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn as ops
+
+Array = jax.Array
+PyTree = Any
+
+# Reference model.py:3-8, verbatim cfg lists.
+CFG = {
+    "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+              512, 512, 512, "M"],
+    "VGG19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+NUM_CLASSES = 10
+
+
+def _flatten_features(cfg: list) -> int:
+    """Classifier input width: the last conv's channel count, since the five
+    2x2 pools collapse a 32x32 input to 1x1 spatial.  512 for every reference
+    variant (reference model.py:39 hard-codes it)."""
+    return [c for c in cfg if c != "M"][-1]
+
+
+def init(key: Array, name: str = "VGG11") -> tuple[PyTree, PyTree]:
+    """Build (params, state) for a VGG variant.
+
+    Equivalent of constructing ``_VGG(name)`` under a fixed torch seed
+    (reference model.py:35-40): every data-parallel replica calls this with
+    the same PRNGKey and gets identical weights — the JAX analogue of the
+    reference's same-seed construction (SURVEY.md section 2.3).
+    """
+    cfg = CFG[name]
+    params: dict = {}
+    state: dict = {}
+    in_ch = 3
+    idx = 0
+    for layer_cfg in cfg:
+        if layer_cfg == "M":
+            continue
+        key, ckey = jax.random.split(key)
+        params[f"conv{idx}"] = ops.conv2d_init(ckey, in_ch, layer_cfg, ksize=3)
+        params[f"bn{idx}"], state[f"bn{idx}"] = ops.batchnorm_init(layer_cfg)
+        in_ch = layer_cfg
+        idx += 1
+    key, fkey = jax.random.split(key)
+    params["fc"] = ops.dense_init(fkey, _flatten_features(cfg), NUM_CLASSES)
+    return params, state
+
+
+def apply(
+    params: PyTree,
+    state: PyTree,
+    x: Array,
+    *,
+    name: str = "VGG11",
+    train: bool = False,
+    dtype: jnp.dtype | None = None,
+    bn_axis_name: str | None = None,
+) -> tuple[Array, PyTree]:
+    """Forward pass; returns (logits[B,10], new_state).
+
+    Equivalent of ``_VGG.forward`` (reference model.py:42-46): conv stack ->
+    flatten to (B, 512) -> linear head.  ``x`` is NHWC float input.
+
+    ``dtype`` selects the compute dtype (e.g. jnp.bfloat16 for MXU-friendly
+    compute with float32 params); ``bn_axis_name`` enables cross-replica
+    sync-BN, which the reference does NOT do — leave None for parity.
+    """
+    if dtype is not None:
+        x = x.astype(dtype)
+    new_state: dict = {}
+    idx = 0
+    for layer_cfg in CFG[name]:
+        if layer_cfg == "M":
+            x = ops.max_pool(x)
+        else:
+            x = ops.conv2d(params[f"conv{idx}"], x)
+            x, new_state[f"bn{idx}"] = ops.batchnorm(
+                params[f"bn{idx}"], state[f"bn{idx}"], x,
+                train=train, axis_name=bn_axis_name,
+            )
+            x = ops.relu(x)
+            idx += 1
+    x = x.reshape(x.shape[0], -1)  # (B, 512); reference model.py:44
+    logits = ops.dense(params["fc"], x)
+    return logits.astype(jnp.float32), new_state
+
+
+def param_count(params: PyTree) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def tensor_count(params: PyTree) -> int:
+    return len(jax.tree.leaves(params))
+
+
+# Factory functions mirroring the reference's API surface.  The reference
+# defines cfgs for all four variants but only exposes VGG11() (model.py:49-50);
+# we expose all four as a capability upgrade.
+
+def VGG11(key: Array) -> tuple[PyTree, PyTree]:
+    return init(key, "VGG11")
+
+
+def VGG13(key: Array) -> tuple[PyTree, PyTree]:
+    return init(key, "VGG13")
+
+
+def VGG16(key: Array) -> tuple[PyTree, PyTree]:
+    return init(key, "VGG16")
+
+
+def VGG19(key: Array) -> tuple[PyTree, PyTree]:
+    return init(key, "VGG19")

@@ -1,0 +1,200 @@
+"""Functional neural-network primitives, TPU-first.
+
+These are the compute building blocks the reference obtains from ``torch.nn``
+(reference: ``model.py:11-27`` builds Conv3x3 -> BatchNorm2d -> ReLU(inplace)
+blocks and MaxPool2d(2,2)).  Here they are expressed as pure functions over
+explicit parameter pytrees so that the whole model is a single XLA program:
+
+- layout is **NHWC** with **HWIO** kernels (the TPU-native convolution layout,
+  unlike torch's NCHW/OIHW) so XLA can tile convs straight onto the MXU;
+- all functions are pure: BatchNorm returns its updated running statistics
+  instead of mutating buffers in place;
+- a ``dtype`` argument supports bfloat16 compute with float32 parameters
+  (params are cast on entry, results accumulated in float32 where it matters).
+
+Initialisation matches torch defaults (kaiming-uniform with a=sqrt(5) for
+conv/linear weights, uniform(+-1/sqrt(fan_in)) for biases, ones/zeros for BN)
+so that loss curves are comparable with the reference, though not bitwise
+identical (different RNG streams; see SURVEY.md section 7.3).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+PyTree = Any
+
+# Torch BatchNorm2d defaults (reference model.py:24 uses defaults).
+BN_MOMENTUM = 0.1
+BN_EPS = 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Initialisers (torch-default-compatible)
+# ---------------------------------------------------------------------------
+
+def kaiming_uniform(key: Array, shape: tuple[int, ...], fan_in: int) -> Array:
+    """torch.nn.init.kaiming_uniform_(a=sqrt(5)) == uniform(+-sqrt(1/fan_in))."""
+    bound = math.sqrt(1.0 / fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def fan_in_uniform(key: Array, shape: tuple[int, ...], fan_in: int) -> Array:
+    """torch's default bias init: uniform(+-1/sqrt(fan_in))."""
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+# ---------------------------------------------------------------------------
+# Conv2d
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key: Array, in_ch: int, out_ch: int, ksize: int = 3) -> dict:
+    """Parameters for a 2-D convolution; kernel layout HWIO (TPU-native)."""
+    kkey, bkey = jax.random.split(key)
+    fan_in = in_ch * ksize * ksize
+    return {
+        "kernel": kaiming_uniform(kkey, (ksize, ksize, in_ch, out_ch), fan_in),
+        "bias": fan_in_uniform(bkey, (out_ch,), fan_in),
+    }
+
+
+def conv2d(params: dict, x: Array, *, stride: int = 1, padding: int = 1) -> Array:
+    """NHWC conv with HWIO kernel (reference conv: model.py:18-23)."""
+    kernel = params["kernel"].astype(x.dtype)
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + params["bias"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm2d
+# ---------------------------------------------------------------------------
+
+def batchnorm_init(num_features: int) -> tuple[dict, dict]:
+    """Returns (trainable params, running state) for BatchNorm2d.
+
+    Matches torch defaults: weight=1, bias=0, running_mean=0, running_var=1
+    (reference model.py:24).
+    """
+    params = {
+        "scale": jnp.ones((num_features,), jnp.float32),
+        "bias": jnp.zeros((num_features,), jnp.float32),
+    }
+    state = {
+        "mean": jnp.zeros((num_features,), jnp.float32),
+        "var": jnp.ones((num_features,), jnp.float32),
+    }
+    return params, state
+
+
+def batchnorm(
+    params: dict,
+    state: dict,
+    x: Array,
+    *,
+    train: bool,
+    axis_name: str | None = None,
+) -> tuple[Array, dict]:
+    """BatchNorm over NHWC input; returns (y, new_state).
+
+    Statistics are computed in float32 regardless of compute dtype.  When
+    ``axis_name`` is given (sync-BN mode), batch statistics are additionally
+    averaged across that mesh axis with ``lax.pmean``; the reference does NOT
+    sync BN across replicas (SURVEY.md section 2.3), so the default is local.
+    Running stats use torch's convention: momentum 0.1, *unbiased* variance
+    stored in the running buffer, biased variance used for normalisation.
+    """
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        mean_sq = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
+        if axis_name is not None:
+            # Global moments first, THEN the variance — pmean of local
+            # variances would understate global variance by the spread of the
+            # per-replica means.
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+        var = mean_sq - jnp.square(mean)
+        n = x32.shape[0] * x32.shape[1] * x32.shape[2]
+        if axis_name is not None:
+            n = n * lax.psum(jnp.ones((), jnp.float32), axis_name)
+        unbiased = var * (n / jnp.maximum(n - 1, 1))
+        new_state = {
+            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
+            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    inv = lax.rsqrt(var + BN_EPS) * params["scale"].astype(jnp.float32)
+    y32 = (x32 - mean) * inv + params["bias"].astype(jnp.float32)
+    return y32.astype(x.dtype), new_state
+
+
+# ---------------------------------------------------------------------------
+# Pooling / Dense
+# ---------------------------------------------------------------------------
+
+def max_pool(x: Array, window: int = 2, stride: int = 2) -> Array:
+    """MaxPool2d(kernel_size=2, stride=2) over NHWC (reference model.py:16)."""
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x,
+        neg_inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def dense_init(key: Array, in_features: int, out_features: int) -> dict:
+    kkey, bkey = jax.random.split(key)
+    return {
+        "kernel": kaiming_uniform(kkey, (in_features, out_features), in_features),
+        "bias": fan_in_uniform(bkey, (out_features,), in_features),
+    }
+
+
+def dense(params: dict, x: Array) -> Array:
+    """Linear layer (reference fc1: model.py:40)."""
+    return x @ params["kernel"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+
+def relu(x: Array) -> Array:
+    return jnp.maximum(x, 0)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def cross_entropy_per_sample(logits: Array, labels: Array) -> Array:
+    """Per-sample cross-entropy, computed in float32 for stability under
+    bf16 compute.  Shared by the training loss and the masked eval sum."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return logz - true_logit
+
+
+def cross_entropy_loss(logits: Array, labels: Array) -> Array:
+    """Mean cross-entropy over the batch == torch.nn.CrossEntropyLoss()."""
+    return jnp.mean(cross_entropy_per_sample(logits, labels))
+
+
+def accuracy_count(logits: Array, labels: Array) -> Array:
+    """Number of correct argmax predictions (reference main.py:60-62)."""
+    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)

@@ -1,0 +1,7 @@
+from . import mesh, strategies
+from .mesh import DATA_AXIS, data_sharding, make_mesh, shard_batch
+
+__all__ = [
+    "mesh", "strategies",
+    "DATA_AXIS", "data_sharding", "make_mesh", "shard_batch",
+]

@@ -1,0 +1,64 @@
+"""Device mesh construction.
+
+The reference's process topology is 4 Gloo workers, one per node (reference:
+start_ddp.sh:1).  The TPU-native equivalent is a named ``jax.sharding.Mesh``
+over all addressable devices, with collectives compiled by XLA over ICI
+(intra-slice) / DCN (cross-slice).  The reference's parallelism inventory is
+data-parallel only (SURVEY.md section 5), so the default mesh has a single
+``'data'`` axis — but axis names are parameterised so tensor/pipeline/sequence
+axes are future mesh shapes, not rewrites.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    num_devices: int | None = None,
+    *,
+    axis_names: tuple[str, ...] = (DATA_AXIS,),
+    axis_shape: tuple[int, ...] | None = None,
+    devices: list[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh over ``num_devices`` (default: all) devices.
+
+    Replaces ``init_process_group(world_size=...)`` (reference:
+    main_all_reduce.py:96): where Gloo enumerates TCP peers, the mesh
+    enumerates chips and names the axes collectives run over.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    if axis_shape is None:
+        axis_shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    dev_array = np.asarray(devices).reshape(axis_shape)
+    return Mesh(dev_array, axis_names)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a global batch: leading dim split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, *arrays: jax.Array):
+    """Place host-global arrays as batch-sharded global jax.Arrays.
+
+    Single-host equivalent of assembling the global batch from per-rank
+    DataLoader shards (reference: DistributedSampler at main_all_reduce.py:112
+    gives each process 1/N of the batch; here the global array's leading dim
+    is split across the 'data' axis).  For multi-host, use
+    ``jax.make_array_from_process_local_data`` via parallel/init.py.
+    """
+    sharding = data_sharding(mesh)
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,205 @@
+"""Gradient-synchronization strategies as grad-pytree transforms.
+
+The reference implements each strategy as a distinct copy-pasted script whose
+only real delta is ~15 lines between ``loss.backward()`` and
+``optimizer.step()`` (SURVEY.md section 0).  Here each strategy is a pure
+function ``grads -> synced_grads`` executed *inside* the compiled, shard_mapped
+train step, over the named mesh axis:
+
+- ``none``       — identity; the single-process baseline (reference main.py).
+- ``all_reduce`` — per-tensor mean via psum, kept sequential with explicit
+                   optimization barriers (reference main_all_reduce.py:45-48:
+                   34 sequential blocking all_reduces per step).
+- ``gather_scatter`` — per-tensor all_gather -> mean at rank 0 -> broadcast,
+                   sequential (reference main_gather.py:42-59: two network
+                   crossings per tensor, all traffic through rank 0).  This is
+                   the deliberately-naive parameter-server baseline.
+- ``ddp``        — one whole-pytree pmean; XLA's latency-hiding scheduler
+                   provides the bucketing/overlap that torch DDP implements in
+                   C++ autograd hooks (reference main_ddp.py:137).
+- ``bucketed``   — explicit DDP-style gradient bucketing: leaves flattened and
+                   packed into ~25 MB buckets, one psum per bucket (torch
+                   DDP's default bucket_cap_mb=25), making the overlap
+                   measurable and XLA's fusion explicit.
+
+Why barriers: torch dispatches 34 *eager* collectives; XLA would otherwise
+fuse them into one — dissolving exactly the contrast these baselines exist to
+measure (SURVEY.md section 7.3 "preserving naivety on purpose").  Each leaf's
+collective is data-chained to the previous leaf's result with
+``lax.optimization_barrier`` so the schedule stays sequential.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+BUCKET_CAP_MB = 25  # torch DDP default bucket size
+
+
+class Strategy(Protocol):
+    name: str
+    needs_mesh: bool
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree: ...
+
+
+def _chain(leaf: jax.Array, token: jax.Array) -> jax.Array:
+    """Tie ``leaf`` to ``token`` so its collective cannot be reordered/fused
+    with the previous one (emulates the reference's sequential eager
+    dispatch)."""
+    leaf, _ = lax.optimization_barrier((leaf, token))
+    return leaf
+
+
+class NoSync:
+    """Single-process baseline — no communication (reference main.py)."""
+
+    name = "none"
+    needs_mesh = False
+
+    def __call__(self, grads: PyTree, axis: str | None = None) -> PyTree:
+        return grads
+
+
+class AllReduce:
+    """Per-tensor sequential all-reduce-mean (reference main_all_reduce.py:45-48).
+
+    ``psum / N`` is numerically the reference's sum-then-divide; sequencing
+    is forced per tensor to preserve the 34-collectives-per-step structure.
+    """
+
+    name = "all_reduce"
+    needs_mesh = True
+
+    def __init__(self, sequential: bool = True):
+        self.sequential = sequential
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        n = lax.axis_size(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        out = []
+        token = jnp.zeros((), jnp.float32)
+        for g in leaves:
+            if self.sequential:
+                g = _chain(g, token)
+            g = lax.psum(g, axis) / n
+            if self.sequential:
+                token = g.ravel()[0].astype(jnp.float32)
+            out.append(g)
+        return jax.tree.unflatten(treedef, out)
+
+
+class GatherScatter:
+    """Per-tensor gather -> rank-0 mean -> scatter (reference main_gather.py:42-59).
+
+    Faithfully two collectives per tensor through rank 0: an ``all_gather``
+    (superset of the reference's gather-to-0) followed by a broadcast of
+    rank 0's mean, implemented as a masked psum so only rank 0's value
+    survives.  Kept sequential per tensor — this strategy's role is to be the
+    slow parameter-server baseline in the benchmark.
+    """
+
+    name = "gather_scatter"
+    needs_mesh = True
+
+    def __init__(self, sequential: bool = True):
+        self.sequential = sequential
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        idx = lax.axis_index(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        out = []
+        token = jnp.zeros((), jnp.float32)
+        for g in leaves:
+            if self.sequential:
+                g = _chain(g, token)
+            # collective 1: gather all replicas' grads (main_gather.py:49)
+            gathered = lax.all_gather(g, axis)
+            # rank-0 mean (main_gather.py:53-55); other ranks contribute zeros
+            mean0 = jnp.where(idx == 0, 1.0, 0.0).astype(g.dtype) * jnp.mean(
+                gathered, axis=0)
+            # collective 2: broadcast rank 0's mean (scatter, main_gather.py:59)
+            g = lax.psum(mean0, axis)
+            if self.sequential:
+                token = g.ravel()[0].astype(jnp.float32)
+            out.append(g)
+        return jax.tree.unflatten(treedef, out)
+
+
+class DDP:
+    """Whole-pytree fused pmean — the idiomatic TPU path (reference
+    main_ddp.py:137's DistributedDataParallel, minus the C++ machinery: XLA
+    sees all 34 reductions at once and schedules/overlaps them itself)."""
+
+    name = "ddp"
+    needs_mesh = True
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        return jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+
+
+class Bucketed:
+    """Explicit DDP-style bucketing: pack leaves into ~bucket_mb buckets,
+    one psum per bucket (torch DDP's Reducer with bucket_cap_mb=25,
+    reference main_ddp.py:137's underlying engine)."""
+
+    name = "bucketed"
+    needs_mesh = True
+
+    def __init__(self, bucket_mb: int = BUCKET_CAP_MB):
+        self.bucket_bytes = bucket_mb * 1024 * 1024
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        n = lax.axis_size(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        # Pack in reverse so late-backward (output-side) grads share the
+        # first-reduced bucket, like torch DDP's reversed bucket order.
+        buckets: list[list[int]] = [[]]
+        size = 0
+        for i in reversed(range(len(leaves))):
+            nbytes = leaves[i].size * leaves[i].dtype.itemsize
+            if size + nbytes > self.bucket_bytes and buckets[-1]:
+                buckets.append([])
+                size = 0
+            buckets[-1].append(i)
+            size += nbytes
+        out: list[jax.Array | None] = [None] * len(leaves)
+        for bucket in buckets:
+            flat = jnp.concatenate([leaves[i].ravel() for i in bucket])
+            flat = lax.psum(flat, axis) / n
+            offset = 0
+            for i in bucket:
+                g = leaves[i]
+                out[i] = flat[offset : offset + g.size].reshape(g.shape)
+                offset += g.size
+        return jax.tree.unflatten(treedef, out)
+
+
+_REGISTRY: dict[str, Callable[[], Strategy]] = {
+    "none": NoSync,
+    "all_reduce": AllReduce,
+    "gather_scatter": GatherScatter,
+    "ddp": DDP,
+    "bucketed": Bucketed,
+}
+
+
+def get(name: str) -> Strategy:
+    """Look up a strategy by name (the pluggable axis the reference's five
+    copy-pasted scripts should have had)."""
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown strategy {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,240 @@
+"""The single trainer: one jitted train step, pluggable gradient sync.
+
+This factors the reference's five ~80%-identical ``main_*.py`` scripts
+(SURVEY.md section 0) into one training loop where the gradient-sync strategy
+is a plug-in (parallel/strategies.py).  The hot path — zero_grad / forward /
+loss / backward / [sync] / step (reference main_all_reduce.py:36-50) — becomes
+ONE compiled XLA program per step:
+
+- single-process (strategy 'none'): plain ``jax.jit`` (reference main.py);
+- data-parallel: ``shard_map`` over the mesh's ``'data'`` axis, with the
+  batch sharded, params/optimizer state replicated, and per-replica
+  BatchNorm statistics carried with a leading device axis (the reference
+  keeps BN stats local per rank — SURVEY.md section 2.3).
+
+The optimizer is optax ``add_decayed_weights(wd)`` then ``sgd(lr, momentum)``
+— the exact update rule of torch ``SGD(lr=0.1, momentum=0.9,
+weight_decay=1e-4)`` (reference main.py:103-104: grad += wd*p, then the
+momentum buffer, then the step).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .data import augment as aug
+from .models import vgg
+from .ops import nn as ops
+from .parallel import strategies as strat
+from .parallel.mesh import DATA_AXIS, data_sharding, make_mesh, replicated
+from .utils.metrics import IterTimeMeter, LossMeter
+
+PyTree = Any
+
+
+@dataclass
+class TrainConfig:
+    """Hyper-parameters; defaults are the reference's exact settings."""
+
+    model: str = "VGG11"
+    lr: float = 0.1               # main.py:103
+    momentum: float = 0.9         # main.py:104
+    weight_decay: float = 1e-4    # main.py:104
+    batch_size: int = 256         # per replica (main.py:18)
+    strategy: str = "ddp"
+    sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
+    compute_dtype: str | None = None  # e.g. "bfloat16" for MXU-friendly compute
+    augment: bool = True
+    seed: int = 1                 # torch.manual_seed(1), main.py:70
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.add_decayed_weights(cfg.weight_decay),
+        optax.sgd(cfg.lr, momentum=cfg.momentum),
+    )
+
+
+def _loss_fn(params, state, key, images, labels, *, cfg: TrainConfig,
+             bn_axis: str | None):
+    """Forward + loss on one replica's shard; images are raw uint8 NHWC."""
+    if cfg.augment:
+        x = aug.augment(key, images)
+    else:
+        x = aug.normalize(images)
+    logits, new_state = vgg.apply(
+        params, state, x, name=cfg.model, train=True,
+        dtype=cfg.dtype, bn_axis_name=bn_axis,
+    )
+    loss = ops.cross_entropy_loss(logits, labels)
+    return loss, new_state
+
+
+def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
+                    mesh: Mesh | None):
+    """Build the compiled train step.
+
+    Signature: ``step(params, state, opt_state, key, images, labels) ->
+    (params, state, opt_state, loss)``.  Under a mesh, ``state`` leaves carry
+    a leading device axis (per-replica BN stats) and ``loss`` is the
+    cross-replica mean of the per-shard losses.
+    """
+    tx = make_optimizer(cfg)
+    bn_axis = DATA_AXIS if (cfg.sync_bn and mesh is not None) else None
+    grad_fn = jax.value_and_grad(
+        partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
+
+    if mesh is None:
+        if strategy.needs_mesh:
+            raise ValueError(f"strategy {strategy.name!r} requires a mesh")
+
+        @jax.jit
+        def step(params, state, opt_state, key, images, labels):
+            (loss, new_state), grads = grad_fn(params, state, key, images, labels)
+            grads = strategy(grads, None)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_state, opt_state, loss
+
+        return step
+
+    def shard_step(params, state, opt_state, key, images, labels):
+        # state arrives as this replica's (1, ...) slice of the stacked
+        # per-device BN stats; drop/restore the leading axis around compute.
+        local_state = jax.tree.map(lambda s: s[0], state)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        # Differentiate w.r.t. a *device-local* (varying) view of the params
+        # so each replica's grads are its own shard's grads (otherwise the
+        # new shard_map autodiff inserts an implicit psum for replicated
+        # inputs and the strategy's collective would double-reduce).  The
+        # strategy below is then the one and only cross-replica reduction —
+        # exactly the reference's structure (sync between backward and step).
+        local_params = jax.lax.pcast(params, DATA_AXIS, to="varying")
+        (loss, new_state), grads = grad_fn(
+            local_params, local_state, key, images, labels)
+        grads = strategy(grads, DATA_AXIS)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_state = jax.tree.map(lambda s: s[None], new_state)
+        return params, new_state, opt_state, jax.lax.pmean(loss, DATA_AXIS)
+
+    return jax.jit(shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P(), P()),
+    ))
+
+
+def replicate_state(state: PyTree, n: int) -> PyTree:
+    """Stack BN state with a leading device axis (identical initial stats on
+    every replica — same-seed construction, SURVEY.md section 2.3)."""
+    return jax.tree.map(lambda s: jnp.broadcast_to(s[None], (n,) + s.shape), state)
+
+
+def rank0_state(state: PyTree, mesh: Mesh | None) -> PyTree:
+    """Rank 0's BN stats for evaluation (torch DDP broadcasts module buffers
+    from rank 0 — reference main_ddp.py:137's engine behavior)."""
+    if mesh is None:
+        return state
+    return jax.tree.map(lambda s: np.asarray(s)[0], state)
+
+
+class Trainer:
+    """Owns (params, state, opt_state) and the compiled step.
+
+    Replaces the per-script ``main()``s: build model + optimizer from one
+    seed, then drive ``train_epoch`` / ``evaluate`` (reference
+    main_all_reduce.py:84-135).
+    """
+
+    def __init__(self, cfg: TrainConfig, mesh: Mesh | None = None):
+        self.cfg = cfg
+        self.strategy = strat.get(cfg.strategy)
+        if self.strategy.needs_mesh and mesh is None:
+            mesh = make_mesh()
+        self.mesh = mesh if self.strategy.needs_mesh else None
+        self.n_replicas = self.mesh.devices.size if self.mesh else 1
+
+        key = jax.random.key(cfg.seed)
+        self.init_key, self.data_key = jax.random.split(key)
+        params, state = vgg.init(self.init_key, cfg.model)
+        tx = make_optimizer(cfg)
+        opt_state = tx.init(params)
+
+        if self.mesh is not None:
+            rep = replicated(self.mesh)
+            shd = data_sharding(self.mesh)
+            params = jax.device_put(params, rep)
+            opt_state = jax.device_put(opt_state, rep)
+            state = jax.device_put(
+                replicate_state(state, self.n_replicas), shd)
+        self.params, self.state, self.opt_state = params, state, opt_state
+        self.step_fn = make_train_step(cfg, self.strategy, self.mesh)
+        self._step = 0
+
+    # -- one optimizer step over a *global* batch -------------------------
+    def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
+        key = jax.random.fold_in(self.data_key, self._step)
+        if self.mesh is not None:
+            if len(images) % self.n_replicas != 0:
+                raise ValueError(
+                    f"global batch {len(images)} not divisible by the "
+                    f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; pass "
+                    f"per-replica batches of equal size (the sampler pads the "
+                    f"epoch for exactly this reason)")
+            shd = data_sharding(self.mesh)
+            images = jax.device_put(images, shd)
+            labels = jax.device_put(labels, shd)
+        self.params, self.state, self.opt_state, loss = self.step_fn(
+            self.params, self.state, self.opt_state, key, images, labels)
+        self._step += 1
+        return loss
+
+    def train_epoch(self, loaders, epoch: int, *, log=print):
+        """One epoch over per-replica loaders, with the reference's metric
+        windows (loss/20 iters, time/40 iters excl. iter 0 — SURVEY.md 2.3).
+
+        ``loaders``: one DataLoader per replica (the global batch is their
+        concatenation), or a single loader for the single-process baseline.
+        """
+        if not isinstance(loaders, (list, tuple)):
+            loaders = [loaders]
+        assert len(loaders) == self.n_replicas
+        for dl in loaders:
+            dl.set_epoch(epoch)
+        loss_meter, time_meter = LossMeter(), IterTimeMeter()
+        loss = None
+        for batch_idx, batches in enumerate(zip(*loaders)):
+            begin = time.perf_counter()
+            images = np.concatenate([b[0] for b in batches])
+            labels = np.concatenate([b[1] for b in batches])
+            loss = self.train_step(images, labels)
+            loss_val = float(loss)  # sync point, like loss.item() (main.py:37)
+            elapsed = time.perf_counter() - begin
+            rec = loss_meter.update(batch_idx, loss_val)
+            if rec and log:
+                log(f"Epoch: {epoch + 1}, Iteration: {rec.first_iter}-"
+                    f"{rec.last_iter}, Average Loss: {rec.value:.3f}")
+            rec = time_meter.update(batch_idx, elapsed)
+            if rec and log:
+                log(f"Avg Time for iteration {rec.first_iter}-{rec.last_iter}: "
+                    f"{rec.value} seconds.")
+        return loss_meter, time_meter
+
+    def eval_state(self) -> PyTree:
+        return rank0_state(self.state, self.mesh)

@@ -1,0 +1,24 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the JAX-idiomatic replacement for the reference's missing mock layer
+(SURVEY.md section 4): ``xla_force_host_platform_device_count`` gives N fake
+CPU devices so multi-chip sharding/collectives are exercised without a pod.
+Must be set before jax initialises its backends, hence module level here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (if present) force-selects its platform via jax.config
+# at register() time, overriding JAX_PLATFORMS from the environment — pin the
+# config back to cpu so tests always run on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

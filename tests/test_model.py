@@ -1,0 +1,119 @@
+"""Model parity tests: shapes, parameter inventory, forward semantics.
+
+Checks the parity facts documented in SURVEY.md section 2.1 item 1 against the
+reference's ``model.py``: VGG11 has 34 trainable tensors / ~9.23M params; the
+forward pass maps (B,32,32,3) -> (B,10) via a (B,512) flatten.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.models import vgg
+from distributed_pytorch_tpu.ops import nn as ops
+
+
+def test_vgg11_param_inventory():
+    params, state = vgg.init(jax.random.key(1), "VGG11")
+    # 8 convs (w+b) + 8 BNs (scale+bias) + fc (w+b) = 34 tensors.
+    assert vgg.tensor_count(params) == 34
+    # Reference payload: ~9.23M params (SURVEY.md 2.1; exact torch count).
+    n = vgg.param_count(params)
+    assert n == 9_231_114, n
+    # BN running state: 8 layers x (mean, var).
+    assert len(jax.tree.leaves(state)) == 16
+
+
+@pytest.mark.parametrize(
+    "name,n_convs",
+    [("VGG11", 8), ("VGG13", 10), ("VGG16", 13), ("VGG19", 16)],
+)
+def test_family_structure(name, n_convs):
+    params, _ = vgg.init(jax.random.key(0), name)
+    assert vgg.tensor_count(params) == n_convs * 4 + 2
+
+
+def test_forward_shapes():
+    params, state = vgg.init(jax.random.key(1))
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    logits, new_state = vgg.apply(params, state, x, train=True)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+    # state pytree structure preserved
+    assert jax.tree.structure(new_state) == jax.tree.structure(state)
+
+
+def test_forward_bf16_compute():
+    params, state = vgg.init(jax.random.key(1))
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    logits32, _ = vgg.apply(params, state, x, train=False)
+    logits16, _ = vgg.apply(params, state, x, train=False, dtype=jnp.bfloat16)
+    assert logits16.dtype == jnp.float32  # head output upcast
+    np.testing.assert_allclose(logits32, logits16, atol=0.15, rtol=0.1)
+
+
+def test_bn_train_updates_state_eval_does_not():
+    params, state = vgg.init(jax.random.key(1))
+    x = jax.random.normal(jax.random.key(3), (8, 32, 32, 3))
+    _, st_train = vgg.apply(params, state, x, train=True)
+    _, st_eval = vgg.apply(params, state, x, train=False)
+    assert not np.allclose(st_train["bn0"]["mean"], state["bn0"]["mean"])
+    np.testing.assert_array_equal(st_eval["bn0"]["mean"], state["bn0"]["mean"])
+
+
+def test_batchnorm_matches_torch_semantics():
+    """Normalisation + running-stat update match torch.nn.BatchNorm2d."""
+    torch = pytest.importorskip("torch")
+    np.random.seed(0)
+    x = np.random.randn(4, 5, 5, 3).astype(np.float32)
+
+    params, state = ops.batchnorm_init(3)
+    y, new_state = ops.batchnorm(params, state, jnp.asarray(x), train=True)
+
+    bn = torch.nn.BatchNorm2d(3)
+    bn.train()
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)  # NHWC -> NCHW
+    yt = bn(xt).permute(0, 2, 3, 1).detach().numpy()
+
+    np.testing.assert_allclose(np.asarray(y), yt, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_state["mean"]), bn.running_mean.numpy(), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_state["var"]), bn.running_var.numpy(), atol=1e-5)
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    np.random.seed(1)
+    logits = np.random.randn(16, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, 16)
+    ours = float(ops.cross_entropy_loss(jnp.asarray(logits), jnp.asarray(labels)))
+    theirs = float(torch.nn.CrossEntropyLoss()(
+        torch.from_numpy(logits), torch.from_numpy(labels)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_param_count_matches_torch_reference_model():
+    """Cross-check the 34-tensor/9.23M inventory against a torch rebuild.
+
+    Rebuilds the reference architecture (model.py:3-27) in torch and compares
+    tensor count and total params (not values — different RNG)."""
+    torch = pytest.importorskip("torch")
+    nn_t = torch.nn
+
+    cfg = vgg.CFG["VGG11"]
+    layers, in_ch = [], 3
+    for c in cfg:
+        if c == "M":
+            layers.append(nn_t.MaxPool2d(2, 2))
+        else:
+            layers += [nn_t.Conv2d(in_ch, c, 3, 1, 1, bias=True),
+                       nn_t.BatchNorm2d(c), nn_t.ReLU(inplace=True)]
+            in_ch = c
+    model = nn_t.Sequential(*layers, nn_t.Flatten(), nn_t.Linear(512, 10))
+
+    t_params = [p for p in model.parameters()]
+    params, _ = vgg.init(jax.random.key(1))
+    assert len(t_params) == vgg.tensor_count(params) == 34
+    assert sum(p.numel() for p in t_params) == vgg.param_count(params)

@@ -1,0 +1,179 @@
+"""Sync-strategy equivalence tests (SURVEY.md section 4 implications).
+
+The core parity property: gather-mean == all-reduce-mean == ddp == bucketed ==
+manually averaged per-shard gradients, on identical data from identical init
+(the reference's strategies all compute the same mean gradient; only the
+communication pattern differs — SURVEY.md sections 2.1 items 5/6/8).
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_pytorch_tpu import train as train_mod
+from distributed_pytorch_tpu.parallel import strategies as strat
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+N_DEV = 4
+PER_DEV_BATCH = 4
+GLOBAL_BATCH = N_DEV * PER_DEV_BATCH
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N_DEV)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(7)
+    images = rng.integers(0, 256, (GLOBAL_BATCH, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, GLOBAL_BATCH).astype(np.int32)
+    return images, labels
+
+
+def _cfg(strategy, **kw):
+    kw.setdefault("augment", False)  # identical data on every path
+    return TrainConfig(batch_size=PER_DEV_BATCH, strategy=strategy, **kw)
+
+
+def _params_after_one_step(strategy, mesh, batch):
+    tr = Trainer(_cfg(strategy), mesh)
+    tr.train_step(*batch)
+    return jax.tree.map(np.asarray, tr.params), tr
+
+
+class TestStrategyEquivalence:
+    def test_all_mesh_strategies_agree(self, mesh, batch):
+        results = {
+            s: _params_after_one_step(s, mesh, batch)[0]
+            for s in ["all_reduce", "gather_scatter", "ddp", "bucketed"]
+        }
+        ref = results.pop("ddp")
+        for name, params in results.items():
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, atol=1e-6, err_msg=name),
+                ref, params)
+
+    def test_matches_manual_gradient_average(self, mesh, batch):
+        """DP step == average of per-shard grads applied by the optimizer.
+
+        Recomputes, on one device, each shard's gradients (local BN over its
+        own 4 samples, as inside shard_map), averages them, and applies the
+        same optax update — must equal the mesh result bit-for-bit-ish."""
+        cfg = _cfg("ddp")
+        dp_params, tr = _params_after_one_step("ddp", mesh, batch)
+
+        params, state = __import__(
+            "distributed_pytorch_tpu.models.vgg", fromlist=["vgg"]
+        ).init(tr.init_key, cfg.model)
+        tx = train_mod.make_optimizer(cfg)
+        opt_state = tx.init(params)
+        loss_fn = partial(train_mod._loss_fn, cfg=cfg, bn_axis=None)
+
+        images, labels = batch
+        grads_sum = None
+        for d in range(N_DEV):
+            sl = slice(d * PER_DEV_BATCH, (d + 1) * PER_DEV_BATCH)
+            key = jax.random.fold_in(
+                jax.random.fold_in(tr.data_key, 0), d)  # step 0, device d
+            (_, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, key, jnp.asarray(images[sl]),
+                jnp.asarray(labels[sl]))
+            grads_sum = g if grads_sum is None else jax.tree.map(
+                jnp.add, grads_sum, g)
+        grads = jax.tree.map(lambda g: g / N_DEV, grads_sum)
+        updates, _ = tx.update(grads, opt_state, params)
+        manual = optax.apply_updates(params, updates)
+        # atol: psum reduction order differs from sequential host summation
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), b, atol=2e-4),
+            manual, dp_params)
+
+    def test_dp_loss_is_mean_of_shard_losses(self, mesh, batch):
+        cfg = _cfg("ddp")
+        tr = Trainer(cfg, mesh)
+        loss = float(tr.train_step(*batch))
+
+        params, state = __import__(
+            "distributed_pytorch_tpu.models.vgg", fromlist=["vgg"]
+        ).init(tr.init_key, cfg.model)
+        loss_fn = partial(train_mod._loss_fn, cfg=cfg, bn_axis=None)
+        images, labels = batch
+        losses = []
+        for d in range(N_DEV):
+            sl = slice(d * PER_DEV_BATCH, (d + 1) * PER_DEV_BATCH)
+            key = jax.random.fold_in(jax.random.fold_in(tr.data_key, 0), d)
+            l, _ = loss_fn(params, state, key, jnp.asarray(images[sl]),
+                           jnp.asarray(labels[sl]))
+            losses.append(float(l))
+        assert abs(loss - np.mean(losses)) < 1e-5
+
+
+class TestBatchNormSemantics:
+    def test_local_bn_state_drifts_per_replica(self, mesh, batch):
+        """Reference-faithful local BN: replicas see different shards, so
+        their running stats diverge (SURVEY.md 2.3)."""
+        tr = Trainer(_cfg("ddp"), mesh)
+        tr.train_step(*batch)
+        mean = np.asarray(tr.state["bn0"]["mean"])
+        assert mean.shape[0] == N_DEV
+        assert not np.allclose(mean[0], mean[1])
+
+    def test_sync_bn_keeps_replicas_identical(self, mesh, batch):
+        tr = Trainer(_cfg("ddp", sync_bn=True), mesh)
+        tr.train_step(*batch)
+        mean = np.asarray(tr.state["bn0"]["mean"])
+        for d in range(1, N_DEV):
+            np.testing.assert_allclose(mean[0], mean[d], atol=1e-6)
+
+    def test_params_stay_replicated(self, mesh, batch):
+        tr = Trainer(_cfg("all_reduce"), mesh)
+        tr.train_step(*batch)
+        # replicated sharding: one shard per device, all equal
+        leaf = tr.params["fc"]["kernel"]
+        assert leaf.sharding.is_fully_replicated
+
+
+class TestStrategyUnits:
+    def test_registry(self):
+        assert strat.available() == [
+            "all_reduce", "bucketed", "ddp", "gather_scatter", "none"]
+        with pytest.raises(ValueError, match="unknown strategy"):
+            strat.get("nope")
+
+    def test_bucketed_packing_many_buckets(self, mesh):
+        """Force multiple buckets with a tiny cap and check correctness."""
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        s = strat.Bucketed(bucket_mb=1)
+        grads = {
+            "a": jnp.arange(300_000, dtype=jnp.float32),  # 1.2 MB
+            "b": jnp.ones((400_000,), jnp.float32),       # 1.6 MB
+            "c": jnp.full((8, 4), 2.0),
+        }
+
+        def f(g):
+            # pcast-to-varying: real grads inside the train step are varying
+            return s(jax.lax.pcast(g, "data", to="varying"), "data")
+
+        out = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P()))(grads)
+        # mean over identical replicas == identity
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            out, grads)
+
+    def test_none_strategy_is_identity(self):
+        g = {"w": jnp.arange(4.0)}
+        out = strat.NoSync()(g)
+        np.testing.assert_array_equal(out["w"], g["w"])
