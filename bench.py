@@ -1,0 +1,145 @@
+"""Headline benchmark: CIFAR-10 training samples/sec/chip.
+
+Measures the framework's full compiled training step (augment + forward +
+loss + backward + gradient sync + SGD update) at the reference's workload
+shape — VGG-11, batch 256 per replica (reference main.py:18,103-104) — over
+all available devices, and reports throughput per chip.
+
+``vs_baseline`` is the ratio to the reference implementation's semantics run
+with torch on CPU (the reference is CPU-only: main.py:15-16, 4 threads) —
+measured live on this machine when torch is available, else a fallback
+constant measured on the dev box.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_tpu(batch_per_replica: int, warmup: int, iters: int) -> float:
+    """Samples/sec/chip of the compiled train step on real devices."""
+    import jax
+
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    # bfloat16 compute: the MXU-native dtype (params stay float32).
+    cfg = TrainConfig(strategy="ddp" if n_dev > 1 else "none",
+                      batch_size=batch_per_replica,
+                      compute_dtype="bfloat16")
+    mesh = make_mesh(n_dev) if n_dev > 1 else None
+    trainer = Trainer(cfg, mesh=mesh)
+
+    global_batch = batch_per_replica * n_dev
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, global_batch).astype(np.int32)
+
+    _log(f"[bench] platform={platform} devices={n_dev} "
+         f"global_batch={global_batch} strategy={cfg.strategy}")
+    for _ in range(max(warmup, 1)):  # >=1: the timed loop must not compile
+        loss = trainer.train_step(images, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.train_step(images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    sps_total = global_batch * iters / dt
+    _log(f"[bench] {iters} steps in {dt:.3f}s -> {sps_total:.1f} samples/s "
+         f"total, {sps_total / n_dev:.1f}/chip, loss={float(loss):.3f}")
+    return sps_total / n_dev
+
+
+# Reference-semantics torch-CPU throughput measured on the dev box
+# (VGG-11, batch 256, SGD momentum, 4 threads — main.py:15-18,103-104).
+FALLBACK_BASELINE_SPS = 89.4
+
+
+def bench_torch_cpu(batch: int, warmup: int, iters: int) -> float:
+    """Reference-equivalent torch CPU samples/sec (the reference's own
+    single-process hot loop: main.py:30-48, rebuilt from its published
+    semantics — batch 256, VGG-11 with BN, SGD(0.1, 0.9, 1e-4), 4 threads)."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(1)
+    torch.set_num_threads(4)  # reference main.py:16
+
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    layers: list[nn.Module] = []
+    in_ch = 3
+    for c in cfg:
+        if c == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(in_ch, c, 3, padding=1),
+                       nn.BatchNorm2d(c), nn.ReLU(inplace=True)]
+            in_ch = c
+    model = nn.Sequential(*layers, nn.Flatten(), nn.Linear(512, 10))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9,
+                          weight_decay=1e-4)
+    criterion = nn.CrossEntropyLoss()
+    x = torch.randn(batch, 3, 32, 32)
+    y = torch.randint(0, 10, (batch,))
+
+    def step():
+        opt.zero_grad()
+        loss = criterion(model(x), y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    dt = time.perf_counter() - t0
+    sps = batch * iters / dt
+    _log(f"[bench] torch-cpu baseline: {iters} steps in {dt:.3f}s "
+         f"-> {sps:.1f} samples/s")
+    return sps
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    sps_chip = bench_tpu(batch, warmup, iters)
+
+    if os.environ.get("BENCH_SKIP_TORCH"):
+        baseline = FALLBACK_BASELINE_SPS
+    else:
+        try:
+            baseline = bench_torch_cpu(batch, warmup=1, iters=3)
+        except Exception as e:  # torch missing/broken: use recorded constant
+            _log(f"[bench] torch baseline failed ({e}); using fallback")
+            baseline = FALLBACK_BASELINE_SPS
+
+    print(json.dumps({
+        "metric": "cifar10_vgg11_train_samples_per_sec_per_chip",
+        "value": round(sps_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / baseline, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""One CLI replacing the reference's five ``main_*.py`` scripts.
+
+The reference ships five ~80%-identical entry scripts whose real deltas are
+the sync strategy and the rendezvous mode (SURVEY.md section 0).  Here both
+are flags on one entry point, preserving the reference's launch contracts:
+
+- ``python -m distributed_pytorch_tpu.cli --strategy gather_scatter
+  --master-ip 172.18.0.2 --num-nodes 4 --rank $R`` — the README.md:4 /
+  main_all_reduce.py:86-92 argparse contract (per-host process, explicit
+  TCP-style rendezvous on port 6585);
+- ``--rendezvous env`` — the torchrun convention (main_ddp.py:93-104),
+  reading MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK;
+- no distributed flags at all — the single-process baseline (main.py).
+
+Strategy names map to the reference scripts:
+  none            -> main.py            (single-process baseline)
+  gather_scatter  -> main_gather.py     (rank-0 parameter-server sync)
+  all_reduce      -> main_all_reduce.py (per-tensor all-reduce)
+  ddp             -> main_ddp.py / main_part3.py (fused overlapped sync)
+  bucketed        -> torch DDP's explicit 25MB-bucket engine
+
+On TPU each *chip* is a data-parallel rank (the reference's "node"); with N
+hosts the mesh spans all hosts' chips and the per-chip loaders shard the
+global batch exactly like ``DistributedSampler(num_replicas, rank)``
+(reference main_all_reduce.py:112).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+
+from . import eval as evaluation
+from .data import DataLoader, DistributedSampler, load
+from .parallel import init as dist_init
+from .parallel.mesh import make_mesh
+from .train import TrainConfig, Trainer
+from .utils.logging import get_logger, setup_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_pytorch_tpu",
+        description="TPU-native distributed VGG/CIFAR-10 trainer",
+    )
+    # Reference argparse contract (main_all_reduce.py:86-92).
+    p.add_argument("--master-ip", type=str, default=None,
+                   help="coordinator host (rank 0), reference --master-ip")
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="number of host processes, reference --num-nodes")
+    p.add_argument("--rank", type=int, default=0,
+                   help="this host's process id, reference --rank")
+    p.add_argument("--port", type=int, default=dist_init.DEFAULT_PORT)
+    p.add_argument("--rendezvous", choices=["args", "env"], default="args",
+                   help="'args' = explicit --master-ip/--rank "
+                        "(main_all_reduce.py:96); 'env' = torchrun-style "
+                        "MASTER_ADDR/RANK env vars (main_ddp.py:93-104)")
+    p.add_argument("--rendezvous-timeout", type=int,
+                   default=dist_init.DEFAULT_TIMEOUT_S,
+                   help="seconds before rendezvous fails loudly (the "
+                        "reference hangs forever: timeout=None)")
+    # Training hyper-parameters; defaults are the reference's exact values.
+    p.add_argument("--strategy", default="ddp",
+                   choices=["none", "gather_scatter", "all_reduce", "ddp",
+                            "bucketed"])
+    p.add_argument("--model", default="VGG11",
+                   choices=["VGG11", "VGG13", "VGG16", "VGG19"])
+    p.add_argument("--epochs", type=int, default=1)     # main.py:106
+    p.add_argument("--batch-size", type=int, default=256)  # main.py:18
+    p.add_argument("--lr", type=float, default=0.1)     # main.py:103
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--seed", type=int, default=1)       # main.py:70
+    p.add_argument("--compute-dtype", default=None,
+                   choices=[None, "bfloat16", "float32"],
+                   help="bfloat16 = MXU-native compute, float32 params")
+    p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="cross-replica BatchNorm (the reference never syncs "
+                        "BN; default off for parity)")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="limit local devices used (default: all)")
+    # Capability upgrades absent from the reference.
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save params/opt-state/step each epoch; resume "
+                        "automatically if a checkpoint exists")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace for the first epoch")
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def build_loaders(args, n_replicas: int, replica_offset: int,
+                  local: int | None = None):
+    """Per-replica train loaders (``local`` of them, for this host's chips)
+    + one test loader.
+
+    Each chip gets a ``DistributedSampler(num_replicas=<global chips>,
+    rank=<its global index>)`` shard — the reference's per-process sampler
+    (main_all_reduce.py:112) with chips as ranks.  The test set is NOT
+    sharded (every rank evaluates all 10k images — main_gather.py:131).
+    """
+    train_set = load("train", args.data_dir)
+    test_set = load("test", args.data_dir)
+    if local is None:
+        local = n_replicas
+    if n_replicas == 1:
+        train_loaders = [DataLoader(train_set, args.batch_size,
+                                    shuffle=True, seed=0)]
+    else:
+        train_loaders = [
+            DataLoader(
+                train_set, args.batch_size,
+                sampler=DistributedSampler(
+                    len(train_set), num_replicas=n_replicas,
+                    rank=replica_offset + i, shuffle=True, seed=0),
+            )
+            for i in range(local)
+        ]
+    test_loader = DataLoader(test_set, args.batch_size)
+    return train_loaders, test_loader
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Rendezvous FIRST: jax.distributed.initialize must run before anything
+    # touches a backend (even jax.process_index()), mirroring the reference's
+    # init-before-everything ordering (main_all_reduce.py:96 precedes all
+    # torch calls).
+    if args.rendezvous == "env":
+        dist_init.init_from_env(timeout_s=args.rendezvous_timeout)
+    else:
+        dist_init.init_distributed(
+            args.master_ip, args.num_nodes, args.rank,
+            port=args.port, timeout_s=args.rendezvous_timeout)
+    setup_logging(args.log_level)
+    log = get_logger("cli")
+
+    cfg = TrainConfig(
+        model=args.model, lr=args.lr, momentum=args.momentum,
+        weight_decay=args.weight_decay, batch_size=args.batch_size,
+        strategy=args.strategy, sync_bn=args.sync_bn,
+        compute_dtype=args.compute_dtype, augment=not args.no_augment,
+        seed=args.seed,
+    )
+    mesh = None
+    if args.strategy != "none":
+        mesh = make_mesh(args.num_devices)
+    trainer = Trainer(cfg, mesh=mesh)
+    n_replicas = trainer.n_replicas
+    local = max(1, n_replicas // max(jax.process_count(), 1))
+    replica_offset = jax.process_index() * local
+    log.info("devices=%d processes=%d strategy=%s model=%s",
+             n_replicas, jax.process_count(), args.strategy, args.model)
+
+    train_loaders, test_loader = build_loaders(args, n_replicas,
+                                               replica_offset, local)
+
+    start_epoch = 0
+    ckpt = None
+    if args.checkpoint_dir:
+        from .utils import checkpoint as ckpt_mod
+        ckpt = ckpt_mod.Checkpointer(args.checkpoint_dir)
+        start_epoch = ckpt.maybe_restore(trainer)
+        if start_epoch:
+            log.info("resumed from checkpoint at epoch %d", start_epoch)
+
+    for epoch in range(start_epoch, args.epochs):
+        if args.profile_dir and epoch == start_epoch:
+            jax.profiler.start_trace(args.profile_dir)
+        trainer.train_epoch(train_loaders, epoch)
+        if args.profile_dir and epoch == start_epoch:
+            jax.profiler.stop_trace()
+        evaluation.evaluate(
+            trainer.params, trainer.eval_state(), test_loader,
+            model_name=args.model, compute_dtype=cfg.dtype)
+        if ckpt is not None:
+            ckpt.save(trainer, epoch + 1)
+
+    dist_init.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

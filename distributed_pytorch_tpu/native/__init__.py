@@ -1,0 +1,21 @@
+"""Native (C++) runtime components with ctypes bindings.
+
+The reference's native layer lives entirely inside its torch dependency
+(libtorch kernels, Gloo collectives, DataLoader worker processes — SURVEY.md
+section 2.2).  This package is the framework's own native layer for the parts
+that belong on the host CPU rather than the TPU: the input pipeline
+(dataloader.cpp).  Device compute stays in XLA/Pallas — hand-rolled C++
+tensor kernels would only slow a TPU program down.
+
+The shared library is built on demand with g++ (build.py) and loaded via
+ctypes; every native entry point has a pure-numpy fallback so the framework
+works without a toolchain.
+"""
+
+from .loader import (
+    NATIVE_AVAILABLE,
+    augment_normalize_batch,
+    gather_batch,
+)
+
+__all__ = ["NATIVE_AVAILABLE", "augment_normalize_batch", "gather_batch"]

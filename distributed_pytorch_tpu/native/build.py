@@ -1,0 +1,48 @@
+"""On-demand g++ build of the native library (no pip/pybind dependency).
+
+Builds ``dataloader.cpp`` into ``_native_v<ABI>.so`` next to the sources the
+first time it is needed; rebuilds when the source is newer than the binary.
+Thread-safe across processes via atomic rename.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+ABI_VERSION = 1
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_THIS_DIR, "dataloader.cpp")
+LIB = os.path.join(_THIS_DIR, f"_native_v{ABI_VERSION}.so")
+
+CXX = os.environ.get("CXX", "g++")
+CXXFLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", "-Wall"]
+
+
+def build(force: bool = False) -> str | None:
+    """Return the path to the built .so, or None if no toolchain."""
+    if (not force and os.path.exists(LIB)
+            and os.path.getmtime(LIB) >= os.path.getmtime(SRC)):
+        return LIB
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_THIS_DIR)
+        os.close(fd)
+        subprocess.run([CXX, *CXXFLAGS, "-o", tmp, SRC], check=True,
+                       capture_output=True, text=True)
+        os.replace(tmp, LIB)  # atomic: concurrent builders race benignly
+        return LIB
+    except (subprocess.CalledProcessError, OSError):
+        # no toolchain, read-only install dir, ... -> numpy fallback
+        if tmp and os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return None
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(path or "build failed")

@@ -1,0 +1,130 @@
+// Native host-side input pipeline: batched augmentation + normalization.
+//
+// TPU-native equivalent of the work the reference delegates to torchvision
+// transforms inside DataLoader worker *processes* (reference main.py:71-78,
+// num_workers=2 at main.py:85-90): RandomCrop(32, padding=4) +
+// RandomHorizontalFlip + ToTensor + per-channel Normalize.  Instead of
+// forked workers and IPC, this is a multithreaded C++ kernel called in-process
+// via ctypes: one pass over the uint8 batch producing the normalized float32
+// batch, with deterministic counter-based per-sample RNG (splitmix64 of
+// seed ^ sample-index) so results are reproducible and rank-independent.
+//
+// Layout is NHWC throughout (TPU-native; the reference uses NCHW).
+//
+// Build: see Makefile / build.py in this directory (g++ -O3 -shared -fPIC).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kH = 32, kW = 32, kC = 3;
+
+// splitmix64: tiny, high-quality counter-based PRNG (public-domain
+// algorithm); one state advance per draw.
+inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct SampleRng {
+  uint64_t state;
+  explicit SampleRng(uint64_t seed, uint64_t idx)
+      : state(seed ^ (idx * 0xd1342543de82ef95ULL + 0x2545f4914f6cdd1dULL)) {}
+  // uniform integer in [0, n)
+  inline uint32_t below(uint32_t n) {
+    return static_cast<uint32_t>(splitmix64(state) % n);
+  }
+};
+
+// One sample: random crop from a zero-padded (pad each side) canvas +
+// optional horizontal flip + (v/255 - mean)/std, uint8 NHWC -> float32 NHWC.
+void augment_one(const uint8_t* in, float* out, uint64_t seed, uint64_t idx,
+                 int pad, bool training, const float* scale,
+                 const float* shift) {
+  int offy = 0, offx = 0;
+  bool flip = false;
+  if (training) {
+    SampleRng rng(seed, idx);
+    offy = static_cast<int>(rng.below(2 * pad + 1)) - pad;  // [-pad, pad]
+    offx = static_cast<int>(rng.below(2 * pad + 1)) - pad;
+    flip = rng.below(2) != 0;
+  }
+  for (int y = 0; y < kH; ++y) {
+    const int sy = y + offy;
+    const bool row_ok = sy >= 0 && sy < kH;
+    for (int x = 0; x < kW; ++x) {
+      const int xx = flip ? (kW - 1 - x) : x;
+      const int sx = xx + offx;
+      float* o = out + (y * kW + x) * kC;
+      if (row_ok && sx >= 0 && sx < kW) {
+        const uint8_t* p = in + (sy * kW + sx) * kC;
+        for (int c = 0; c < kC; ++c) o[c] = p[c] * scale[c] + shift[c];
+      } else {
+        // zero-padding pixel: value 0 -> (0 - mean)/std == shift
+        for (int c = 0; c < kC; ++c) o[c] = shift[c];
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// in:  n * 32*32*3 uint8 NHWC
+// out: n * 32*32*3 float32 NHWC, (v/255 - mean[c]) / std[c]
+// training != 0 applies random crop (pad 4 semantics via `pad`) + hflip.
+void augment_normalize_batch(const uint8_t* in, float* out, int64_t n,
+                             uint64_t seed, const float* mean,
+                             const float* stddev, int pad, int training,
+                             int num_threads) {
+  // Precompute per-channel affine: v*scale + shift == (v/255 - mean)/std.
+  float scale[kC], shift[kC];
+  for (int c = 0; c < kC; ++c) {
+    scale[c] = 1.0f / (255.0f * stddev[c]);
+    shift[c] = -mean[c] / stddev[c];
+  }
+  const int64_t px = int64_t{kH} * kW * kC;
+  if (num_threads <= 1 || n < 64) {
+    for (int64_t i = 0; i < n; ++i)
+      augment_one(in + i * px, out + i * px, seed, static_cast<uint64_t>(i),
+                  pad, training != 0, scale, shift);
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  auto worker = [&] {
+    for (;;) {
+      const int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      augment_one(in + i * px, out + i * px, seed, static_cast<uint64_t>(i),
+                  pad, training != 0, scale, shift);
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(num_threads);
+  for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+}
+
+// Gather rows of a (total, 32*32*3) uint8 image store and an int32 label
+// store into contiguous batch buffers — the DataLoader's collate step
+// (reference main.py:85-90) without per-sample Python.
+void gather_batch(const uint8_t* images, const int32_t* labels,
+                  const int64_t* indices, int64_t n, uint8_t* out_images,
+                  int32_t* out_labels) {
+  const int64_t px = int64_t{kH} * kW * kC;
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(out_images + i * px, images + indices[i] * px, px);
+    out_labels[i] = labels[indices[i]];
+  }
+}
+
+int native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""ctypes bindings for dataloader.cpp, with numpy fallbacks.
+
+``augment_normalize_batch`` is the host-side equivalent of the reference's
+torchvision transform stack (main.py:71-78); the framework's default path
+augments on *device* (data/augment.py), but the host path exists for
+(a) overlap experiments — host augment of batch k+1 while the TPU runs step k
+— and (b) parity with the reference's host-worker architecture.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..data.cifar10 import MEAN, STD
+from . import build as _build
+
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+NATIVE_AVAILABLE = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed, NATIVE_AVAILABLE
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("DPT_DISABLE_NATIVE"):
+        return None
+    path = _build.build()
+    if path is None:
+        _load_failed = True  # don't retry the compiler in the data hot path
+        return None
+    lib = ctypes.CDLL(path)
+    lib.augment_normalize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.gather_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.native_abi_version.restype = ctypes.c_int
+    assert lib.native_abi_version() == _build.ABI_VERSION
+    _lib = lib
+    NATIVE_AVAILABLE = True
+    return lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# -- reference python implementations (fallback + test oracle) -------------
+
+def _splitmix64(state: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorised splitmix64 step: returns (new_state, draw)."""
+    state = (state + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z = state.copy()
+    z ^= z >> np.uint64(30)
+    z = (z * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z ^= z >> np.uint64(27)
+    z = (z * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    z ^= z >> np.uint64(31)
+    return state, z
+
+
+def _sample_rng_draws(seed: int, n: int, pad: int):
+    """(offy, offx, flip) per sample — bit-identical to SampleRng in C++."""
+    idx = np.arange(n, dtype=np.uint64)
+    state = np.uint64(seed) ^ (
+        (idx * np.uint64(0xD1342543DE82EF95) + np.uint64(0x2545F4914F6CDD1D))
+        & np.uint64(0xFFFFFFFFFFFFFFFF))
+    m = np.uint64(2 * pad + 1)
+    state, d1 = _splitmix64(state)
+    offy = (d1 % m).astype(np.int64) - pad
+    state, d2 = _splitmix64(state)
+    offx = (d2 % m).astype(np.int64) - pad
+    state, d3 = _splitmix64(state)
+    flip = (d3 % np.uint64(2)).astype(bool)
+    return offy, offx, flip
+
+
+def _augment_numpy(images: np.ndarray, seed: int, pad: int,
+                   training: bool) -> np.ndarray:
+    n, h, w, c = images.shape
+    x = images.astype(np.float32) / 255.0
+    if training:
+        offy, offx, flip = _sample_rng_draws(seed, n, pad)
+        padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), np.float32)
+        padded[:, pad:pad + h, pad:pad + w] = x
+        out = np.empty_like(x)
+        for i in range(n):  # fallback path; the .so is the fast path
+            img = padded[i, pad + offy[i]: pad + offy[i] + h,
+                         pad + offx[i]: pad + offx[i] + w]
+            out[i] = img[:, ::-1] if flip[i] else img
+        x = out
+    return (x - MEAN) / STD
+
+
+# -- public API ------------------------------------------------------------
+
+def augment_normalize_batch(images: np.ndarray, *, seed: int = 0,
+                            training: bool = True, pad: int = 4,
+                            num_threads: int = 0) -> np.ndarray:
+    """uint8 NHWC batch -> augmented normalized float32 NHWC batch."""
+    assert images.dtype == np.uint8 and images.ndim == 4
+    lib = _load()
+    if lib is None:
+        return _augment_numpy(images, seed, pad, training)
+    images = np.ascontiguousarray(images)
+    out = np.empty(images.shape, np.float32)
+    if num_threads <= 0:
+        num_threads = min(os.cpu_count() or 1, 16)
+    mean = np.ascontiguousarray(MEAN, np.float32)
+    std = np.ascontiguousarray(STD, np.float32)
+    lib.augment_normalize_batch(
+        _ptr(images, ctypes.c_uint8), _ptr(out, ctypes.c_float),
+        images.shape[0], ctypes.c_uint64(seed),
+        _ptr(mean, ctypes.c_float), _ptr(std, ctypes.c_float),
+        pad, int(training), num_threads)
+    return out
+
+
+def gather_batch(images: np.ndarray, labels: np.ndarray,
+                 indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Collate ``images[indices], labels[indices]`` into contiguous buffers."""
+    lib = _load()
+    indices = np.ascontiguousarray(indices, np.int64)
+    if lib is None:
+        return images[indices], labels[indices]
+    images = np.ascontiguousarray(images)
+    labels = np.ascontiguousarray(labels, np.int32)
+    out_i = np.empty((len(indices),) + images.shape[1:], np.uint8)
+    out_l = np.empty(len(indices), np.int32)
+    lib.gather_batch(_ptr(images, ctypes.c_uint8), _ptr(labels, ctypes.c_int32),
+                     _ptr(indices, ctypes.c_int64), len(indices),
+                     _ptr(out_i, ctypes.c_uint8), _ptr(out_l, ctypes.c_int32))
+    return out_i, out_l

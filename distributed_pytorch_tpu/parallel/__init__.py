@@ -1,7 +1,9 @@
-from . import mesh, strategies
+from . import init, mesh, strategies
+from .init import init_distributed, init_from_env, shutdown
 from .mesh import DATA_AXIS, data_sharding, make_mesh, shard_batch
 
 __all__ = [
-    "mesh", "strategies",
+    "init", "mesh", "strategies",
+    "init_distributed", "init_from_env", "shutdown",
     "DATA_AXIS", "data_sharding", "make_mesh", "shard_batch",
 ]

@@ -1,0 +1,95 @@
+"""Multi-host rendezvous: the TPU-native ``init_process_group``.
+
+The reference rendezvouses 4 Gloo workers over TCP in one of two ways
+(SURVEY.md section 2.1 item 7):
+
+- explicit: ``init_process_group('gloo', init_method='tcp://<master-ip>:6585',
+  world_size, rank)`` from ``--master-ip/--num-nodes/--rank`` CLI args
+  (reference main_all_reduce.py:86-96);
+- env-var: torchrun sets MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK and
+  ``init_process_group('gloo')`` reads them (reference main_ddp.py:93-104).
+
+Both contracts are preserved here, mapped onto
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)``: the coordinator (rank 0's host, the ``--master-ip`` analog)
+runs the distributed KV store; XLA then compiles collectives over ICI within
+a slice and DCN across slices — there is no per-collective TCP path to
+configure.
+
+Failure-detection upgrade over the reference: the reference passes
+``timeout=None`` so a missing peer hangs forever (SURVEY.md section 2.3).
+Here rendezvous has a real default timeout and raises a diagnosable
+``RendezvousError`` naming the coordinator it could not reach.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+DEFAULT_PORT = 6585  # the reference's hard-coded port (main_all_reduce.py:96)
+DEFAULT_TIMEOUT_S = 300
+
+
+class RendezvousError(RuntimeError):
+    """Multi-host initialization failed (peer missing / coordinator down)."""
+
+
+def init_distributed(
+    master_ip: str | None = None,
+    num_nodes: int = 1,
+    rank: int = 0,
+    *,
+    port: int = DEFAULT_PORT,
+    timeout_s: int | None = DEFAULT_TIMEOUT_S,
+) -> None:
+    """Explicit-rendezvous mode (reference main_all_reduce.py:96 contract).
+
+    No-op for ``num_nodes == 1`` (single-controller JAX needs no init), so the
+    same entry point serves the single-process baseline (reference main.py).
+    """
+    if num_nodes <= 1:
+        return
+    if master_ip is None:
+        raise ValueError("--master-ip is required when --num-nodes > 1")
+    coordinator = f"{master_ip}:{port}"
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_nodes,
+            process_id=rank,
+            initialization_timeout=timeout_s if timeout_s else 86_400,
+        )
+    except Exception as e:
+        raise RendezvousError(
+            f"rendezvous with coordinator {coordinator} failed for rank "
+            f"{rank}/{num_nodes} after {timeout_s}s: {e}") from e
+
+
+def init_from_env(*, timeout_s: int | None = DEFAULT_TIMEOUT_S) -> None:
+    """Env-var rendezvous mode (the torchrun convention, main_ddp.py:93-104).
+
+    Reads MASTER_ADDR / MASTER_PORT / WORLD_SIZE / RANK.  Missing vars mean
+    single-process (matching a bare ``python main_ddp.py`` failing loudly in
+    the reference — here we degrade to the single-host path instead).
+    """
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    init_distributed(
+        os.environ.get("MASTER_ADDR"),
+        world_size,
+        int(os.environ.get("RANK", "0")),
+        port=int(os.environ.get("MASTER_PORT", str(DEFAULT_PORT))),
+        timeout_s=timeout_s,
+    )
+
+
+def shutdown() -> None:
+    """Tear down the distributed service (torch's destroy_process_group)."""
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()
+
+
+def process_info() -> tuple[int, int]:
+    """(process_id, process_count) — the post-init (rank, world_size)."""
+    return jax.process_index(), jax.process_count()

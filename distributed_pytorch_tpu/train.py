@@ -191,15 +191,22 @@ class Trainer:
     def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
         key = jax.random.fold_in(self.data_key, self._step)
         if self.mesh is not None:
-            if len(images) % self.n_replicas != 0:
-                raise ValueError(
-                    f"global batch {len(images)} not divisible by the "
-                    f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; pass "
-                    f"per-replica batches of equal size (the sampler pads the "
-                    f"epoch for exactly this reason)")
             shd = data_sharding(self.mesh)
-            images = jax.device_put(images, shd)
-            labels = jax.device_put(labels, shd)
+            if jax.process_count() > 1:
+                # Multi-host: each process contributes its local ranks' shard
+                # of the global batch (the per-host DistributedSampler split,
+                # reference main_all_reduce.py:112); assemble a global array.
+                images = jax.make_array_from_process_local_data(shd, images)
+                labels = jax.make_array_from_process_local_data(shd, labels)
+            else:
+                if len(images) % self.n_replicas != 0:
+                    raise ValueError(
+                        f"global batch {len(images)} not divisible by the "
+                        f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; "
+                        f"pass per-replica batches of equal size (the sampler "
+                        f"pads the epoch for exactly this reason)")
+                images = jax.device_put(images, shd)
+                labels = jax.device_put(labels, shd)
         self.params, self.state, self.opt_state, loss = self.step_fn(
             self.params, self.state, self.opt_state, key, images, labels)
         self._step += 1
@@ -214,7 +221,11 @@ class Trainer:
         """
         if not isinstance(loaders, (list, tuple)):
             loaders = [loaders]
-        assert len(loaders) == self.n_replicas
+        # One loader per *locally-fed* replica: all of them single-host, this
+        # process's shard of the mesh on multi-host.
+        local = max(1, self.n_replicas // max(jax.process_count(), 1))
+        assert len(loaders) == local, (
+            f"got {len(loaders)} loaders for {local} local replicas")
         for dl in loaders:
             dl.set_epoch(epoch)
         loss_meter, time_meter = LossMeter(), IterTimeMeter()

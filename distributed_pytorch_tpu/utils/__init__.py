@@ -1,3 +1,3 @@
-from . import metrics
+from . import checkpoint, logging, metrics
 
-__all__ = ["metrics"]
+__all__ = ["checkpoint", "logging", "metrics"]

@@ -1,0 +1,36 @@
+"""Structured logging: the observability layer the reference lacks.
+
+The reference imports ``logging`` but never configures it and reports
+everything via bare ``print`` (reference main.py:10, SURVEY.md section 5).
+Here one ``setup_logging`` call configures rank-aware stdlib logging; the
+training loop's printed windows (loss/20 iters, time/40 iters) route through
+it so output is greppable and per-process attributable on multi-host runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def setup_logging(level: str = "INFO") -> None:
+    """Configure root logging with a rank-aware format (idempotent)."""
+    try:
+        import jax
+        rank = jax.process_index()
+    except Exception:
+        rank = 0
+    root = logging.getLogger("distributed_pytorch_tpu")
+    root.setLevel(level.upper())
+    if root.handlers:  # already configured
+        return
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(
+        f"%(asctime)s rank{rank} %(name)s %(levelname)s: %(message)s",
+        datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"distributed_pytorch_tpu.{name}")
