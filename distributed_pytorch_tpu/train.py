@@ -92,6 +92,10 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
     (params, state, opt_state, loss)``.  Under a mesh, ``state`` leaves carry
     a leading device axis (per-replica BN stats) and ``loss`` is the
     cross-replica mean of the per-shard losses.
+
+    The three training-state arguments are DONATED: the step updates them in
+    place on device and the caller must use the returned pytrees (passing a
+    consumed buffer again raises "Array has been deleted").
     """
     tx = make_optimizer(cfg)
     bn_axis = DATA_AXIS if (cfg.sync_bn and mesh is not None) else None
@@ -102,7 +106,7 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
         if strategy.needs_mesh:
             raise ValueError(f"strategy {strategy.name!r} requires a mesh")
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def step(params, state, opt_state, key, images, labels):
             (loss, new_state), grads = grad_fn(params, state, key, images, labels)
             grads = strategy(grads, None)
@@ -132,12 +136,15 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
         new_state = jax.tree.map(lambda s: s[None], new_state)
         return params, new_state, opt_state, jax.lax.pmean(loss, DATA_AXIS)
 
+    # donate_argnums: params/BN-state/opt-state are consumed and re-emitted
+    # every step — donation lets XLA update them in place (no HBM copy of the
+    # ~36.9 MB params + ~36.9 MB momentum buffers per step).
     return jax.jit(shard_map(
         shard_step,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(), P()),
-    ))
+    ), donate_argnums=(0, 1, 2))
 
 
 def replicate_state(state: PyTree, n: int) -> PyTree:
