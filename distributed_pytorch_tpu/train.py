@@ -155,9 +155,13 @@ def replicate_state(state: PyTree, n: int) -> PyTree:
 
 def rank0_state(state: PyTree, mesh: Mesh | None) -> PyTree:
     """Rank 0's BN stats for evaluation (torch DDP broadcasts module buffers
-    from rank 0 — reference main_ddp.py:137's engine behavior)."""
+    from rank 0 — reference main_ddp.py:137's engine behavior).
+
+    Always returns host copies: the live ``state`` buffers are donated into
+    the next compiled step, so a held reference would otherwise be deleted.
+    """
     if mesh is None:
-        return state
+        return jax.tree.map(np.asarray, state)
     return jax.tree.map(lambda s: np.asarray(s)[0], state)
 
 

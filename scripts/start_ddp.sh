@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Parity launcher for the reference's start_ddp.sh:1:
+#   torchrun --nproc_per_node=1 --nnodes=4 --node_rank=0 \
+#     --master_addr="172.18.0.2" --master_port=6585 main_ddp.py
+# Run once per host with NODE_RANK set (the reference edits --node_rank by
+# hand per node).  One process per host owns all its TPU chips.
+set -euo pipefail
+MASTER_ADDR="${MASTER_ADDR:-172.18.0.2}"
+NODE_RANK="${NODE_RANK:-0}"
+NNODES="${NNODES:-4}"
+exec python -m distributed_pytorch_tpu.launch \
+  --nproc_per_node=1 --nnodes="$NNODES" --node_rank="$NODE_RANK" \
+  --master_addr="$MASTER_ADDR" --master_port=6585 -- \
+  -m distributed_pytorch_tpu.cli --rendezvous env --strategy ddp

@@ -1,0 +1,105 @@
+"""Launcher tests: env contract, failure detection, elastic restarts.
+
+The reference's launcher is one torchrun line (start_ddp.sh:1) with no
+restart/failure config; these tests pin our agent's upgrades — workers get
+the exact MASTER_ADDR/.../RANK env convention (main_ddp.py:93-100), a failed
+worker tears down the gang promptly instead of hanging (the reference's
+timeout=None behavior), and --max-restarts relaunches the gang.
+"""
+
+import sys
+import time
+
+from distributed_pytorch_tpu.launch import LocalAgent, build_parser
+
+
+def _quiet(*a):
+    pass
+
+
+def test_worker_specs_env_contract():
+    agent = LocalAgent(["x.py"], nnodes=4, node_rank=2, nproc_per_node=2,
+                       master_addr="10.0.0.1", master_port=6585, log=_quiet)
+    specs = agent.specs()
+    assert [s.rank for s in specs] == [4, 5]
+    env = specs[1].env()
+    assert env["MASTER_ADDR"] == "10.0.0.1"
+    assert env["MASTER_PORT"] == "6585"
+    assert env["WORLD_SIZE"] == "8"
+    assert env["LOCAL_WORLD_SIZE"] == "2"
+    assert env["RANK"] == "5"
+    assert env["LOCAL_RANK"] == "1"
+    assert env["NODE_RANK"] == "2"
+
+
+def test_gang_success_and_env_propagation(tmp_path):
+    out = tmp_path / "ranks"
+    out.mkdir()
+    prog = (
+        "import os, pathlib; "
+        f"pathlib.Path(r'{out}', os.environ['RANK']).write_text("
+        "os.environ['WORLD_SIZE'])"
+    )
+    agent = LocalAgent(["-c", prog], nproc_per_node=3, log=_quiet)
+    result = agent.run()
+    assert result.returncode == 0
+    assert result.per_rank == {0: 0, 1: 0, 2: 0}
+    assert sorted(p.name for p in out.iterdir()) == ["0", "1", "2"]
+    assert (out / "1").read_text() == "3"
+
+
+def test_failure_detection_tears_down_gang():
+    # rank 1 fails fast; ranks 0 and 2 would sleep for 60s.  The agent must
+    # detect the failure and kill the sleepers well within that.
+    prog = (
+        "import os, sys, time\n"
+        "if os.environ['RANK'] == '1': sys.exit(3)\n"
+        "time.sleep(60)\n"
+    )
+    agent = LocalAgent(["-c", prog], nproc_per_node=3,
+                       monitor_interval_s=0.05, log=_quiet)
+    t0 = time.monotonic()
+    result = agent.run()
+    elapsed = time.monotonic() - t0
+    assert result.returncode == 3
+    assert result.failed_rank == 1
+    assert elapsed < 30, f"gang teardown took {elapsed:.1f}s"
+    # survivors were signal-terminated, not left running
+    assert result.per_rank[0] != 0 and result.per_rank[2] != 0
+
+
+def test_max_restarts_relaunches_gang(tmp_path):
+    sentinel = tmp_path / "second_attempt"
+    # Attempt 1: sentinel missing -> create it and fail.  Attempt 2: succeed.
+    prog = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path(r'{sentinel}')\n"
+        "if p.exists(): sys.exit(0)\n"
+        "p.write_text('')\n"
+        "sys.exit(1)\n"
+    )
+    agent = LocalAgent(["-c", prog], nproc_per_node=1, max_restarts=2,
+                       monitor_interval_s=0.05, log=_quiet)
+    result = agent.run()
+    assert result.returncode == 0
+    assert result.restarts_used == 1
+
+
+def test_restarts_exhausted_reports_failure():
+    agent = LocalAgent(["-c", "import sys; sys.exit(7)"], nproc_per_node=1,
+                       max_restarts=1, monitor_interval_s=0.05, log=_quiet)
+    result = agent.run()
+    assert result.returncode == 7
+    assert result.restarts_used == 1
+
+
+def test_parser_matches_torchrun_flags():
+    # Both torchrun's underscore spelling (start_ddp.sh:1) and dashes parse.
+    args = build_parser().parse_args(
+        ["--nproc_per_node=1", "--nnodes=4", "--node_rank=0",
+         "--master_addr=172.18.0.2", "--master_port=6585", "--",
+         "-m", "distributed_pytorch_tpu.cli", "--rendezvous", "env"])
+    assert args.nnodes == 4
+    assert args.master_addr == "172.18.0.2"
+    assert args.cmd[0] == "--"
+    assert "-m" in args.cmd
