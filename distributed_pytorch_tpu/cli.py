@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically if a checkpoint exists")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the first epoch")
+    p.add_argument("--debug-checks", action="store_true",
+                   help="after each epoch, verify DP invariants: replicated "
+                        "params/opt-state bitwise-identical on every device "
+                        "and finite (utils/debug.py)")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -175,6 +179,9 @@ def main(argv: list[str] | None = None) -> int:
         trainer.train_epoch(train_loaders, epoch)
         if args.profile_dir and epoch == start_epoch:
             jax.profiler.stop_trace()
+        if args.debug_checks:
+            trainer.check_consistency()
+            log.info("epoch %d: replica-consistency checks passed", epoch + 1)
         evaluation.evaluate(
             trainer.params, trainer.eval_state(), test_loader,
             model_name=args.model, compute_dtype=cfg.dtype)

@@ -19,7 +19,15 @@ Two deliberate upgrades over the reference's setup:
   Here the agent polls its children; when one exits non-zero, the rest are
   terminated (SIGTERM, then SIGKILL after a grace period) and the gang is
   either restarted (``--max-restarts N``, elastic-style) or the launcher
-  exits with the failed worker's code.
+  exits with the failed worker's code.  SIGTERM to the launcher itself also
+  tears the gang down (no orphaned workers holding chips).
+
+  Scope: each agent supervises ONLY its own node's workers.  A worker death
+  on another node surfaces there; this node's workers then fail out of the
+  collective via the rendezvous/heartbeat timeout (parallel/init.py's
+  ``--rendezvous-timeout``, vs the reference's infinite hang).  Because
+  restarts are per-node and uncoordinated, ``--max-restarts > 0`` with
+  ``--nnodes > 1`` would produce mixed-generation gangs and is rejected.
 - **TPU process model.** On TPU one *process per host* owns all local chips
   (JAX single-controller-per-host), so ``--nproc-per-node`` defaults to 1 and
   values >1 are for CPU simulation/testing, where each worker is given a
@@ -97,6 +105,11 @@ class LocalAgent:
         monitor_interval_s: float = 0.1,
         log=print,
     ):
+        if max_restarts > 0 and nnodes > 1:
+            raise ValueError(
+                "--max-restarts requires --nnodes 1: restarts are per-node "
+                "and an uncoordinated restart would rejoin a gang whose "
+                "other nodes still run the previous generation")
         self.argv = argv
         self.nnodes = nnodes
         self.node_rank = node_rank
@@ -189,7 +202,9 @@ class LocalAgent:
             self._spawn()
             try:
                 result = self._monitor()
-            except KeyboardInterrupt:
+            except BaseException:
+                # Ctrl-C, SIGTERM (via the main() handler), or any agent
+                # crash: never leave workers orphaned on the chips.
                 self._terminate_all()
                 raise
             result.restarts_used = attempt
@@ -244,6 +259,9 @@ def main(argv: list[str] | None = None) -> int:
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
     )
+    # A scheduler's SIGTERM must tear down the gang, not orphan it; raising
+    # SystemExit routes through run()'s BaseException cleanup.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     result = agent.run()
     if result.returncode != 0:
         print(f"[launch] gang failed: rank {result.failed_rank} exit "

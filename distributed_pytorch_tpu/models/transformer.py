@@ -1,0 +1,197 @@
+"""Decoder-only transformer LM, TPU-native and parallelism-aware.
+
+The reference framework's only model is a CNN (reference model.py); this is
+the model family the TPU build adds for its long-context/distributed
+capabilities.  Same design idiom as models/vgg.py — pure functions over an
+explicit parameter pytree — with a modern decoder stack: RMSNorm -> causal
+self-attention with rotary embeddings -> residual, RMSNorm -> SwiGLU MLP ->
+residual, tied embedding head.
+
+Parallelism is expressed through two optional named-axis hooks, so the same
+code runs single-device, tensor-parallel, sequence-parallel, or both:
+
+- ``tp_axis``: the params passed in are each device's HEAD/FFN shard (heads
+  split over the axis for wq/wk/wv, rows for wo; columns for w_gate/w_up,
+  rows for w_down).  The only communication is one ``psum`` after the
+  attention out-projection and one after the MLP down-projection — the
+  standard Megatron factoring, here compiled by XLA over ICI.
+- ``seq_axis``: activations hold this device's contiguous sequence chunk;
+  attention runs as a ring over the axis (parallel/context.py).  ``pos0``
+  carries the chunk's absolute position offset for rotary embeddings.
+
+Head dim defaults to 128 — one MXU lane tile — and d_ff to 4*d_model.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import attention as attn_ops
+from ..parallel import context as ctx
+
+Array = jax.Array
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 4
+    head_dim: int = 128   # MXU lane tile
+    d_ff: int | None = None  # default 4*d_model
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def ff(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+
+# Named size presets, in the spirit of the reference's cfg dict
+# (reference model.py:3-8 defines VGG11..19 the same way).
+PRESETS = {
+    "LM-tiny": TransformerConfig(vocab_size=1024, d_model=256, n_layers=2,
+                                 n_heads=2),
+    "LM-small": TransformerConfig(d_model=768, n_layers=12, n_heads=6),
+    "LM-base": TransformerConfig(d_model=1024, n_layers=24, n_heads=8),
+}
+
+
+def init(key: Array, cfg: TransformerConfig) -> PyTree:
+    """Build the parameter pytree (same-seed construction on every replica,
+    the reference's init-parity mechanism — SURVEY.md 2.3)."""
+    d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.ff
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in))
+
+    keys = iter(jax.random.split(key, 2 + 7 * cfg.n_layers))
+    params: dict = {
+        "embed": jax.random.normal(next(keys), (cfg.vocab_size, d),
+                                   jnp.float32) * 0.02,
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    for i in range(cfg.n_layers):
+        params[f"layer{i}"] = {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": dense(next(keys), (d, h, dh), d),
+            "wk": dense(next(keys), (d, h, dh), d),
+            "wv": dense(next(keys), (d, h, dh), d),
+            "wo": dense(next(keys), (h, dh, d), h * dh),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w_gate": dense(next(keys), (d, f), d),
+            "w_up": dense(next(keys), (d, f), d),
+            "w_down": dense(next(keys), (f, d), f),
+        }
+    return params
+
+
+def shard_specs(cfg: TransformerConfig, *, tp_axis: str = "model") -> PyTree:
+    """PartitionSpec pytree matching ``init``'s structure: the Megatron
+    sharding (heads/FFN columns over ``tp_axis``), norms/embed replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    specs: dict = {"embed": P(), "final_norm": P()}
+    for i in range(cfg.n_layers):
+        specs[f"layer{i}"] = {
+            "attn_norm": P(),
+            "wq": P(None, tp_axis, None),
+            "wk": P(None, tp_axis, None),
+            "wv": P(None, tp_axis, None),
+            "wo": P(tp_axis, None, None),
+            "mlp_norm": P(),
+            "w_gate": P(None, tp_axis),
+            "w_up": P(None, tp_axis),
+            "w_down": P(tp_axis, None),
+        }
+    return specs
+
+
+def rms_norm(x: Array, scale: Array, eps: float) -> Array:
+    x32 = x.astype(jnp.float32)
+    rms = lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (x32 * rms * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rotary(x: Array, pos: Array, theta: float) -> Array:
+    """Rotary position embedding over (B, H, S, D); ``pos`` is (S,) absolute
+    positions (a sequence-parallel shard passes its global offsets)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # (D/2,)
+    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]      # (S, D/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    out = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def apply(
+    params: PyTree,
+    tokens: Array,
+    *,
+    cfg: TransformerConfig,
+    dtype: jnp.dtype | None = None,
+    attn_impl: str = "flash",      # 'flash' (Pallas) | 'reference' (XLA)
+    seq_axis: str | None = None,   # ring-attention sequence parallelism
+    tp_axis: str | None = None,    # Megatron tensor parallelism
+    pos0: Array | int = 0,         # absolute position of tokens[:, 0]
+) -> Array:
+    """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
+
+    Under ``seq_axis``, ``tokens`` is this device's contiguous chunk and
+    ``pos0`` its global offset; logits come back chunk-sharded the same way.
+    Under ``tp_axis``, the weights are the local head/FFN shards and two
+    psums restore the full residual stream.
+    """
+    x = params["embed"][tokens]  # (B, S, D)
+    if dtype is not None:
+        x = x.astype(dtype)
+    b, s, d = x.shape
+    pos = pos0 + jnp.arange(s)
+
+    for i in range(cfg.n_layers):
+        lp = params[f"layer{i}"]
+        # -- attention block ------------------------------------------------
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
+        q = rotary(q, pos, cfg.rope_theta)
+        k = rotary(k, pos, cfg.rope_theta)
+        if seq_axis is not None:
+            o = ctx.ring_attention(q, k, v, seq_axis, causal=True)
+        elif attn_impl == "flash":
+            o = attn_ops.flash_attention(q, k, v, causal=True)
+        else:
+            o = attn_ops.attention_reference(q, k, v, causal=True)
+        o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+        if tp_axis is not None:
+            o = lax.psum(o, tp_axis)  # Megatron row-parallel reduction 1
+        x = x + o
+        # -- MLP block ------------------------------------------------------
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
+        up = h @ lp["w_up"].astype(h.dtype)
+        down = (gate * up) @ lp["w_down"].astype(h.dtype)
+        if tp_axis is not None:
+            down = lax.psum(down, tp_axis)  # Megatron reduction 2
+        x = x + down
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return logits
+
+
+def param_count(params: PyTree) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

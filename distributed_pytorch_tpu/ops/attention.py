@@ -1,0 +1,389 @@
+"""Attention ops: XLA reference + Pallas TPU flash attention (fwd/bwd).
+
+The reference repo has no attention at all — it is a CNN project (SURVEY.md
+section 5: "no attention, no sequence dimension").  This module is the
+long-context capability the TPU framework adds: the hot op of every
+transformer, built MXU-first:
+
+- ``attention_reference``: plain XLA attention (einsum -> f32 softmax ->
+  einsum).  O(S^2) memory — the oracle the kernel is tested against, and the
+  building block of the pure-JAX ring attention (parallel/context.py).
+- ``flash_attention``: Pallas TPU kernel, online-softmax tiling so the S x S
+  score matrix never materializes in HBM; custom VJP with the standard
+  recompute backward (dQ kernel + dK/dV kernel).  Blocks are MXU-shaped
+  (128 x 128 by default); scores/accumulators are f32, inputs may be bf16.
+
+Shapes follow the (batch, heads, seq, head_dim) convention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+# Defaults from a block-size sweep on v5e at S=2048 (see tests/bench): q
+# blocks 2x and k blocks 4x the 128-wide MXU tile amortize grid overhead.
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference attention (the correctness oracle)
+# ---------------------------------------------------------------------------
+
+def attention_reference(
+    q: Array, k: Array, v: Array, *, causal: bool = False,
+    sm_scale: float | None = None, with_lse: bool = False,
+    bias: Array | None = None,
+):
+    """Plain XLA attention over (B, H, S, D) tensors.
+
+    Scores and softmax in float32 regardless of input dtype.  With
+    ``with_lse`` also returns the row logsumexp (B, H, Sq) — the quantity
+    ring attention needs to merge partial results across sequence chunks.
+    ``bias`` is an additive score bias broadcastable to (B, H, Sq, Sk)
+    (e.g. a NEG_INF mask for cross-chunk causality in ring attention).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kj = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(qi + (sk - sq) >= kj, s, NEG_INF)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    if with_lse:
+        return o, lse
+    return o
+
+
+# ---------------------------------------------------------------------------
+# Flash attention: forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale: float, causal: bool,
+                block_q: int, block_k: int):
+    """Grid (BH, num_q, num_k); the k dimension is innermost/sequential, so
+    the VMEM scratch (acc/m/l) carries the online-softmax state across k
+    blocks of one q block."""
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: the whole k block is masked iff its first key comes after the
+    # last query of this q block — skip the compute (the grid still visits).
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # (block_q, d)
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, NEG_INF)
+        m_prev = m_ref[:, :1]                          # (bq, 1)
+        l_prev = l_ref[:, :1]                          # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)      # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                # (bq, 1)
+        p = jnp.exp(s - m_new)                         # (bq, bk) f32
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, d)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(safe_l[:, 0])      # (bq,)
+        # (8, bq) broadcast: the lse buffer keeps 8 sublanes so its block
+        # satisfies the TPU (8, 128) tile-divisibility rule.
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _vma(*arrays):
+    """Union of the inputs' varying mesh axes: pallas_call outputs must
+    declare their vma explicitly under shard_map(check_vma=True)."""
+    out = frozenset()
+    for a in arrays:
+        out |= jax.typeof(a).vma
+    return out
+
+
+def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    vma = _vma(q, k, v)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Flash attention: backward kernels (recompute p from q,k + saved lse)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   acc_ref, *, sm_scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    """Grid (BH, num_q, num_k), k innermost: accumulate dQ for one q block."""
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])        # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)  # (bq, 1)
+        dp = jax.lax.dot_general(
+            do.astype(v_ref.dtype), v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, sm_scale: float, causal: bool,
+                    block_q: int, block_k: int):
+    """Grid (BH, num_k, num_q), q innermost: accumulate dK/dV for one k block."""
+    j, i = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= kj, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])        # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, d)
+        dp = jax.lax.dot_general(
+            do.astype(v_ref.dtype), v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta) * sm_scale               # (bq, bk)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, grads):
+    q, k, v, o, lse = residuals
+    do = grads
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    vma = _vma(q, k, v, o, do, lse)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public entry point
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: Array, k: Array, v: Array, *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> Array:
+    """Tiled attention over (B, H, S, D); differentiable (custom VJP).
+
+    Sequence lengths must be multiples of the block sizes (the model pads to
+    MXU-friendly lengths; ragged tails belong in the caller's mask).  Off-TPU
+    the kernels run in Pallas interpret mode so CPU tests exercise the exact
+    same code path.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, H, S, D) q, got {q.shape}")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lens ({sq}, {sk}) must divide block sizes "
+            f"({block_q}, {block_k})")
+    if causal and sq != sk:
+        raise ValueError("causal flash attention requires sq == sk")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    o = _flash(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+               v.reshape(b * h, sk, d), sm_scale, causal,
+               block_q, block_k, interpret)
+    return o.reshape(b, h, sq, d)

@@ -1,0 +1,105 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no sequence dimension at all (SURVEY.md section 5: its
+parallelism inventory is data-parallel only); this module is the long-context
+capability the TPU framework adds.  Sequences are sharded over a named mesh
+axis ``seq``; each device holds one contiguous chunk of Q/K/V.  Attention
+over the full sequence is computed in ``n = axis_size(seq)`` ring steps:
+
+  step t: attend my Q chunk against the K/V chunk that started on device
+  ``(my - t) mod n``, then pass my current K/V chunk to the next neighbor
+  with ``lax.ppermute`` (XLA lowers this to ICI neighbor exchange, which
+  overlaps with the attention compute of the current step).
+
+Partial results are merged with the online-softmax rule — each step yields a
+normalized chunk output plus its row logsumexp; two partials combine by
+logaddexp-weighted averaging.  The whole thing is plain differentiable JAX
+(``ppermute``'s transpose is ``ppermute``), so one ``jax.grad`` produces the
+backward ring automatically.
+
+Causality across chunks: with contiguous ("segment") layout, chunk r is
+entirely before chunk m for r < m, so step t attends fully when the source
+chunk is earlier, causally on the diagonal (t == 0), and not at all when the
+source is later.  The not-at-all steps still run (SPMD lockstep) and are
+masked out — the classic ring-attention load imbalance; a striped layout is
+the known fix and a future optimization.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import NEG_INF, attention_reference
+
+Array = jax.Array
+
+
+def _merge(o1: Array, lse1: Array, o2: Array, lse2: Array):
+    """Combine two normalized partial attentions (online-softmax merge).
+
+    ``o_i`` are (B, H, S, D) outputs normalized within their own key chunk;
+    ``lse_i`` are their (B, H, S) logsumexps.  Fully-masked partials carry
+    lse ~= NEG_INF and vanish smoothly (finite large-negative, no NaNs).
+    """
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2 * w2, lse
+
+
+def ring_attention(
+    q: Array, k: Array, v: Array, axis: str, *,
+    causal: bool = True, sm_scale: float | None = None,
+) -> Array:
+    """Attention over a sequence sharded across mesh axis ``axis``.
+
+    Args are this device's chunks, (B, H, S_local, D).  Equivalent (tested)
+    to full attention over the concatenated sequence with chunks laid out
+    contiguously in axis-index order.  Peak score memory per device is
+    O(S_local^2) per ring step — the blockwise-attention memory saving that
+    makes million-token sequences feasible.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    sq = q.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # pass k/v to the right
+
+    def step(carry, t):
+        k_t, v_t, acc, lse_acc = carry
+        src = (me - t) % n  # the chunk now in hand started on device src
+        # Additive bias selecting the causal relation of (my chunk, src):
+        #   src == me (t == 0): causal triangle;  src < me: full;  else: none.
+        tri = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+            >= jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1),
+            0.0, NEG_INF)
+        if causal:
+            bias = jnp.where(
+                src == me, tri,
+                jnp.where(src < me, 0.0, NEG_INF))
+        else:
+            bias = jnp.zeros((sq, sq))
+        o_t, lse_t = attention_reference(
+            q, k_t, v_t, sm_scale=sm_scale, with_lse=True,
+            bias=bias[None, None])
+        acc, lse_acc = _merge(acc, lse_acc, o_t.astype(jnp.float32), lse_t)
+        # Rotate K/V around the ring (skipped after the last step's compute
+        # would be wasted; one extra hop keeps the scan body uniform).
+        k_t = lax.ppermute(k_t, axis, perm)
+        v_t = lax.ppermute(v_t, axis, perm)
+        return (k_t, v_t, acc, lse_acc), None
+
+    # Accumulator inits derive from q (0*q) so they inherit q's full set of
+    # varying mesh axes — a fresh constant would be axis-invariant and the
+    # scan carry type check would reject the merge with varying partials.
+    acc0 = q.astype(jnp.float32) * 0.0
+    lse0 = jnp.sum(acc0, axis=-1) + NEG_INF
+    (_, _, acc, _), _ = lax.scan(step, (k, v, acc0, lse0), jnp.arange(n))
+    return acc.astype(q.dtype)

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from .data import augment as aug
@@ -37,6 +37,7 @@ from .models import vgg
 from .ops import nn as ops
 from .parallel import strategies as strat
 from .parallel.mesh import DATA_AXIS, data_sharding, make_mesh, replicated
+from .utils import debug as dbg, tracing
 from .utils.metrics import IterTimeMeter, LossMeter
 
 PyTree = Any
@@ -52,6 +53,7 @@ class TrainConfig:
     weight_decay: float = 1e-4    # main.py:104
     batch_size: int = 256         # per replica (main.py:18)
     strategy: str = "ddp"
+    steps_per_loop: int = 1       # K optimizer steps per device dispatch
     sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
     compute_dtype: str | None = None  # e.g. "bfloat16" for MXU-friendly compute
     augment: bool = True
@@ -60,6 +62,17 @@ class TrainConfig:
     @property
     def dtype(self):
         return jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+
+
+def _as_varying(tree: PyTree, axis: str) -> PyTree:
+    """Pcast leaves to device-varying over ``axis``; leaves that are already
+    varying (e.g. a scan carry whose vma was unified with varying neighbors)
+    pass through unchanged."""
+    def cast(x):
+        if axis in jax.typeof(x).vma:
+            return x
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.tree.map(cast, tree)
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -86,63 +99,100 @@ def _loss_fn(params, state, key, images, labels, *, cfg: TrainConfig,
 
 def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
                     mesh: Mesh | None):
-    """Build the compiled train step.
+    """Build the compiled single train step — ``make_multi_step`` with K=1
+    (one implementation of the optimizer-step semantics, not two).
 
-    Signature: ``step(params, state, opt_state, key, images, labels) ->
-    (params, state, opt_state, loss)``.  Under a mesh, ``state`` leaves carry
-    a leading device axis (per-replica BN stats) and ``loss`` is the
-    cross-replica mean of the per-shard losses.
+    Signature: ``step(params, state, opt_state, key, step0, images, labels)
+    -> (params, state, opt_state, loss)``; the per-step RNG is
+    ``fold_in(key, step0)``.  Under a mesh, ``state`` leaves carry a leading
+    device axis (per-replica BN stats) and ``loss`` is the cross-replica
+    mean of the per-shard losses.
 
     The three training-state arguments are DONATED: the step updates them in
     place on device and the caller must use the returned pytrees (passing a
     consumed buffer again raises "Array has been deleted").
+    """
+    multi = make_multi_step(cfg, strategy, mesh)
+
+    def step(params, state, opt_state, key, step0, images, labels):
+        params, state, opt_state, losses = multi(
+            params, state, opt_state, key, step0,
+            images[None], labels[None])
+        return params, state, opt_state, losses[0]
+
+    return step
+
+
+def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
+                    mesh: Mesh | None):
+    """Build a compiled K-step training loop (``lax.scan`` over stacked
+    batches): ONE dispatch executes K optimizer steps on device.
+
+    Signature: ``fn(params, state, opt_state, key, step0, images, labels) ->
+    (params, state, opt_state, losses)`` with ``images``/``labels`` carrying
+    a leading scan axis of length K and ``losses`` shape (K,).
+
+    This is the TPU-native answer to per-step dispatch overhead: the
+    reference's hot loop makes one eager dispatch per op (SURVEY.md 3.1);
+    the single-step path here makes one per step; this makes one per K
+    steps, which matters when the host link has real latency (tunneled or
+    multi-host setups).  RNG per step is ``fold_in(key, step0 + i)`` —
+    identical to the single-step path's stream, so loss curves match
+    exactly regardless of steps_per_loop.
     """
     tx = make_optimizer(cfg)
     bn_axis = DATA_AXIS if (cfg.sync_bn and mesh is not None) else None
     grad_fn = jax.value_and_grad(
         partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
 
+    def scan_steps(params, state, opt_state, key, step0, images, labels,
+                   *, axis: str | None):
+        def body(carry, batch):
+            params, state, opt_state, step = carry
+            imgs, lbls = batch
+            k = jax.random.fold_in(key, step)
+            if axis is not None:
+                k = jax.random.fold_in(k, jax.lax.axis_index(axis))
+                # Per-shard grads via a device-varying view (see
+                # make_train_step); the strategy's collective then restores
+                # cross-replica invariance before the optimizer update.
+                local_params = _as_varying(params, axis)
+            else:
+                local_params = params
+            (loss, state), grads = grad_fn(local_params, state, k, imgs, lbls)
+            grads = strategy(grads, axis)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, state, opt_state, step + 1), loss
+
+        (params, state, opt_state, _), losses = jax.lax.scan(
+            body, (params, state, opt_state, step0), (images, labels))
+        return params, state, opt_state, losses
+
     if mesh is None:
         if strategy.needs_mesh:
             raise ValueError(f"strategy {strategy.name!r} requires a mesh")
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step(params, state, opt_state, key, images, labels):
-            (loss, new_state), grads = grad_fn(params, state, key, images, labels)
-            grads = strategy(grads, None)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, new_state, opt_state, loss
+        def multi_step(params, state, opt_state, key, step0, images, labels):
+            return scan_steps(params, state, opt_state, key, step0,
+                              images, labels, axis=None)
 
-        return step
+        return multi_step
 
-    def shard_step(params, state, opt_state, key, images, labels):
-        # state arrives as this replica's (1, ...) slice of the stacked
-        # per-device BN stats; drop/restore the leading axis around compute.
+    def shard_multi_step(params, state, opt_state, key, step0, images, labels):
         local_state = jax.tree.map(lambda s: s[0], state)
-        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
-        # Differentiate w.r.t. a *device-local* (varying) view of the params
-        # so each replica's grads are its own shard's grads (otherwise the
-        # new shard_map autodiff inserts an implicit psum for replicated
-        # inputs and the strategy's collective would double-reduce).  The
-        # strategy below is then the one and only cross-replica reduction —
-        # exactly the reference's structure (sync between backward and step).
-        local_params = jax.lax.pcast(params, DATA_AXIS, to="varying")
-        (loss, new_state), grads = grad_fn(
-            local_params, local_state, key, images, labels)
-        grads = strategy(grads, DATA_AXIS)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params, new_state, opt_state, losses = scan_steps(
+            params, local_state, opt_state, key, step0, images, labels,
+            axis=DATA_AXIS)
         new_state = jax.tree.map(lambda s: s[None], new_state)
-        return params, new_state, opt_state, jax.lax.pmean(loss, DATA_AXIS)
+        return params, new_state, opt_state, jax.lax.pmean(losses, DATA_AXIS)
 
-    # donate_argnums: params/BN-state/opt-state are consumed and re-emitted
-    # every step — donation lets XLA update them in place (no HBM copy of the
-    # ~36.9 MB params + ~36.9 MB momentum buffers per step).
     return jax.jit(shard_map(
-        shard_step,
+        shard_multi_step,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(), P(DATA_AXIS), P(), P(), P(),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(), P()),
     ), donate_argnums=(0, 1, 2))
 
@@ -195,33 +245,76 @@ class Trainer:
             state = jax.device_put(
                 replicate_state(state, self.n_replicas), shd)
         self.params, self.state, self.opt_state = params, state, opt_state
-        self.step_fn = make_train_step(cfg, self.strategy, self.mesh)
+        self._multi_fn = None   # jitted K-step program, built lazily
+        self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
 
     # -- one optimizer step over a *global* batch -------------------------
     def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
-        key = jax.random.fold_in(self.data_key, self._step)
-        if self.mesh is not None:
-            shd = data_sharding(self.mesh)
-            if jax.process_count() > 1:
-                # Multi-host: each process contributes its local ranks' shard
-                # of the global batch (the per-host DistributedSampler split,
-                # reference main_all_reduce.py:112); assemble a global array.
-                images = jax.make_array_from_process_local_data(shd, images)
-                labels = jax.make_array_from_process_local_data(shd, labels)
-            else:
-                if len(images) % self.n_replicas != 0:
-                    raise ValueError(
-                        f"global batch {len(images)} not divisible by the "
-                        f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; "
-                        f"pass per-replica batches of equal size (the sampler "
-                        f"pads the epoch for exactly this reason)")
-                images = jax.device_put(images, shd)
-                labels = jax.device_put(labels, shd)
-        self.params, self.state, self.opt_state, loss = self.step_fn(
-            self.params, self.state, self.opt_state, key, images, labels)
-        self._step += 1
-        return loss
+        """One step == ``train_steps`` with K=1 (same compiled path, same
+        RNG stream: per-step key is fold_in(data_key, step))."""
+        return self.train_steps(images[None], labels[None])[0]
+
+    # -- K optimizer steps in one device dispatch -------------------------
+    def _stage(self, images, labels):
+        """Place stacked (K, global_batch, ...) arrays onto the mesh."""
+        if self.mesh is None:
+            return images, labels
+        shd = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        if jax.process_count() > 1:
+            # Multi-host: each process contributes its local ranks' shard
+            # of the global batch (the per-host DistributedSampler split,
+            # reference main_all_reduce.py:112); assemble a global array.
+            return (jax.make_array_from_process_local_data(shd, images),
+                    jax.make_array_from_process_local_data(shd, labels))
+        if images.shape[1] % self.n_replicas != 0:
+            raise ValueError(
+                f"global batch {images.shape[1]} not divisible by the "
+                f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; "
+                f"pass per-replica batches of equal size (the sampler "
+                f"pads the epoch for exactly this reason)")
+        return jax.device_put(images, shd), jax.device_put(labels, shd)
+
+    def _executable(self, args):
+        """AOT-compile the K-step program for these batch shapes (cached).
+
+        ``lower().compile()`` builds the executable without running it, so
+        callers (train_epoch) can keep compile time out of timed windows —
+        the reference's iter-0 exclusion contract (main.py:43-48) would
+        otherwise be diluted to 1/K by the scan."""
+        key = (args[-2].shape, args[-1].shape)
+        exe = self._compiled.get(key)
+        if exe is None:
+            if self._multi_fn is None:
+                self._multi_fn = make_multi_step(self.cfg, self.strategy,
+                                                 self.mesh)
+            exe = self._multi_fn.lower(*args).compile()
+            self._compiled[key] = exe
+        return exe
+
+    def _args(self, images, labels):
+        step0 = jnp.asarray(self._step, jnp.int32)
+        return (self.params, self.state, self.opt_state, self.data_key,
+                step0, images, labels)
+
+    def precompile_steps(self, images: np.ndarray, labels: np.ndarray) -> None:
+        """Ensure the program for these (K, batch, ...) shapes is compiled
+        WITHOUT executing a step (no state is consumed)."""
+        images, labels = self._stage(images, labels)
+        self._executable(self._args(images, labels))
+
+    def train_steps(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
+        """Run ``K = images.shape[0]`` steps over stacked global batches
+        (K, global_batch, ...) as one compiled ``lax.scan``; returns the K
+        per-step losses.  Produces the identical parameter/RNG trajectory as
+        K ``train_step`` calls — just one dispatch instead of K."""
+        k = images.shape[0]
+        images, labels = self._stage(images, labels)
+        args = self._args(images, labels)
+        self.params, self.state, self.opt_state, losses = (
+            self._executable(args)(*args))
+        self._step += k
+        return losses
 
     def train_epoch(self, loaders, epoch: int, *, log=print):
         """One epoch over per-replica loaders, with the reference's metric
@@ -240,14 +333,8 @@ class Trainer:
         for dl in loaders:
             dl.set_epoch(epoch)
         loss_meter, time_meter = LossMeter(), IterTimeMeter()
-        loss = None
-        for batch_idx, batches in enumerate(zip(*loaders)):
-            begin = time.perf_counter()
-            images = np.concatenate([b[0] for b in batches])
-            labels = np.concatenate([b[1] for b in batches])
-            loss = self.train_step(images, labels)
-            loss_val = float(loss)  # sync point, like loss.item() (main.py:37)
-            elapsed = time.perf_counter() - begin
+
+        def record(batch_idx, loss_val, elapsed):
             rec = loss_meter.update(batch_idx, loss_val)
             if rec and log:
                 log(f"Epoch: {epoch + 1}, Iteration: {rec.first_iter}-"
@@ -256,7 +343,51 @@ class Trainer:
             if rec and log:
                 log(f"Avg Time for iteration {rec.first_iter}-{rec.last_iter}: "
                     f"{rec.value} seconds.")
+
+        spl = max(1, self.cfg.steps_per_loop)
+        chunk: list[tuple[np.ndarray, np.ndarray]] = []
+        batch_idx = 0
+
+        def flush():
+            nonlocal batch_idx
+            if not chunk:
+                return
+            images = np.stack([c[0] for c in chunk])
+            labels = np.stack([c[1] for c in chunk])
+            # Compile outside the timed window: the reference's metric
+            # excludes warm-up (iter 0, main.py:43-48); with a K-step scan
+            # the compile would otherwise smear across K counted iters.
+            self.precompile_steps(images, labels)
+            begin = time.perf_counter()
+            with tracing.annotate_step(self._step):
+                losses = np.asarray(self.train_steps(images, labels))
+            per_step = (time.perf_counter() - begin) / len(chunk)
+            for loss_val in losses:
+                record(batch_idx, float(loss_val), per_step)
+                batch_idx += 1
+            chunk.clear()
+
+        for batches in zip(*loaders):
+            batch = (np.concatenate([b[0] for b in batches]),
+                     np.concatenate([b[1] for b in batches]))
+            if chunk and batch[0].shape != chunk[0][0].shape:
+                flush()  # ragged final batch can't stack with full ones
+            chunk.append(batch)
+            if len(chunk) == spl:
+                flush()
+        flush()  # ragged tail: one smaller scan (compiled once per tail size)
         return loss_meter, time_meter
 
     def eval_state(self) -> PyTree:
         return rank0_state(self.state, self.mesh)
+
+    def check_consistency(self) -> None:
+        """Verify the DP invariants (utils/debug.py): params and optimizer
+        state bitwise-identical on every replica, and finite.  The check the
+        reference never does — torch DDP enforces it once by broadcast; the
+        manual variants just trust same-seed init + sync (SURVEY.md 2.3)."""
+        dbg.assert_replicas_in_sync(
+            {"params": self.params, "opt_state": self.opt_state},
+            what="params/opt_state")
+        dbg.assert_finite(jax.tree.map(np.asarray, self.params),
+                          what="params")

@@ -1,0 +1,114 @@
+"""Training-consistency checkers: the race/desync detection the reference lacks.
+
+The reference has no sanitizers at all (SURVEY.md section 5): its collectives
+are synchronous so ordering races are avoided by construction, but nothing
+ever *verifies* the data-parallel invariants — and its manual variants do
+silently violate one (per-rank BatchNorm stats drift, SURVEY.md 2.3).  On TPU
+the failure modes shift (non-deterministic reduction orders, desynced
+replicated state after a bad host-side update, NaN-poisoned grads); this
+module makes them checkable:
+
+- ``replica_desync(tree)``: bitwise-compare every device copy of replicated
+  arrays — the DP invariant torch DDP enforces by broadcast; a mismatch means
+  a desync bug (or a non-replicated sharding sneaking into training state);
+- ``check_determinism(fn, *args)``: run a compiled step twice from identical
+  inputs and compare results bitwise — catches nondeterministic kernels or
+  host-side state leaking into a supposedly pure step;
+- ``assert_finite(tree)``: NaN/Inf scan over a pytree (grad/param health).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+class ConsistencyError(AssertionError):
+    """A data-parallel training invariant was violated."""
+
+
+def _leaf_paths(tree: PyTree):
+    leaves, _ = jax.tree.flatten_with_path(tree)
+    for path, leaf in leaves:
+        yield jax.tree_util.keystr(path), leaf
+
+
+def replica_desync(tree: PyTree, *, atol: float = 0.0) -> list[str]:
+    """Paths of replicated leaves whose per-device copies disagree.
+
+    Replicated training state (params, optimizer state) must be identical on
+    every device — the invariant the reference maintains by same-seed
+    construction plus grad sync (SURVEY.md 2.3) and torch DDP by broadcast.
+    Leaves that are genuinely sharded (no device holds the full value) are
+    skipped; only the replicated ones are comparable.
+    """
+    bad = []
+    for path, leaf in _leaf_paths(tree):
+        if not isinstance(leaf, jax.Array) or not hasattr(leaf, "sharding"):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) < 2:
+            continue
+        if shards[0].data.shape != leaf.shape:
+            continue  # sharded, not replicated: nothing to cross-check
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            other = np.asarray(s.data)
+            if atol == 0.0:
+                ok = np.array_equal(ref, other, equal_nan=True)
+            else:
+                ok = np.allclose(ref, other, atol=atol, rtol=0.0,
+                                 equal_nan=True)
+            if not ok:
+                bad.append(path)
+                break
+    return bad
+
+
+def assert_replicas_in_sync(tree: PyTree, *, atol: float = 0.0,
+                            what: str = "training state") -> None:
+    bad = replica_desync(tree, atol=atol)
+    if bad:
+        raise ConsistencyError(
+            f"{what} desynced across replicas at {len(bad)} leaves: "
+            f"{bad[:5]}{'...' if len(bad) > 5 else ''}")
+
+
+def check_determinism(fn: Callable[..., PyTree], *args,
+                      runs: int = 2) -> None:
+    """Run ``fn(*args)`` ``runs`` times and require bitwise-identical outputs.
+
+    ``fn`` must be pure (a compiled step re-invoked on the SAME inputs —
+    donation must be off, or pass fresh copies).  Catches nondeterministic
+    reductions and host-side state leaking into the step.
+    """
+    outs = [jax.tree.map(np.asarray, fn(*args)) for _ in range(runs)]
+    ref = outs[0]
+    for i, out in enumerate(outs[1:], start=2):
+        mism = []
+        for (path, a), (_, b) in zip(_leaf_paths(ref), _leaf_paths(out)):
+            if not np.array_equal(np.asarray(a), np.asarray(b),
+                                  equal_nan=True):
+                mism.append(path)
+        if mism:
+            raise ConsistencyError(
+                f"run {i} differs from run 1 at {len(mism)} leaves: "
+                f"{mism[:5]}{'...' if len(mism) > 5 else ''}")
+
+
+def assert_finite(tree: PyTree, *, what: str = "pytree") -> None:
+    """Raise if any leaf contains NaN/Inf (grad/param health check)."""
+    bad = []
+    for path, leaf in _leaf_paths(tree):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(
+                arr).all():
+            bad.append(path)
+    if bad:
+        raise ConsistencyError(
+            f"{what} has non-finite values at {len(bad)} leaves: "
+            f"{bad[:5]}{'...' if len(bad) > 5 else ''}")

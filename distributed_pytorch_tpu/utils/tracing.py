@@ -1,0 +1,71 @@
+"""Profiling/tracing: the subsystem the reference hand-rolls with datetime.
+
+The reference's only tracing is ``datetime.now()`` deltas per iteration
+(reference main.py:28-48, SURVEY.md section 5).  That metric survives in
+utils/metrics.py; this module adds what a real framework provides on top:
+
+- ``trace(dir)``: capture an XLA/TPU profile (TensorBoard-loadable) around
+  any region — per-op device timelines, HLO, memory viewer;
+- ``annotate_step(n)``: mark one training step in the trace so device time
+  groups by step (the profiler's step-boundary convention);
+- ``StepTimer``: cheap wall-clock step timing with percentile summary, for
+  when a full profile is overkill.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device profile into ``log_dir`` for the enclosed region."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_step(step: int):
+    """Context manager marking one train step in an active trace."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock step timer with summary stats (excludes warm-up steps,
+    like the reference's iter-0 exclusion at main.py:43-48)."""
+
+    skip_first: int = 1
+    _times: list[float] = field(default_factory=list)
+    _seen: int = 0
+    _t0: float | None = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._seen += 1
+        if self._seen > self.skip_first:
+            self._times.append(dt)
+        return False
+
+    def summary(self) -> dict[str, float]:
+        if not self._times:
+            return {}
+        ts = sorted(self._times)
+        n = len(ts)
+        return {
+            "steps": n,
+            "mean_s": sum(ts) / n,
+            "p50_s": ts[n // 2],
+            "p90_s": ts[min(n - 1, int(n * 0.9))],
+            "max_s": ts[-1],
+        }

@@ -1,0 +1,108 @@
+"""Consistency-checker tests (utils/debug.py, utils/tracing.py).
+
+These pin the DP invariants the checkers enforce: replicated state must be
+bitwise-identical across devices (what torch DDP guarantees by broadcast and
+the reference by same-seed init + sync — SURVEY.md 2.3), compiled steps must
+be deterministic, and desync/NaN states must be *detected*, not just avoided.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.train import TrainConfig, Trainer
+from distributed_pytorch_tpu.utils import debug as dbg
+from distributed_pytorch_tpu.utils.tracing import StepTimer, trace
+
+
+def _replicated(mesh, value: np.ndarray) -> jax.Array:
+    return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+def _desynced(mesh, value: np.ndarray) -> jax.Array:
+    """A 'replicated'-sharded array whose device copies actually differ —
+    the bug state replica_desync exists to catch."""
+    sharding = NamedSharding(mesh, P())
+    bufs = []
+    for i, d in enumerate(mesh.devices.flat):
+        v = value.copy()
+        if i == len(mesh.devices.flat) - 1:
+            v[0] += 1.0  # one replica drifted
+        bufs.append(jax.device_put(v, d))
+    return jax.make_array_from_single_device_arrays(
+        value.shape, sharding, bufs)
+
+
+def test_replica_desync_clean_and_dirty():
+    mesh = make_mesh(4)
+    good = _replicated(mesh, np.ones((8,), np.float32))
+    bad = _desynced(mesh, np.ones((8,), np.float32))
+    assert dbg.replica_desync({"w": good}) == []
+    assert dbg.replica_desync({"w": good, "v": bad}) == ["['v']"]
+    with pytest.raises(dbg.ConsistencyError, match="desynced"):
+        dbg.assert_replicas_in_sync({"v": bad})
+
+
+def test_replica_desync_skips_sharded_leaves():
+    mesh = make_mesh(4)
+    sharded = jax.device_put(np.arange(16, dtype=np.float32),
+                             NamedSharding(mesh, P("data")))
+    assert dbg.replica_desync({"x": sharded}) == []
+
+
+def test_trainer_consistency_after_steps():
+    mesh = make_mesh(4)
+    t = Trainer(TrainConfig(strategy="ddp", batch_size=4), mesh=mesh)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    for _ in range(2):
+        t.train_step(imgs, labels)
+    t.check_consistency()  # replicated state stayed in sync through sync'd grads
+
+
+def test_check_determinism_passes_for_pure_fn():
+    @jax.jit
+    def f(x):
+        return {"y": x * 2.0, "z": jnp.sum(x)}
+
+    dbg.check_determinism(f, jnp.arange(8.0))
+
+
+def test_check_determinism_catches_impure_fn():
+    state = {"n": 0}
+
+    def impure(x):
+        state["n"] += 1
+        return x + state["n"]
+
+    with pytest.raises(dbg.ConsistencyError, match="differs"):
+        dbg.check_determinism(impure, jnp.zeros((4,)))
+
+
+def test_assert_finite():
+    dbg.assert_finite({"a": np.ones(3), "b": jnp.zeros(2)})
+    with pytest.raises(dbg.ConsistencyError, match="non-finite"):
+        dbg.assert_finite({"a": np.array([1.0, np.nan])})
+    # integer leaves are ignored (no NaN concept)
+    dbg.assert_finite({"i": np.array([1, 2, 3])})
+
+
+def test_step_timer_skips_warmup():
+    timer = StepTimer(skip_first=1)
+    for _ in range(5):
+        with timer:
+            pass
+    s = timer.summary()
+    assert s["steps"] == 4
+    assert s["mean_s"] >= 0.0 and s["p50_s"] <= s["max_s"]
+
+
+def test_trace_writes_profile(tmp_path):
+    with trace(str(tmp_path)):
+        jnp.sum(jnp.arange(16.0)).block_until_ready()
+    produced = list(tmp_path.rglob("*"))
+    assert produced, "profiler wrote nothing"

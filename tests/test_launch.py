@@ -103,3 +103,51 @@ def test_parser_matches_torchrun_flags():
     assert args.master_addr == "172.18.0.2"
     assert args.cmd[0] == "--"
     assert "-m" in args.cmd
+
+
+def test_multinode_restarts_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="nnodes 1"):
+        LocalAgent(["x.py"], nnodes=2, max_restarts=1, log=_quiet)
+
+
+def test_sigterm_to_launcher_tears_down_gang(tmp_path):
+    """SIGTERM to the launcher must kill the workers (no orphans on chips)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    pids = tmp_path / "pids"
+    pids.mkdir()
+    worker = (
+        "import os, pathlib, time; "
+        f"pathlib.Path(r'{pids}', os.environ['RANK']).write_text("
+        "str(os.getpid())); time.sleep(60)"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+         "--nproc-per-node", "2", "--monitor-interval", "0.05", "--",
+         "-c", worker],
+        cwd="/root/repo")
+    deadline = time.monotonic() + 30
+    while len(list(pids.iterdir())) < 2:
+        assert time.monotonic() < deadline, "workers never started"
+        time.sleep(0.05)
+    worker_pids = [int(p.read_text()) for p in pids.iterdir()]
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=30) == 143
+    # ESRCH for both workers == no orphans
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        alive = []
+        for pid in worker_pids:
+            try:
+                os.kill(pid, 0)
+                alive.append(pid)
+            except ProcessLookupError:
+                pass
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert not alive, f"orphaned workers: {alive}"

@@ -163,3 +163,65 @@ class TestEvaluate:
         assert st["bn0"]["mean"].shape == (8,)  # leading device axis removed
         np.testing.assert_array_equal(
             st["bn0"]["mean"], np.asarray(tr.state["bn0"]["mean"])[0])
+
+
+def test_train_steps_scan_matches_single_steps():
+    """K scanned steps (one dispatch) must reproduce K single-step calls
+    exactly: same params, same losses (same RNG stream by construction)."""
+    import numpy as np
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+    rng = np.random.default_rng(3)
+    k, gb = 3, 8
+    images = rng.integers(0, 256, (k, gb, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (k, gb)).astype(np.int32)
+
+    for strategy, mesh in (("none", None), ("ddp", make_mesh(4))):
+        # small lr: keeps the trajectory numerically tame so scan-vs-unrolled
+        # fusion differences stay at float32 noise level
+        cfg = TrainConfig(strategy=strategy, batch_size=gb, lr=1e-3)
+        a = Trainer(cfg, mesh=mesh)
+        single_losses = [float(a.train_step(images[i], labels[i]))
+                         for i in range(k)]
+        b = Trainer(cfg, mesh=mesh)
+        scan_losses = np.asarray(b.train_steps(images, labels))
+        # same RNG stream/trajectory; tolerances absorb scan-vs-unrolled
+        # compilation differences (different fusion, same math)
+        np.testing.assert_allclose(scan_losses, single_losses,
+                                   rtol=2e-4, atol=1e-5)
+        for pa, pb in zip(jax.tree.leaves(a.params),
+                          jax.tree.leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                       rtol=2e-3, atol=2e-4)
+        assert b._step == k
+
+
+def test_train_epoch_steps_per_loop_matches():
+    """train_epoch with steps_per_loop>1 (incl. ragged tail) reproduces the
+    per-step path's loss window values."""
+    import numpy as np
+    from distributed_pytorch_tpu.data import DataLoader
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+    class _Synth:
+        def __init__(self, n):
+            rng = np.random.default_rng(0)
+            self.images = rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8)
+            self.labels = rng.integers(0, 10, n).astype(np.int32)
+        def __len__(self):
+            return len(self.images)
+
+    ds = _Synth(40)  # 5 batches of 8 -> chunks of 2 + ragged tail of 1
+    params = {}
+    for spl in (1, 2):
+        cfg = TrainConfig(strategy="none", batch_size=8, steps_per_loop=spl,
+                          lr=1e-3, augment=False)
+        tr = Trainer(cfg)
+        loader = DataLoader(ds, 8, shuffle=True, seed=0)
+        tr.train_epoch([loader], 0, log=None)
+        assert tr._step == 5
+        params[spl] = tr.params
+    for pa, pb in zip(jax.tree.leaves(params[1]), jax.tree.leaves(params[2])):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-3, atol=2e-4)
