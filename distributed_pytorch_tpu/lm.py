@@ -34,12 +34,13 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .models import transformer as tfm
+from .ops.nn import IGNORE_INDEX, masked_ce
 from .parallel.mesh import make_mesh
 
 PyTree = Any
 
-DATA, SEQ, MODEL = "data", "seq", "model"
-IGNORE = -1  # target id excluded from the loss (padding)
+DATA, SEQ, MODEL, PIPE = "data", "seq", "model", "pipe"
+IGNORE = IGNORE_INDEX  # target id excluded from the loss (padding)
 
 
 @dataclass
@@ -51,15 +52,27 @@ class LMTrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
+    aux_coef: float = 0.01  # MoE load-balance loss weight (Switch default)
     compute_dtype: str | None = "bfloat16"
     seed: int = 1
-    # parallel degrees; dp * sp * tp must equal the mesh size
+    # parallel degrees; dp * sp * tp (or dp * pp) must equal the mesh size
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1          # pipeline stages (GPipe); requires sp == tp == 1
+    microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
 
 
 def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
+    if cfg.pp > 1:
+        if cfg.sp != 1 or cfg.tp != 1:
+            raise ValueError("pp composes with dp only (sp == tp == 1)")
+        if cfg.model.n_experts:
+            raise ValueError(
+                "pp does not support MoE models (n_experts > 0): expert "
+                "layers cannot stack into homogeneous pipeline stages")
+        return make_mesh(cfg.dp * cfg.pp, axis_names=(DATA, PIPE),
+                         axis_shape=(cfg.dp, cfg.pp), devices=devices)
     return make_mesh(cfg.dp * cfg.sp * cfg.tp,
                      axis_names=(DATA, SEQ, MODEL),
                      axis_shape=(cfg.dp, cfg.sp, cfg.tp),
@@ -72,17 +85,6 @@ def make_optimizer(cfg: LMTrainConfig) -> optax.GradientTransformation:
         optax.adamw(cfg.lr, b1=cfg.b1, b2=cfg.b2,
                     weight_decay=cfg.weight_decay),
     )
-
-
-def masked_ce(logits: jax.Array, targets: jax.Array):
-    """(sum of CE over non-ignored tokens, count) — caller reduces/divides."""
-    logits = logits.astype(jnp.float32)
-    mask = targets != IGNORE
-    safe = jnp.where(mask, targets, 0)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    true_logit = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-    ce = jnp.where(mask, logz - true_logit, 0.0)
-    return jnp.sum(ce), jnp.sum(mask)
 
 
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
@@ -101,14 +103,16 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     def local_loss(params, tokens, targets):
         s_local = tokens.shape[1]
         pos0 = jax.lax.axis_index(SEQ) * s_local
-        logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
-                           seq_axis=seq_axis, tp_axis=tp_axis, pos0=pos0)
+        logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
+                                seq_axis=seq_axis, tp_axis=tp_axis, pos0=pos0,
+                                return_aux=True)
         ce_sum, n = masked_ce(logits, targets)
         # Global mean over every shard's tokens (loss is axis-invariant;
         # 'model' shards compute identical values, no reduction needed there).
         ce_sum = jax.lax.psum(ce_sum, (DATA, SEQ))
         n = jax.lax.psum(n, (DATA, SEQ))
-        return ce_sum / jnp.maximum(n, 1)
+        aux = jax.lax.pmean(aux, (DATA, SEQ))  # already pmean'd over MODEL
+        return ce_sum / jnp.maximum(n, 1) + cfg.aux_coef * aux
 
     grad_step = shard_map(
         jax.value_and_grad(local_loss),
@@ -129,30 +133,93 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     return step
 
 
+def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Pipeline-parallel step over Mesh((data, pipe)): tokens/targets arrive
+    (global_batch, S); each data-rank cuts its local batch into microbatches
+    and drives the GPipe schedule (parallel/pipeline.py)."""
+    from .parallel import pipeline as pp
+
+    tx = make_optimizer(cfg)
+    dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+    n_micro = cfg.microbatches or 2 * cfg.pp
+
+    def local_loss(stage_params, shared, tokens, targets):
+        b_local = tokens.shape[0]
+        if b_local % n_micro:
+            raise ValueError(
+                f"local batch {b_local} not divisible into {n_micro} "
+                f"microbatches")
+        mb = b_local // n_micro
+        tokens = tokens.reshape(n_micro, mb, -1)
+        targets = targets.reshape(n_micro, mb, -1)
+        ce_sum, n = pp.pipeline_loss(stage_params, shared, tokens, targets,
+                                     cfg=cfg.model, axis=PIPE, dtype=dtype)
+        ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE))
+        n = jax.lax.psum(n, (DATA, PIPE))
+        return ce_sum / jnp.maximum(n, 1)
+
+    stage_specs = pp.stage_specs(cfg.model, cfg.pp)
+    shared_specs = {"embed": P(), "final_norm": P()}
+
+    grad_step = shard_map(
+        jax.value_and_grad(local_loss, argnums=(0, 1)),
+        mesh=mesh,
+        in_specs=(stage_specs, shared_specs, P(DATA), P(DATA)),
+        out_specs=(P(), (stage_specs, shared_specs)),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = grad_step(params["stages"], params["shared"],
+                                tokens, targets)
+        grads = {"stages": grads[0], "shared": grads[1]}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
 class LMTrainer:
-    """Owns (params, opt_state) laid out over the (data, seq, model) mesh."""
+    """Owns (params, opt_state) laid out over the (data, seq, model) mesh —
+    or the (data, pipe) mesh when cfg.pp > 1."""
 
     def __init__(self, cfg: LMTrainConfig, mesh: Mesh | None = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_lm_mesh(cfg)
-        assert self.mesh.devices.size == cfg.dp * cfg.sp * cfg.tp, (
-            f"mesh has {self.mesh.devices.size} devices, config wants "
-            f"dp*sp*tp = {cfg.dp * cfg.sp * cfg.tp}")
+        want = cfg.dp * (cfg.pp if cfg.pp > 1 else cfg.sp * cfg.tp)
+        assert self.mesh.devices.size == want, (
+            f"mesh has {self.mesh.devices.size} devices, config wants {want}")
 
         params = tfm.init(jax.random.key(cfg.seed), cfg.model)
-        specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            params, specs)
         tx = make_optimizer(cfg)
+        if cfg.pp > 1:
+            from .parallel import pipeline as pp
+            stages, shared = pp.split_layer_params(params, cfg.model, cfg.pp)
+            stage_specs = pp.stage_specs(cfg.model, cfg.pp)
+            params = {
+                "stages": jax.tree.map(
+                    lambda x, s: jax.device_put(
+                        x, NamedSharding(self.mesh, s)),
+                    stages, stage_specs),
+                "shared": jax.device_put(
+                    shared, NamedSharding(self.mesh, P())),
+            }
+            self.step_fn = make_lm_pp_train_step(cfg, self.mesh)
+        else:
+            specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                params, specs)
+            self.step_fn = make_lm_train_step(cfg, self.mesh)
         # zeros_like/elementwise init inherits each param's sharding
         self.opt_state = jax.jit(tx.init)(params)
         self.params = params
-        self.step_fn = make_lm_train_step(cfg, self.mesh)
         self._step = 0
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
-        shd = NamedSharding(self.mesh, P(DATA, SEQ))
+        spec = P(DATA) if self.cfg.pp > 1 else P(DATA, SEQ)
+        shd = NamedSharding(self.mesh, spec)
         if jax.process_count() > 1:
             tokens = jax.make_array_from_process_local_data(shd, tokens)
             targets = jax.make_array_from_process_local_data(shd, targets)

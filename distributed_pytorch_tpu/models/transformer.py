@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import attention as attn_ops
+from ..ops import moe as moe_ops
 from ..parallel import context as ctx
 
 Array = jax.Array
@@ -49,10 +50,19 @@ class TransformerConfig:
     d_ff: int | None = None  # default 4*d_model
     rope_theta: float = 10_000.0
     norm_eps: float = 1e-5
+    # Mixture-of-Experts: 0 = dense; otherwise every ``moe_every``-th layer
+    # (counting from layer moe_every-1) uses a Switch-routed MoE MLP whose
+    # experts shard over the tensor axis (ops/moe.py).
+    n_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 2.0
 
     @property
     def ff(self) -> int:
         return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
 
 
 # Named size presets, in the spirit of the reference's cfg dict
@@ -81,17 +91,23 @@ def init(key: Array, cfg: TransformerConfig) -> PyTree:
         "final_norm": jnp.ones((d,), jnp.float32),
     }
     for i in range(cfg.n_layers):
-        params[f"layer{i}"] = {
+        layer = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": dense(next(keys), (d, h, dh), d),
             "wk": dense(next(keys), (d, h, dh), d),
             "wv": dense(next(keys), (d, h, dh), d),
             "wo": dense(next(keys), (h, dh, d), h * dh),
             "mlp_norm": jnp.ones((d,), jnp.float32),
-            "w_gate": dense(next(keys), (d, f), d),
-            "w_up": dense(next(keys), (d, f), d),
-            "w_down": dense(next(keys), (f, d), f),
         }
+        if cfg.is_moe_layer(i):
+            layer["moe"] = moe_ops.moe_init(next(keys), d, f, cfg.n_experts)
+        else:
+            layer.update(
+                w_gate=dense(next(keys), (d, f), d),
+                w_up=dense(next(keys), (d, f), d),
+                w_down=dense(next(keys), (f, d), f),
+            )
+        params[f"layer{i}"] = layer
     return params
 
 
@@ -102,17 +118,27 @@ def shard_specs(cfg: TransformerConfig, *, tp_axis: str = "model") -> PyTree:
 
     specs: dict = {"embed": P(), "final_norm": P()}
     for i in range(cfg.n_layers):
-        specs[f"layer{i}"] = {
+        layer = {
             "attn_norm": P(),
             "wq": P(None, tp_axis, None),
             "wk": P(None, tp_axis, None),
             "wv": P(None, tp_axis, None),
             "wo": P(tp_axis, None, None),
             "mlp_norm": P(),
-            "w_gate": P(None, tp_axis),
-            "w_up": P(None, tp_axis),
-            "w_down": P(tp_axis, None),
         }
+        if cfg.is_moe_layer(i):
+            # experts shard over the tensor axis (expert parallelism);
+            # the router is replicated
+            layer["moe"] = {
+                "router": P(),
+                "w_gate": P(tp_axis, None, None),
+                "w_up": P(tp_axis, None, None),
+                "w_down": P(tp_axis, None, None),
+            }
+        else:
+            layer.update(w_gate=P(None, tp_axis), w_up=P(None, tp_axis),
+                         w_down=P(tp_axis, None))
+        specs[f"layer{i}"] = layer
     return specs
 
 
@@ -136,6 +162,79 @@ def rotary(x: Array, pos: Array, theta: float) -> Array:
     return out.astype(x.dtype)
 
 
+def block(
+    lp: PyTree,
+    x: Array,
+    *,
+    cfg: TransformerConfig,
+    is_moe: bool,
+    pos: Array,
+    attn_impl: str = "flash",
+    seq_axis: str | None = None,
+    tp_axis: str | None = None,
+) -> tuple[Array, Array]:
+    """One transformer block: (layer params, (B, S, D)) -> (x, moe aux).
+
+    The single implementation of the layer body, shared by ``apply`` and the
+    pipeline-parallel stage runner (parallel/pipeline.py).
+    """
+    b, s, d = x.shape
+    # -- attention ---------------------------------------------------------
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
+    q = rotary(q, pos, cfg.rope_theta)
+    k = rotary(k, pos, cfg.rope_theta)
+    if seq_axis is not None:
+        o = ctx.ring_attention(q, k, v, seq_axis, causal=True)
+    elif attn_impl == "flash":
+        o = attn_ops.flash_attention(q, k, v, causal=True)
+    else:
+        o = attn_ops.attention_reference(q, k, v, causal=True)
+    o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)  # Megatron row-parallel reduction 1
+    x = x + o
+    # -- MLP ---------------------------------------------------------------
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    aux = jnp.zeros((), jnp.float32)
+    if is_moe:
+        hf = h.reshape(b * s, d)
+        if tp_axis is not None:
+            # Tokens are replicated across the tensor axis; each rank
+            # routes its 1/n slice, experts exchange via all_to_all
+            # (ops/moe.py), and the final psum (shared with the Megatron
+            # reduction below) reassembles the full token set.
+            n = lax.axis_size(tp_axis)
+            if (b * s) % n:
+                raise ValueError(
+                    f"tokens per device {b * s} not divisible by the "
+                    f"{n}-way '{tp_axis}' axis for MoE routing")
+            t_loc = b * s // n
+            idx = lax.axis_index(tp_axis)
+            h_loc = lax.dynamic_slice_in_dim(hf, idx * t_loc, t_loc)
+            out_loc, aux = moe_ops.moe_apply(
+                lp["moe"], h_loc, n_experts=cfg.n_experts,
+                capacity_factor=cfg.capacity_factor, axis=tp_axis)
+            down = jnp.zeros_like(hf)
+            down = lax.dynamic_update_slice_in_dim(
+                down, out_loc, idx * t_loc, 0)
+            aux = lax.pmean(aux, tp_axis)
+        else:
+            down, aux = moe_ops.moe_apply(
+                lp["moe"], hf, n_experts=cfg.n_experts,
+                capacity_factor=cfg.capacity_factor, axis=None)
+        down = down.reshape(b, s, d)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
+        up = h @ lp["w_up"].astype(h.dtype)
+        down = (gate * up) @ lp["w_down"].astype(h.dtype)
+    if tp_axis is not None:
+        down = lax.psum(down, tp_axis)  # Megatron reduction 2
+    return x + down, aux
+
+
 def apply(
     params: PyTree,
     tokens: Array,
@@ -146,50 +245,36 @@ def apply(
     seq_axis: str | None = None,   # ring-attention sequence parallelism
     tp_axis: str | None = None,    # Megatron tensor parallelism
     pos0: Array | int = 0,         # absolute position of tokens[:, 0]
-) -> Array:
+    return_aux: bool = False,
+) -> Array | tuple[Array, Array]:
     """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
 
     Under ``seq_axis``, ``tokens`` is this device's contiguous chunk and
     ``pos0`` its global offset; logits come back chunk-sharded the same way.
     Under ``tp_axis``, the weights are the local head/FFN shards and two
-    psums restore the full residual stream.
+    psums restore the full residual stream (MoE layers additionally
+    expert-shard over the axis and exchange tokens with all_to_all).
+
+    With ``return_aux`` the result is the tuple ``(logits, aux)`` where aux
+    is this device's summed MoE load-balance loss (0.0 for dense models);
+    callers average it across their mesh axes.
     """
     x = params["embed"][tokens]  # (B, S, D)
     if dtype is not None:
         x = x.astype(dtype)
-    b, s, d = x.shape
-    pos = pos0 + jnp.arange(s)
+    pos = pos0 + jnp.arange(x.shape[1])
+    aux_total = jnp.zeros((), jnp.float32)
 
     for i in range(cfg.n_layers):
-        lp = params[f"layer{i}"]
-        # -- attention block ------------------------------------------------
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
-        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
-        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
-        q = rotary(q, pos, cfg.rope_theta)
-        k = rotary(k, pos, cfg.rope_theta)
-        if seq_axis is not None:
-            o = ctx.ring_attention(q, k, v, seq_axis, causal=True)
-        elif attn_impl == "flash":
-            o = attn_ops.flash_attention(q, k, v, causal=True)
-        else:
-            o = attn_ops.attention_reference(q, k, v, causal=True)
-        o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
-        if tp_axis is not None:
-            o = lax.psum(o, tp_axis)  # Megatron row-parallel reduction 1
-        x = x + o
-        # -- MLP block ------------------------------------------------------
-        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
-        up = h @ lp["w_up"].astype(h.dtype)
-        down = (gate * up) @ lp["w_down"].astype(h.dtype)
-        if tp_axis is not None:
-            down = lax.psum(down, tp_axis)  # Megatron reduction 2
-        x = x + down
+        x, aux = block(
+            params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
+            pos=pos, attn_impl=attn_impl, seq_axis=seq_axis, tp_axis=tp_axis)
+        aux_total = aux_total + aux
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total
     return logits
 
 

@@ -1,0 +1,123 @@
+"""Mixture-of-Experts layer with expert parallelism (all_to_all dispatch).
+
+The last parallelism axis the framework adds (the reference has none of
+this — SURVEY.md section 5): a Switch-style top-1-routed MoE MLP whose
+experts are sharded over a mesh axis.  Design:
+
+- **Routing** (per token): softmax router over all E experts, top-1 pick,
+  output scaled by the router probability (straight-through gating).
+- **Capacity**: each expert accepts at most C = ceil(T * cf / E) tokens per
+  routing group; overflow tokens are dropped (their MLP delta is zero —
+  the residual stream passes them through), the standard Switch behavior.
+- **Dispatch** is einsum against a (T, E, C) one-hot tensor — dense,
+  MXU-shaped, fully differentiable (the gradient of a dropped token's
+  delta is zero, as it should be).
+- **Expert parallelism** (``axis``): each device holds E_local = E/n
+  experts and routes its own T tokens; one ``lax.all_to_all`` carries every
+  device's per-expert buffers to the expert's owner and a second carries
+  results back.  XLA lowers these to ICI all-to-alls.
+- **Load-balance aux loss**: the Switch aux ``E * sum_e f_e * p_e`` over
+  this device's tokens (f = routed fraction, p = mean router prob).
+
+All shapes are static: capacity and expert counts are trace-time constants,
+so the whole layer compiles into one XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+PyTree = Any
+
+
+def moe_init(key: Array, d_model: int, d_ff: int, n_experts: int) -> PyTree:
+    """Router + per-expert SwiGLU stacks.  To expert-shard, split the
+    leading expert dim of w_gate/w_up/w_down over the mesh axis (the router
+    stays replicated)."""
+    ks = jax.random.split(key, 4)
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+
+    return {
+        "router": dense(ks[0], (d_model, n_experts), d_model),
+        "w_gate": dense(ks[1], (n_experts, d_model, d_ff), d_model),
+        "w_up": dense(ks[2], (n_experts, d_model, d_ff), d_model),
+        "w_down": dense(ks[3], (n_experts, d_ff, d_model), d_ff),
+    }
+
+
+def moe_apply(
+    params: PyTree,
+    x: Array,                      # (T, D) this device's tokens
+    *,
+    n_experts: int,                # GLOBAL expert count E
+    capacity_factor: float = 2.0,
+    axis: str | None = None,       # expert-parallel mesh axis
+) -> tuple[Array, Array]:
+    """Returns (out (T, D), load-balance aux loss scalar).
+
+    Without ``axis``, ``params`` holds all E experts.  With ``axis``,
+    ``params['w_*']`` hold this device's E/n expert shard and tokens are
+    exchanged over the axis with all_to_all.
+    """
+    t, d = x.shape
+    e = n_experts
+    n = lax.axis_size(axis) if axis is not None else 1
+    if e % n:
+        raise ValueError(f"{e} experts do not shard over {n} devices")
+    e_local = e // n
+    cap = max(1, math.ceil(t * capacity_factor / e))
+
+    # -- routing (f32 for a stable softmax) --------------------------------
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
+    gate = jnp.max(probs, axis=-1)                       # (T,)
+    expert = jnp.argmax(probs, axis=-1)                  # (T,)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+
+    # Switch load-balance aux: E * sum_e (fraction routed) * (mean prob).
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+
+    # -- capacity & dispatch tensor (T, E, C) ------------------------------
+    pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based slot
+    keep = (pos > 0) & (pos <= cap)
+    slot = (pos - 1).astype(jnp.int32)                   # -1 when unrouted
+    dispatch = jax.nn.one_hot(slot, cap, dtype=x.dtype) * keep[
+        ..., None].astype(x.dtype)                       # (T, E, C)
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+
+    xin = jnp.einsum("tec,td->ecd", dispatch, x)         # (E, C, D)
+
+    # -- expert exchange (EP): my tokens -> expert owners ------------------
+    if axis is not None:
+        xin = xin.reshape(n, e_local, cap, d)
+        # slot j of the result = the buffer device j routed to my experts
+        xin = lax.all_to_all(xin, axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+        xin = jnp.moveaxis(xin, 0, 1).reshape(e_local, n * cap, d)
+
+    # -- per-expert SwiGLU (batched over the local expert dim) -------------
+    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin,
+                               params["w_gate"].astype(x.dtype)))
+    u = jnp.einsum("ecd,edf->ecf", xin, params["w_up"].astype(x.dtype))
+    yout = jnp.einsum("ecf,efd->ecd", g * u,
+                      params["w_down"].astype(x.dtype))
+
+    # -- return trip and combine ------------------------------------------
+    if axis is not None:
+        yout = jnp.moveaxis(yout.reshape(e_local, n, cap, d), 1, 0)
+        yout = lax.all_to_all(yout, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+        yout = yout.reshape(e, cap, d)
+
+    out = jnp.einsum("tec,ecd->td", combine, yout)       # (T, D)
+    return out, aux.astype(jnp.float32)

@@ -198,3 +198,22 @@ def cross_entropy_loss(logits: Array, labels: Array) -> Array:
 def accuracy_count(logits: Array, labels: Array) -> Array:
     """Number of correct argmax predictions (reference main.py:60-62)."""
     return jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+
+
+IGNORE_INDEX = -1  # target id excluded from LM losses (padding)
+
+
+def masked_ce(logits: Array, targets: Array) -> tuple[Array, Array]:
+    """(sum of next-token CE over non-ignored tokens, count).
+
+    The LM-side sibling of ``cross_entropy_loss``: callers psum the pair
+    over their data/sequence axes and divide, so the mean is global no
+    matter how the batch/sequence are sharded.
+    """
+    logits = logits.astype(jnp.float32)
+    mask = targets != IGNORE_INDEX
+    safe = jnp.where(mask, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    ce = jnp.where(mask, logz - true_logit, 0.0)
+    return jnp.sum(ce), jnp.sum(mask)

@@ -1,0 +1,169 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference's parallelism inventory is data-parallel only (SURVEY.md
+section 5); this module adds the pipeline axis for models whose layer stack
+does not fit one chip.  Design (the JAX SPMD formulation, not a scheduler
+thread per stage):
+
+- the transformer's L identical blocks are split into ``n = axis_size(pipe)``
+  contiguous stages; each stage's layer parameters are stacked with a leading
+  stage dim and sharded ``P('pipe')``, so each device holds L/n layers;
+- a ``lax.scan`` runs the GPipe schedule: at tick t, stage s processes
+  microbatch ``t - s`` (when valid); activations hop stage s -> s+1 with one
+  ``lax.ppermute`` per tick (ICI neighbor exchange);
+- every device executes the same program every tick (SPMD lockstep); ticks
+  outside a stage's valid window compute on zeros and are masked out of the
+  loss — the classic (n-1)/(M+n-1) pipeline bubble;
+- the backward schedule is NOT hand-written: ``jax.grad`` through the scan
+  and ppermute yields the reverse pipeline (ppermute's transpose reverses
+  the ring), with ``jax.checkpoint`` on the stage body for activation remat.
+
+Embedding/unembedding weights are replicated to every stage (cheap at these
+scales) so first/last-stage special-casing is a mask, not a branch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import transformer as tfm
+
+PyTree = Any
+
+
+def split_layer_params(params: PyTree, cfg: tfm.TransformerConfig,
+                       n_stages: int):
+    """Re-pack per-layer params into stage-stacked leaves.
+
+    Returns ``(stage_params, shared)`` where each ``stage_params`` leaf has
+    shape (n_stages, layers_per_stage, *leaf) — shard its leading dim over
+    'pipe' — and ``shared`` holds embed/final_norm (replicated everywhere).
+    """
+    if cfg.n_experts:
+        raise ValueError(
+            "pipeline parallelism requires a dense layer stack (layer "
+            "params must stack homogeneously); MoE models (n_experts > 0) "
+            "are not supported with pp > 1")
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"{cfg.n_layers} layers do not split into {n_stages} stages")
+    per = cfg.n_layers // n_stages
+    layers = [params[f"layer{i}"] for i in range(cfg.n_layers)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    stage_params = jax.tree.map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+    shared = {"embed": params["embed"], "final_norm": params["final_norm"]}
+    return stage_params, shared
+
+
+def merge_layer_params(stage_params: PyTree, shared: PyTree,
+                       cfg: tfm.TransformerConfig) -> PyTree:
+    """Inverse of split_layer_params (for checkpoint export/tests)."""
+    flat = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), stage_params)
+    params = {"embed": shared["embed"], "final_norm": shared["final_norm"]}
+    for i in range(cfg.n_layers):
+        params[f"layer{i}"] = jax.tree.map(lambda x: x[i], flat)
+    return params
+
+
+def stage_specs(cfg: tfm.TransformerConfig, n_stages: int) -> PyTree:
+    """The P('pipe') spec tree matching split_layer_params' stage output —
+    computed once from the real split structure (no homogeneity guess)."""
+    from jax.sharding import PartitionSpec as P
+
+    stages_shape = jax.eval_shape(
+        lambda k: split_layer_params(tfm.init(k, cfg), cfg, n_stages)[0],
+        jax.random.key(0))
+    return jax.tree.map(lambda _: P("pipe"), stages_shape)
+
+
+def _stage(stage_layers: PyTree, x: jax.Array,
+           cfg: tfm.TransformerConfig, attn_impl: str) -> jax.Array:
+    """Run this device's layers_per_stage blocks (a homogeneous layer scan
+    over the shared models/transformer.py:block body)."""
+    pos = jnp.arange(x.shape[1])
+
+    def body(x, lp):
+        x, _ = tfm.block(lp, x, cfg=cfg, is_moe=False, pos=pos,
+                         attn_impl=attn_impl)
+        return x, None
+
+    x, _ = lax.scan(body, x, stage_layers)
+    return x
+
+
+def pipeline_loss(
+    stage_params: PyTree,
+    shared: PyTree,
+    tokens: jax.Array,     # (M, mb, S) microbatched token ids
+    targets: jax.Array,    # (M, mb, S) next-token targets (IGNORE = pad)
+    *,
+    cfg: tfm.TransformerConfig,
+    axis: str = "pipe",
+    dtype: jnp.dtype | None = None,
+    attn_impl: str = "flash",
+) -> jax.Array:
+    """Mean masked CE over all microbatches, computed through the pipeline.
+
+    Runs inside shard_map with ``stage_params`` leaves carrying this stage's
+    (1, layers_per_stage, ...) slice.  Returns the loss summed over this
+    shard's tokens plus the valid-token count (both to be psum'd by the
+    caller across data/pipe axes).
+    """
+    from ..ops.nn import masked_ce
+
+    me = lax.axis_index(axis)
+    n = lax.axis_size(axis)
+    local_layers = jax.tree.map(lambda x: x[0], stage_params)  # (per, ...)
+    m_micro, mb, s = tokens.shape
+
+    # Embed all microbatches (replicated embed; masked-out stages feed zeros).
+    x_all = shared["embed"][tokens]  # (M, mb, S, D)
+    if dtype is not None:
+        x_all = x_all.astype(dtype)
+
+    stage_fn = jax.checkpoint(partial(_stage, cfg=cfg, attn_impl=attn_impl))
+    perm = [(i, i + 1) for i in range(n - 1)]  # stage s -> s+1
+
+    # Scan carries must be varying over every axis their updates vary over:
+    # the pipe axis (stage params) plus whatever the inputs carry (e.g. a
+    # 'data' axis when composed with DP).
+    want_vma = jax.typeof(x_all).vma | {axis}
+
+    def _varying(x):
+        missing = tuple(a for a in want_vma if a not in jax.typeof(x).vma)
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    zero_x = _varying(jnp.zeros((mb, s, x_all.shape[-1]), x_all.dtype))
+
+    def tick(carry, t):
+        prev_out, ce_acc, n_acc = carry
+        # Activation arriving from the previous stage (stage 0 receives its
+        # fresh microbatch embedding instead).
+        recv = lax.ppermute(prev_out, axis, perm)
+        m_in = jnp.clip(t, 0, m_micro - 1)
+        fresh = lax.dynamic_index_in_dim(x_all, m_in, 0, keepdims=False)
+        x_in = jnp.where(me == 0, fresh, recv)
+        out = stage_fn(local_layers, x_in)
+        # Last stage finishes microbatch t-(n-1): unembed + masked CE.
+        m_out = jnp.clip(t - (n - 1), 0, m_micro - 1)
+        valid = (me == n - 1) & (t - (n - 1) >= 0) & (t - (n - 1) < m_micro)
+        h = tfm.rms_norm(out, shared["final_norm"], cfg.norm_eps)
+        logits = h.astype(jnp.float32) @ shared["embed"].T.astype(jnp.float32)
+        tgt = lax.dynamic_index_in_dim(targets, m_out, 0, keepdims=False)
+        ce, cnt = masked_ce(logits, tgt)
+        ce_acc = ce_acc + jnp.where(valid, ce, 0.0)
+        n_acc = n_acc + jnp.where(valid, cnt, 0)
+        return (out, ce_acc, n_acc), None
+
+    ce0 = _varying(jnp.zeros(()))
+    n0 = _varying(jnp.zeros((), jnp.int32))
+    (_, ce_sum, n_sum), _ = lax.scan(
+        tick, (zero_x, ce0, n0), jnp.arange(m_micro + n - 1))
+    return ce_sum, n_sum
