@@ -1,0 +1,150 @@
+"""Autoregressive decoding with a KV cache for the transformer LM.
+
+Inference counterpart of lm.py: one compiled ``lax.scan`` drives prefill and
+sampling (no per-token dispatch), with per-layer K/V caches updated in place
+via ``dynamic_update_slice`` — static shapes throughout, so the whole decode
+is a single XLA program.
+
+Supports greedy (temperature=0) and temperature/top-k sampling.  MoE layers
+decode with a dense-evaluation trick (every expert runs on the B decode
+tokens, the router's one-hot selects) — exact w.r.t. training semantics
+minus capacity drops, and cheap at decode batch sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .models import transformer as tfm
+from .ops.attention import NEG_INF, attention_reference
+
+PyTree = Any
+
+
+def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
+               dtype=jnp.float32) -> PyTree:
+    """Zeroed per-layer K/V buffers, (B, H, max_len, head_dim)."""
+    shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    return {
+        f"layer{i}": {"k": jnp.zeros(shape, dtype),
+                      "v": jnp.zeros(shape, dtype)}
+        for i in range(cfg.n_layers)
+    }
+
+
+def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig):
+    """Capacity-free MoE for decode: run all experts, one-hot combine."""
+    b, s, d = h.shape
+    hf = h.reshape(b * s, d)
+    probs = jax.nn.softmax(
+        hf.astype(jnp.float32) @ lp["moe"]["router"].astype(jnp.float32), -1)
+    gate = jnp.max(probs, -1)
+    onehot = jax.nn.one_hot(jnp.argmax(probs, -1), cfg.n_experts,
+                            dtype=hf.dtype)
+    g = jax.nn.silu(jnp.einsum("td,edf->tef", hf,
+                               lp["moe"]["w_gate"].astype(hf.dtype)))
+    u = jnp.einsum("td,edf->tef", hf, lp["moe"]["w_up"].astype(hf.dtype))
+    y = jnp.einsum("tef,efd->ted", g * u,
+                   lp["moe"]["w_down"].astype(hf.dtype))
+    out = jnp.einsum("te,ted->td", onehot * gate.astype(hf.dtype)[:, None], y)
+    return out.reshape(b, s, d)
+
+
+def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
+                pos: jax.Array, *, cfg: tfm.TransformerConfig,
+                dtype=None):
+    """Process one token per sequence: (B,) ids at position ``pos`` ->
+    ((B, vocab) logits, updated cache)."""
+    x = params["embed"][token][:, None, :]  # (B, 1, D)
+    if dtype is not None:
+        x = x.astype(dtype)
+    max_len = next(iter(cache.values()))["k"].shape[2]
+    # bias masking cache slots beyond the current position
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+    bias = jnp.where(slot <= pos, 0.0, NEG_INF)[None, None]  # (1,1,1,L)
+
+    for i in range(cfg.n_layers):
+        lp = params[f"layer{i}"]
+        c = cache[f"layer{i}"]
+        h = tfm.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
+        posv = pos[None] if pos.ndim == 0 else pos
+        q = tfm.rotary(q, posv, cfg.rope_theta)
+        k = tfm.rotary(k, posv, cfg.rope_theta)
+        ck = lax.dynamic_update_slice(
+            c["k"], k.astype(c["k"].dtype), (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(
+            c["v"], v.astype(c["v"].dtype), (0, 0, pos, 0))
+        cache[f"layer{i}"] = {"k": ck, "v": cv}
+        o = attention_reference(q, ck.astype(q.dtype), cv.astype(q.dtype),
+                                bias=bias)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+        h = tfm.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.is_moe_layer(i):
+            x = x + _moe_dense(lp, h, cfg)
+        else:
+            gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
+            up = h @ lp["w_up"].astype(h.dtype)
+            x = x + (gate * up) @ lp["w_down"].astype(h.dtype)
+
+    x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0].astype(jnp.float32)
+              @ params["embed"].T.astype(jnp.float32))
+    return logits, cache
+
+
+def _sample(key, logits, temperature: float, top_k: int | None):
+    if temperature == 0.0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, -1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k"))
+def generate(
+    params: PyTree,
+    prompt: jax.Array,       # (B, S0) int32
+    key: jax.Array,
+    *,
+    cfg: tfm.TransformerConfig,
+    max_new: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+) -> jax.Array:
+    """Sample ``max_new`` tokens after ``prompt``; returns (B, S0+max_new).
+
+    One jitted program: a prefill scan feeds the prompt through the cache,
+    then a sampling scan emits tokens (each step's sample feeds the next).
+    """
+    b, s0 = prompt.shape
+    cache = init_cache(cfg, b, s0 + max_new)
+
+    step = partial(decode_step, cfg=cfg)
+
+    def prefill(cache, t):
+        logits, cache = step(params, cache, prompt[:, t], jnp.asarray(t))
+        return cache, logits
+
+    cache, logits_all = lax.scan(prefill, cache, jnp.arange(s0))
+    last_logits = logits_all[-1]
+
+    def sample_step(carry, t):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = _sample(sub, logits, temperature, top_k)
+        logits, cache = step(params, cache, tok, s0 + t)
+        return (cache, logits, key), tok
+
+    (_, _, _), tokens = lax.scan(
+        sample_step, (cache, last_logits, key), jnp.arange(max_new))
+    return jnp.concatenate([prompt, tokens.T], axis=1)

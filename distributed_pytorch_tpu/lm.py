@@ -212,10 +212,43 @@ class LMTrainer:
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)
             self.step_fn = make_lm_train_step(cfg, self.mesh)
-        # zeros_like/elementwise init inherits each param's sharding
-        self.opt_state = jax.jit(tx.init)(params)
+        # zeros_like/elementwise init inherits each param's sharding; leaves
+        # with no param ancestry (Adam's step count) come out single-device —
+        # normalize them to replicated-on-mesh so every training-state leaf
+        # lives on the same device set (mixing committed single-device and
+        # mesh-wide args in one jit is an error).
+        rep = NamedSharding(self.mesh, P())
+        self.opt_state = jax.tree.map(
+            lambda leaf: (jax.device_put(leaf, rep)
+                          if isinstance(leaf, jax.Array)
+                          and len(leaf.sharding.device_set) == 1
+                          and self.mesh.devices.size > 1 else leaf),
+            jax.jit(tx.init)(params))
         self.params = params
         self._step = 0
+
+    # -- checkpointing ----------------------------------------------------
+    def save_checkpoint(self, directory: str) -> None:
+        """Snapshot params/opt-state/step (utils/checkpoint.py); all
+        processes must call (sharded fetches are collectives)."""
+        from .utils.checkpoint import PyTreeCheckpointer
+        PyTreeCheckpointer(directory).save(
+            {"params": self.params, "opt": self.opt_state}, self._step,
+            meta={"dp": self.cfg.dp, "sp": self.cfg.sp, "tp": self.cfg.tp,
+                  "pp": self.cfg.pp})
+
+    def maybe_restore(self, directory: str) -> int:
+        """Restore the latest checkpoint if present; returns the step to
+        resume from (0 = fresh)."""
+        from .utils.checkpoint import PyTreeCheckpointer
+        got = PyTreeCheckpointer(directory).restore(
+            {"params": self.params, "opt": self.opt_state})
+        if got is None:
+            return 0
+        trees, meta = got
+        self.params, self.opt_state = trees["params"], trees["opt"]
+        self._step = meta["step"]
+        return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
         spec = P(DATA) if self.cfg.pp > 1 else P(DATA, SEQ)

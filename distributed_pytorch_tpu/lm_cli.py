@@ -1,0 +1,178 @@
+"""CLI for transformer-LM training: the long-context/distributed entry point.
+
+The sibling of cli.py (which preserves the reference's VGG/CIFAR contract —
+reference README.md:4); this one drives lm.py's (data x seq x tensor) or
+(data x pipe) meshes on a byte-level corpus:
+
+  python -m distributed_pytorch_tpu.lm_cli --preset LM-tiny --steps 100 \\
+      --dp 2 --sp 2 --tp 2 --batch-size 8 --seq-len 512
+
+Multi-host uses the same rendezvous contract as cli.py (--master-ip /
+--num-nodes / --rank, or torchrun-style env vars via --rendezvous env).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import numpy as np
+
+from .data import lm_corpus
+from .lm import IGNORE, LMTrainConfig, LMTrainer
+from .models import transformer as tfm
+from .parallel import init as dist_init
+from .utils.logging import get_logger, setup_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_pytorch_tpu.lm_cli",
+        description="TPU-native transformer LM trainer "
+                    "(dp x sp x tp, or dp x pp)")
+    # rendezvous (same contract as cli.py / the reference)
+    p.add_argument("--master-ip", default=None)
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--port", type=int, default=dist_init.DEFAULT_PORT)
+    p.add_argument("--rendezvous", choices=["args", "env"], default="args")
+    # model
+    p.add_argument("--preset", default="LM-tiny",
+                   choices=sorted(tfm.PRESETS))
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--n-layers", type=int, default=None)
+    p.add_argument("--n-heads", type=int, default=None)
+    p.add_argument("--head-dim", type=int, default=None)
+    p.add_argument("--n-experts", type=int, default=None,
+                   help="enable MoE layers with this many experts")
+    # parallelism
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    # training
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="global batch (sequences per step)")
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--compute-dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--corpus", default=None,
+                   help="path to a text file (byte-level); default: "
+                        "deterministic synthetic corpus")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=200)
+    # sampling after training
+    p.add_argument("--generate", default=None, metavar="PROMPT",
+                   help="sample text from the trained model")
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def model_config(args) -> tfm.TransformerConfig:
+    cfg = tfm.PRESETS[args.preset]
+    # byte-level corpus: the vocab is always 256
+    overrides = {"vocab_size": lm_corpus.VOCAB_SIZE}
+    for field in ("d_model", "n_layers", "n_heads", "head_dim", "n_experts"):
+        val = getattr(args, field)
+        if val is not None:
+            overrides[field] = val
+    import dataclasses
+    return dataclasses.replace(cfg, **overrides)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.rendezvous == "env":
+        dist_init.init_from_env()
+    else:
+        dist_init.init_distributed(args.master_ip, args.num_nodes, args.rank,
+                                   port=args.port)
+    setup_logging(args.log_level)
+    log = get_logger("lm_cli")
+
+    cfg = LMTrainConfig(
+        model=model_config(args), lr=args.lr, seed=args.seed,
+        compute_dtype=(None if args.compute_dtype == "float32"
+                       else args.compute_dtype),
+        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp)
+    trainer = LMTrainer(cfg)
+    log.info("model: %s | mesh: dp=%d sp=%d tp=%d pp=%d over %d devices",
+             cfg.model, args.dp, args.sp, args.tp, args.pp,
+             trainer.mesh.devices.size)
+
+    start = 0
+    if args.checkpoint_dir:
+        start = trainer.maybe_restore(args.checkpoint_dir)
+        if start:
+            log.info("resumed at step %d", start)
+
+    corpus = lm_corpus.load_corpus(args.corpus)
+    log.info("corpus: %d tokens (%s)", len(corpus),
+             "synthetic" if corpus.synthetic else args.corpus)
+    # each process feeds its host-local share of the global batch
+    procs = jax.process_count()
+    if args.batch_size % max(procs, 1):
+        raise SystemExit(f"--batch-size {args.batch_size} must divide "
+                         f"across {procs} processes")
+    loader = lm_corpus.LMDataLoader(
+        corpus, args.batch_size // procs, args.seq_len,
+        num_replicas=procs, rank=jax.process_index(), seed=0)
+
+    step = start
+    t_last, s_last = time.perf_counter(), start
+    steps_per_epoch = max(len(loader), 1)
+    while step < args.steps:
+        # Derive (epoch, batch offset) from the global step so a resumed run
+        # consumes exactly the batches the interrupted run would have.
+        loader.set_epoch(step // steps_per_epoch)
+        skip = step % steps_per_epoch
+        for i, (tokens, targets) in enumerate(loader):
+            if i < skip or step >= args.steps:
+                continue
+            loss = trainer.train_step(tokens, targets)
+            step += 1
+            if step % args.log_every == 0:
+                dt = time.perf_counter() - t_last
+                tok_s = ((step - s_last) * args.batch_size * args.seq_len
+                         / max(dt, 1e-9))
+                log.info("step %d | loss %.4f | %.0f tok/s",
+                         step, float(loss), tok_s)
+                t_last, s_last = time.perf_counter(), step
+            if (args.checkpoint_dir
+                    and step % args.checkpoint_every == 0):
+                trainer.save_checkpoint(args.checkpoint_dir)
+
+    if args.checkpoint_dir:
+        trainer.save_checkpoint(args.checkpoint_dir)
+
+    if args.generate is not None:
+        if cfg.pp > 1:
+            log.warning("generation with pp>1 not supported; skipping")
+        else:
+            from . import generate as gen
+            from .utils.checkpoint import _fetch
+            # host-gather params (collective-safe on multi-host shardings)
+            params = jax.tree.map(_fetch, trainer.params)
+            prompt = lm_corpus.encode(args.generate)[None]
+            out = gen.generate(
+                params,
+                prompt.astype(np.int32), jax.random.key(args.seed),
+                cfg=cfg.model, max_new=args.max_new,
+                temperature=args.temperature)
+            text = lm_corpus.decode(np.asarray(out[0]))
+            print(text)
+
+    dist_init.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

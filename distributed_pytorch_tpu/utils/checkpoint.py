@@ -65,6 +65,31 @@ def _unflatten_like(tree, flat: dict[str, np.ndarray], prefix: str):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _list_ckpts(directory: str) -> list[tuple[int, str]]:
+    out = []
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def _atomic_write(directory: str, index: int, payload: dict,
+                  meta: dict, keep: int) -> str:
+    """Embed meta, write ckpt_<index>.npz atomically, prune old ones."""
+    payload = dict(payload)
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    path = os.path.join(directory, f"ckpt_{index}.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic publish
+    for _, old in _list_ckpts(directory)[:-keep]:
+        os.remove(old)
+    return path
+
+
 class Checkpointer:
     """Epoch-granularity checkpoints in ``directory`` (ckpt_<epoch>.npz)."""
 
@@ -90,30 +115,11 @@ class Checkpointer:
         meta = {"epoch": epoch, "step": trainer._step,
                 "model": trainer.cfg.model, "strategy": trainer.cfg.strategy,
                 "n_replicas": trainer.n_replicas}
-        payload["__meta__"] = np.frombuffer(
-            json.dumps(meta).encode(), dtype=np.uint8)
-        path = os.path.join(self.directory, f"ckpt_{epoch}.npz")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, path)  # atomic publish
-        self._prune()
-        return path
-
-    def _prune(self) -> None:
-        ckpts = sorted(self.list(), key=lambda t: t[0])
-        for epoch, path in ckpts[: -self.keep]:
-            os.remove(path)
+        return _atomic_write(self.directory, epoch, payload, meta, self.keep)
 
     # -- restore ----------------------------------------------------------
     def list(self) -> list[tuple[int, str]]:
-        out = []
-        for name in os.listdir(self.directory):
-            m = _CKPT_RE.match(name)
-            if m:
-                out.append((int(m.group(1)),
-                            os.path.join(self.directory, name)))
-        return sorted(out)
+        return _list_ckpts(self.directory)
 
     def latest(self) -> tuple[int, str] | None:
         ckpts = self.list()
@@ -150,3 +156,52 @@ class Checkpointer:
             params, state, opt_state)
         trainer._step = meta["step"]
         return meta["epoch"]
+
+
+class PyTreeCheckpointer:
+    """Generic step-granularity checkpoints for named pytrees (the LM-side
+    sibling of ``Checkpointer``, which is wedded to the VGG trainer's
+    params/BN-state/opt triple).
+
+    ``save`` stores any dict of pytrees + JSON-able meta; ``restore`` needs
+    a template dict with the same structure (e.g. a freshly initialized
+    trainer's state) and re-places every leaf with the template leaf's
+    sharding, so a resumed run is layout-identical to a fresh one.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, trees: dict, step: int, meta: dict | None = None):
+        payload: dict[str, np.ndarray] = {}
+        for name, tree in trees.items():
+            for k, v in _flatten(tree).items():
+                payload[name + k] = v
+        if jax.process_index() != 0:
+            return None
+        return _atomic_write(self.directory, step, payload,
+                             dict(meta or {}, step=step), self.keep)
+
+    def list(self) -> list[tuple[int, str]]:
+        return _list_ckpts(self.directory)
+
+    def restore(self, like: dict) -> tuple[dict, dict] | None:
+        """Latest checkpoint restored into ``like``'s structure/shardings;
+        returns (trees, meta) or None when no checkpoint exists."""
+        ckpts = self.list()
+        if not ckpts:
+            return None
+        _, path = ckpts[-1]
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+        meta = json.loads(bytes(flat.pop("__meta__").tobytes()).decode())
+        out = {}
+        for name, tree in like.items():
+            restored = _unflatten_like(tree, flat, name)
+            out[name] = jax.tree.map(
+                lambda new, old: (jax.device_put(new, old.sharding)
+                                  if isinstance(old, jax.Array) else new),
+                restored, tree)
+        return out, meta
