@@ -131,12 +131,25 @@ def generate(
 
     step = partial(decode_step, cfg=cfg)
 
-    def prefill(cache, t):
-        logits, cache = step(params, cache, prompt[:, t], jnp.asarray(t))
-        return cache, logits
-
-    cache, logits_all = lax.scan(prefill, cache, jnp.arange(s0))
-    last_logits = logits_all[-1]
+    # Prefill: ONE batched causal forward over the whole prompt (matmul-bound
+    # MXU work), seeding each layer's cache from the block's rotary-embedded
+    # K/V — not a per-token scan of tiny (B, 1, D) ops.
+    x = params["embed"][prompt]
+    pos = jnp.arange(s0)
+    for i in range(cfg.n_layers):
+        x, _, (k, v) = tfm.block(
+            params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
+            pos=pos, attn_impl="reference", return_kv=True)
+        c = cache[f"layer{i}"]
+        cache[f"layer{i}"] = {
+            "k": lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                          (0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                          (0, 0, 0, 0)),
+        }
+    x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last_logits = (x[:, -1].astype(jnp.float32)
+                   @ params["embed"].T.astype(jnp.float32))
 
     def sample_step(carry, t):
         cache, logits, key = carry

@@ -61,6 +61,7 @@ class LMTrainConfig:
     tp: int = 1
     pp: int = 1          # pipeline stages (GPipe); requires sp == tp == 1
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
+    fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
 
 
 def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
@@ -77,6 +78,48 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
                      axis_names=(DATA, SEQ, MODEL),
                      axis_shape=(cfg.dp, cfg.sp, cfg.tp),
                      devices=devices)
+
+
+def param_specs(cfg: LMTrainConfig) -> PyTree:
+    """Per-leaf PartitionSpecs for the transformer params.
+
+    Base: the Megatron tensor sharding (models/transformer.py shard_specs).
+    With ``fsdp``, each leaf's first dp-divisible unsharded dim additionally
+    shards over 'data' (ZeRO-3): parameters and optimizer state shrink by
+    the dp degree per device; the train step all-gathers weights for use and
+    autodiff's transpose reduce-scatters the gradients back.
+    """
+    specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
+    if not cfg.fsdp or cfg.dp == 1:
+        return specs
+    shapes = jax.eval_shape(lambda k: tfm.init(k, cfg.model),
+                            jax.random.key(0))
+
+    def add_data(spec: P, shape) -> P:
+        parts = list(spec) + [None] * (len(shape.shape) - len(spec))
+        for i, (ax, dim) in enumerate(zip(parts, shape.shape)):
+            if ax is None and dim % cfg.dp == 0:
+                parts[i] = DATA
+                return P(*parts)
+        return spec  # no divisible dim: leaf stays dp-replicated
+
+    return jax.tree.map(add_data, specs, shapes)
+
+
+def _fsdp_gather(params: PyTree, specs: PyTree) -> PyTree:
+    """all_gather fsdp-sharded leaves back to full (tp shards stay local).
+
+    Inside shard_map; the transpose of these gathers is the reduce-scatter
+    that delivers each device only its shard's gradient — ZeRO's comm
+    pattern, synthesized by autodiff.
+    """
+    def gather(p, spec):
+        for dim, ax in enumerate(spec):
+            if ax == DATA:
+                return jax.lax.all_gather(p, DATA, axis=dim, tiled=True)
+        return p
+
+    return jax.tree.map(gather, params, specs)
 
 
 def make_optimizer(cfg: LMTrainConfig) -> optax.GradientTransformation:
@@ -98,9 +141,11 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     # only replaces local flash attention when the seq axis is actually cut.
     tp_axis = MODEL
     seq_axis = SEQ if cfg.sp > 1 else None
-    specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
+    specs = param_specs(cfg)
 
     def local_loss(params, tokens, targets):
+        if cfg.fsdp:
+            params = _fsdp_gather(params, specs)
         s_local = tokens.shape[1]
         pos0 = jax.lax.axis_index(SEQ) * s_local
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
@@ -191,6 +236,9 @@ class LMTrainer:
         assert self.mesh.devices.size == want, (
             f"mesh has {self.mesh.devices.size} devices, config wants {want}")
 
+        if cfg.fsdp and cfg.pp > 1:
+            raise ValueError("fsdp composes with the (data, seq, model) "
+                             "mesh, not with pp")
         params = tfm.init(jax.random.key(cfg.seed), cfg.model)
         tx = make_optimizer(cfg)
         if cfg.pp > 1:
@@ -207,7 +255,7 @@ class LMTrainer:
             }
             self.step_fn = make_lm_pp_train_step(cfg, self.mesh)
         else:
-            specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
+            specs = param_specs(cfg)
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)

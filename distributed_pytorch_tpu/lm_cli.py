@@ -21,7 +21,7 @@ import jax
 import numpy as np
 
 from .data import lm_corpus
-from .lm import IGNORE, LMTrainConfig, LMTrainer
+from .lm import LMTrainConfig, LMTrainer
 from .models import transformer as tfm
 from .parallel import init as dist_init
 from .utils.logging import get_logger, setup_logging
@@ -52,6 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3: shard params+optimizer over the data axis")
     # training
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=8,
@@ -102,7 +104,7 @@ def main(argv: list[str] | None = None) -> int:
         model=model_config(args), lr=args.lr, seed=args.seed,
         compute_dtype=(None if args.compute_dtype == "float32"
                        else args.compute_dtype),
-        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp)
+        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, fsdp=args.fsdp)
     trainer = LMTrainer(cfg)
     log.info("model: %s | mesh: dp=%d sp=%d tp=%d pp=%d over %d devices",
              cfg.model, args.dp, args.sp, args.tp, args.pp,
@@ -124,18 +126,24 @@ def main(argv: list[str] | None = None) -> int:
                          f"across {procs} processes")
     loader = lm_corpus.LMDataLoader(
         corpus, args.batch_size // procs, args.seq_len,
-        num_replicas=procs, rank=jax.process_index(), seed=0)
+        num_replicas=procs, rank=jax.process_index(), seed=args.seed)
+    if len(loader) == 0:
+        raise SystemExit(
+            f"corpus yields 0 batches: {loader.per_rank} windows/process "
+            f"at --seq-len {args.seq_len} cannot fill a batch of "
+            f"{loader.batch_size}; use a larger --corpus or smaller "
+            f"--batch-size/--seq-len")
 
     step = start
     t_last, s_last = time.perf_counter(), start
-    steps_per_epoch = max(len(loader), 1)
+    steps_per_epoch = len(loader)
     while step < args.steps:
         # Derive (epoch, batch offset) from the global step so a resumed run
         # consumes exactly the batches the interrupted run would have.
         loader.set_epoch(step // steps_per_epoch)
         skip = step % steps_per_epoch
         for i, (tokens, targets) in enumerate(loader):
-            if i < skip or step >= args.steps:
+            if i < skip:
                 continue
             loss = trainer.train_step(tokens, targets)
             step += 1
@@ -149,6 +157,8 @@ def main(argv: list[str] | None = None) -> int:
             if (args.checkpoint_dir
                     and step % args.checkpoint_every == 0):
                 trainer.save_checkpoint(args.checkpoint_dir)
+            if step >= args.steps:
+                break
 
     if args.checkpoint_dir:
         trainer.save_checkpoint(args.checkpoint_dir)

@@ -172,11 +172,14 @@ def block(
     attn_impl: str = "flash",
     seq_axis: str | None = None,
     tp_axis: str | None = None,
-) -> tuple[Array, Array]:
+    return_kv: bool = False,
+) -> tuple[Array, Array] | tuple[Array, Array, tuple[Array, Array]]:
     """One transformer block: (layer params, (B, S, D)) -> (x, moe aux).
 
-    The single implementation of the layer body, shared by ``apply`` and the
-    pipeline-parallel stage runner (parallel/pipeline.py).
+    The single implementation of the layer body, shared by ``apply``, the
+    pipeline-parallel stage runner (parallel/pipeline.py), and — with
+    ``return_kv`` exposing the rotary-embedded K/V for cache seeding — the
+    decode prefill (generate.py).
     """
     b, s, d = x.shape
     # -- attention ---------------------------------------------------------
@@ -232,6 +235,8 @@ def block(
         down = (gate * up) @ lp["w_down"].astype(h.dtype)
     if tp_axis is not None:
         down = lax.psum(down, tp_axis)  # Megatron reduction 2
+    if return_kv:
+        return x + down, aux, (k, v)
     return x + down, aux
 
 
