@@ -79,3 +79,61 @@ class DataLoader:
         for start in range(0, end, self.batch_size):
             batch = idx[start : start + self.batch_size]
             yield self.dataset.images[batch], self.dataset.labels[batch]
+
+
+def prefetch(iterable, depth: int = 2):
+    """Run ``iterable`` in a background thread with a bounded queue.
+
+    The host-side analog of the reference's DataLoader worker processes
+    (reference main.py:85-90, num_workers=2): while the device executes the
+    current chunk, the next one is being assembled and transferred
+    (``jax.device_put`` is thread-safe and asynchronous), so input
+    preparation overlaps compute instead of serializing with it.
+    Exceptions in the producer re-raise at the consumer.
+    """
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone (an abandoned
+        generator must not leave the producer blocked holding staged device
+        buffers forever)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterable:
+                if not _put(item):
+                    return
+            _put(done)
+        except BaseException as e:  # surfaced at the consuming side
+            _put(("__prefetch_error__", e))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__prefetch_error__"):
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        while True:  # release any buffered references
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -48,6 +48,9 @@ class LMTrainConfig:
     model: tfm.TransformerConfig = field(
         default_factory=lambda: tfm.PRESETS["LM-tiny"])
     lr: float = 3e-4
+    warmup_steps: int = 0     # linear LR warmup
+    decay_steps: int = 0      # cosine decay horizon (0 = constant LR)
+    min_lr_ratio: float = 0.1
     weight_decay: float = 0.1
     b1: float = 0.9
     b2: float = 0.95
@@ -122,10 +125,23 @@ def _fsdp_gather(params: PyTree, specs: PyTree) -> PyTree:
     return jax.tree.map(gather, params, specs)
 
 
+def make_schedule(cfg: LMTrainConfig):
+    """Constant LR, or linear warmup + cosine decay to min_lr_ratio*lr."""
+    if cfg.decay_steps <= 0 and cfg.warmup_steps <= 0:
+        return cfg.lr
+    if cfg.decay_steps <= 0:
+        return optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=cfg.lr,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=cfg.decay_steps,
+        end_value=cfg.lr * cfg.min_lr_ratio)
+
+
 def make_optimizer(cfg: LMTrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(cfg.lr, b1=cfg.b1, b2=cfg.b2,
+        optax.adamw(make_schedule(cfg), b1=cfg.b1, b2=cfg.b2,
                     weight_decay=cfg.weight_decay),
     )
 
@@ -225,6 +241,30 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
     return step
 
 
+def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Forward-only masked-CE: (params, tokens, targets) -> (ce_sum, count),
+    globally reduced.  Works for the (data, seq, model) mesh; the pp layout
+    evaluates through pipeline_loss the same way."""
+    dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+    specs = param_specs(cfg)
+
+    def local_eval(params, tokens, targets):
+        if cfg.fsdp:
+            params = _fsdp_gather(params, specs)
+        pos0 = jax.lax.axis_index(SEQ) * tokens.shape[1]
+        logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
+                           seq_axis=SEQ if cfg.sp > 1 else None,
+                           tp_axis=MODEL, pos0=pos0)
+        ce, n = masked_ce(logits, targets)
+        return (jax.lax.psum(ce, (DATA, SEQ)),
+                jax.lax.psum(n, (DATA, SEQ)))
+
+    return jax.jit(shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(specs, P(DATA, SEQ), P(DATA, SEQ)),
+        out_specs=(P(), P())))
+
+
 class LMTrainer:
     """Owns (params, opt_state) laid out over the (data, seq, model) mesh —
     or the (data, pipe) mesh when cfg.pp > 1."""
@@ -273,7 +313,28 @@ class LMTrainer:
                           and self.mesh.devices.size > 1 else leaf),
             jax.jit(tx.init)(params))
         self.params = params
+        self._eval_fn = None
         self._step = 0
+
+    def evaluate(self, batches) -> dict[str, float]:
+        """Held-out loss/perplexity over an iterable of (tokens, targets)."""
+        if self.cfg.pp > 1:
+            raise NotImplementedError("evaluate() with pp>1: use the "
+                                      "(data, seq, model) layout for eval")
+        if self._eval_fn is None:
+            self._eval_fn = make_lm_eval_step(self.cfg, self.mesh)
+        shd = NamedSharding(self.mesh, P(DATA, SEQ))
+        total, count = 0.0, 0
+        for tokens, targets in batches:
+            if jax.process_count() > 1:
+                tokens = jax.make_array_from_process_local_data(shd, tokens)
+                targets = jax.make_array_from_process_local_data(shd, targets)
+            ce, n = self._eval_fn(self.params, tokens, targets)
+            total += float(ce)
+            count += int(n)
+        loss = total / max(count, 1)
+        return {"loss": loss, "ppl": float(np.exp(min(loss, 30.0))),
+                "tokens": count}
 
     # -- checkpointing ----------------------------------------------------
     def save_checkpoint(self, directory: str) -> None:

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global batch (sequences per step)")
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--decay-steps", type=int, default=0,
+                   help="cosine-decay horizon (0 = constant LR)")
     p.add_argument("--compute-dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--seed", type=int, default=1)
@@ -67,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="path to a text file (byte-level); default: "
                         "deterministic synthetic corpus")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="evaluate held-out loss/ppl every N steps (holds "
+                        "out the final 10%% of the corpus)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=200)
     # sampling after training
@@ -104,6 +110,7 @@ def main(argv: list[str] | None = None) -> int:
         model=model_config(args), lr=args.lr, seed=args.seed,
         compute_dtype=(None if args.compute_dtype == "float32"
                        else args.compute_dtype),
+        warmup_steps=args.warmup_steps, decay_steps=args.decay_steps,
         dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, fsdp=args.fsdp)
     trainer = LMTrainer(cfg)
     log.info("model: %s | mesh: dp=%d sp=%d tp=%d pp=%d over %d devices",
@@ -119,6 +126,27 @@ def main(argv: list[str] | None = None) -> int:
     corpus = lm_corpus.load_corpus(args.corpus)
     log.info("corpus: %d tokens (%s)", len(corpus),
              "synthetic" if corpus.synthetic else args.corpus)
+    val_loader = None
+    if args.eval_every > 0 and cfg.pp == 1:
+        # hold out the final 10% of the stream for evaluation
+        split = int(len(corpus) * 0.9)
+        val = lm_corpus.LMCorpus(corpus.tokens[split:], corpus.synthetic)
+        try:
+            candidate = lm_corpus.LMDataLoader(
+                val, args.batch_size // max(jax.process_count(), 1),
+                args.seq_len, num_replicas=max(jax.process_count(), 1),
+                rank=jax.process_index(), shuffle=False)
+        except ValueError:
+            candidate = None
+        if candidate is None or len(candidate) == 0:
+            log.warning(
+                "corpus too small for a 10%% eval holdout at --seq-len %d / "
+                "--batch-size %d; --eval-every disabled", args.seq_len,
+                args.batch_size)
+        else:
+            val_loader = candidate
+            corpus = lm_corpus.LMCorpus(corpus.tokens[:split],
+                                        corpus.synthetic)
     # each process feeds its host-local share of the global batch
     procs = jax.process_count()
     if args.batch_size % max(procs, 1):
@@ -157,6 +185,11 @@ def main(argv: list[str] | None = None) -> int:
             if (args.checkpoint_dir
                     and step % args.checkpoint_every == 0):
                 trainer.save_checkpoint(args.checkpoint_dir)
+            if (val_loader is not None
+                    and step % args.eval_every == 0):
+                m = trainer.evaluate(iter(val_loader))
+                log.info("step %d | val loss %.4f | ppl %.2f (%d tokens)",
+                         step, m["loss"], m["ppl"], m["tokens"])
             if step >= args.steps:
                 break
 

@@ -7,6 +7,7 @@ worker tears down the gang promptly instead of hanging (the reference's
 timeout=None behavior), and --max-restarts relaunches the gang.
 """
 
+import os
 import sys
 import time
 
@@ -151,3 +152,25 @@ def test_sigterm_to_launcher_tears_down_gang(tmp_path):
             break
         time.sleep(0.1)
     assert not alive, f"orphaned workers: {alive}"
+
+
+def test_two_process_distributed_training():
+    """Full multi-process integration: the launcher spawns a 2-process gang
+    that rendezvouses via jax.distributed, builds a mesh over both
+    processes' devices (2x2), assembles global batches from per-host shards,
+    and trains with cross-process collectives."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+         "--nproc-per-node", "2", "--master-port", "16731", "--",
+         "tests/workers/ddp_worker.py"],
+        cwd="/root/repo", capture_output=True, text=True, timeout=420,
+        env=dict(
+            {k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS",)},
+            PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""),
+        ),
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert proc.stdout.count("OK") == 2, proc.stdout

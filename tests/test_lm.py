@@ -178,3 +178,26 @@ def test_fsdp_checkpoint_roundtrip(tmp_path):
     la = float(a.train_step(tokens, targets))
     lb = float(b.train_step(tokens, targets))
     np.testing.assert_allclose(lb, la, rtol=1e-6)
+
+
+def test_evaluate_and_lr_schedule():
+    """Held-out eval returns finite loss/ppl consistent with exp(loss);
+    warmup schedule starts near zero so early steps barely move params."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.lm import make_schedule
+
+    model = tfm.TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
+                                  n_heads=2, head_dim=64)
+    tokens, targets = _data(b=4, s=128, vocab=512)
+    tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
+                                 dp=2, sp=2, tp=2))
+    tr.train_step(tokens, targets)
+    m = tr.evaluate([(tokens, targets)])
+    assert np.isfinite(m["loss"]) and m["tokens"] == 4 * 127
+    np.testing.assert_allclose(m["ppl"], np.exp(m["loss"]), rtol=1e-5)
+
+    sched = make_schedule(LMTrainConfig(lr=1e-3, warmup_steps=10,
+                                        decay_steps=100))
+    assert float(sched(0)) < 1e-4
+    np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-5)
+    assert float(sched(100)) < 2e-4  # decayed toward min_lr_ratio * lr

@@ -1,0 +1,53 @@
+"""Worker for the 2-process distributed-training integration test.
+
+Launched by distributed_pytorch_tpu.launch with env-var rendezvous; each
+process gets 2 fake CPU devices, so the gang trains over a real 2-process /
+4-device mesh: jax.distributed rendezvous, cross-process collectives, and
+the make_array_from_process_local_data batch-assembly path.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributed_pytorch_tpu.parallel import init as dist_init  # noqa: E402
+from distributed_pytorch_tpu.parallel.mesh import make_mesh  # noqa: E402
+from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
+
+
+def main() -> int:
+    dist_init.init_from_env(timeout_s=120)
+    rank, world = dist_init.process_info()
+    assert world == 2, world
+    n_dev = len(jax.devices())
+    assert n_dev == 4, f"expected 4 global devices, got {n_dev}"
+
+    mesh = make_mesh()
+    trainer = Trainer(TrainConfig(strategy="ddp", batch_size=4, lr=1e-3),
+                      mesh=mesh)
+    # per-host share of the global batch: local devices * per-replica batch
+    rng = np.random.default_rng(rank)
+    local = 2 * 4
+    losses = []
+    for _ in range(3):
+        images = rng.integers(0, 256, (local, 32, 32, 3)).astype(np.uint8)
+        labels = rng.integers(0, 10, local).astype(np.int32)
+        losses.append(float(trainer.train_step(images, labels)))
+    assert all(np.isfinite(losses)), losses
+    trainer.check_consistency()  # replicated state in sync across processes
+    print(f"worker rank={rank} OK losses={losses}", flush=True)
+    dist_init.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
