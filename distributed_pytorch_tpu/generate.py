@@ -28,8 +28,9 @@ PyTree = Any
 
 def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
                dtype=jnp.float32) -> PyTree:
-    """Zeroed per-layer K/V buffers, (B, H, max_len, head_dim)."""
-    shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    """Zeroed per-layer K/V buffers, (B, kv_heads, max_len, head_dim) —
+    GQA models cache only the kv heads."""
+    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
     return {
         f"layer{i}": {"k": jnp.zeros(shape, dtype),
                       "v": jnp.zeros(shape, dtype)}
@@ -83,8 +84,12 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
         cv = lax.dynamic_update_slice(
             c["v"], v.astype(c["v"].dtype), (0, 0, pos, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
-        o = attention_reference(q, ck.astype(q.dtype), cv.astype(q.dtype),
-                                bias=bias)
+        ka, va = ck.astype(q.dtype), cv.astype(q.dtype)
+        if cfg.kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.kv_heads
+            ka = jnp.repeat(ka, rep, axis=1)
+            va = jnp.repeat(va, rep, axis=1)
+        o = attention_reference(q, ka, va, bias=bias)
         x = x + jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
         h = tfm.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.is_moe_layer(i):

@@ -77,6 +77,15 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
                 "layers cannot stack into homogeneous pipeline stages")
         return make_mesh(cfg.dp * cfg.pp, axis_names=(DATA, PIPE),
                          axis_shape=(cfg.dp, cfg.pp), devices=devices)
+    if cfg.tp > 1:
+        if cfg.model.n_heads % cfg.tp:
+            raise ValueError(f"n_heads {cfg.model.n_heads} must divide over "
+                             f"tp={cfg.tp}")
+        if cfg.model.kv_heads % cfg.tp:
+            raise ValueError(
+                f"n_kv_heads {cfg.model.kv_heads} must divide over "
+                f"tp={cfg.tp} (replicating kv heads across tensor ranks is "
+                f"not supported; lower tp or raise n_kv_heads)")
     return make_mesh(cfg.dp * cfg.sp * cfg.tp,
                      axis_names=(DATA, SEQ, MODEL),
                      axis_shape=(cfg.dp, cfg.sp, cfg.tp),

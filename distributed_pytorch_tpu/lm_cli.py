@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-model", type=int, default=None)
     p.add_argument("--n-layers", type=int, default=None)
     p.add_argument("--n-heads", type=int, default=None)
+    p.add_argument("--n-kv-heads", type=int, default=None,
+                   help="grouped-query attention (default: n_heads)")
     p.add_argument("--head-dim", type=int, default=None)
     p.add_argument("--n-experts", type=int, default=None,
                    help="enable MoE layers with this many experts")
@@ -88,7 +90,8 @@ def model_config(args) -> tfm.TransformerConfig:
     cfg = tfm.PRESETS[args.preset]
     # byte-level corpus: the vocab is always 256
     overrides = {"vocab_size": lm_corpus.VOCAB_SIZE}
-    for field in ("d_model", "n_layers", "n_heads", "head_dim", "n_experts"):
+    for field in ("d_model", "n_layers", "n_heads", "n_kv_heads",
+                  "head_dim", "n_experts"):
         val = getattr(args, field)
         if val is not None:
             overrides[field] = val

@@ -46,6 +46,7 @@ class TransformerConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 4
+    n_kv_heads: int | None = None  # grouped-query attention (None = MHA)
     head_dim: int = 128   # MXU lane tile
     d_ff: int | None = None  # default 4*d_model
     rope_theta: float = 10_000.0
@@ -57,9 +58,19 @@ class TransformerConfig:
     moe_every: int = 2
     capacity_factor: float = 2.0
 
+    def __post_init__(self):
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(f"n_heads {self.n_heads} not divisible by "
+                             f"n_kv_heads {kv}")
+
     @property
     def ff(self) -> int:
         return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
 
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
@@ -79,6 +90,7 @@ def init(key: Array, cfg: TransformerConfig) -> PyTree:
     """Build the parameter pytree (same-seed construction on every replica,
     the reference's init-parity mechanism — SURVEY.md 2.3)."""
     d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.ff
+    kv = cfg.kv_heads
 
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -94,8 +106,8 @@ def init(key: Array, cfg: TransformerConfig) -> PyTree:
         layer = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": dense(next(keys), (d, h, dh), d),
-            "wk": dense(next(keys), (d, h, dh), d),
-            "wv": dense(next(keys), (d, h, dh), d),
+            "wk": dense(next(keys), (d, kv, dh), d),
+            "wv": dense(next(keys), (d, kv, dh), d),
             "wo": dense(next(keys), (h, dh, d), h * dh),
             "mlp_norm": jnp.ones((d,), jnp.float32),
         }
@@ -189,6 +201,13 @@ def block(
     v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
     q = rotary(q, pos, cfg.rope_theta)
     k = rotary(k, pos, cfg.rope_theta)
+    kv_cacheable = (k, v)  # kv_heads-sized, pre-repeat (the decode cache size)
+    if cfg.kv_heads != cfg.n_heads:
+        # GQA: q heads share repeated K/V heads (params and decode cache stay
+        # kv_heads-sized; the repeat is a view XLA folds into the attention)
+        rep = q.shape[1] // k.shape[1]  # local head counts (same under TP)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     if seq_axis is not None:
         o = ctx.ring_attention(q, k, v, seq_axis, causal=True)
     elif attn_impl == "flash":
@@ -236,7 +255,7 @@ def block(
     if tp_axis is not None:
         down = lax.psum(down, tp_axis)  # Megatron reduction 2
     if return_kv:
-        return x + down, aux, (k, v)
+        return x + down, aux, kv_cacheable
     return x + down, aux
 
 
