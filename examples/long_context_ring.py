@@ -1,0 +1,41 @@
+"""Ring-attention demo: attention over a sequence sharded across devices.
+
+Runs on any mesh — a virtual CPU mesh here so it works without a pod:
+the 8 devices each hold a 1/8 chunk of a 8192-token sequence, attention
+runs as a ring over ICI-equivalent collectives, and the result matches
+full attention computed on one device.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "tpu":
+    jax.config.update("jax_platforms", "cpu")
+
+from functools import partial  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from distributed_pytorch_tpu.ops.attention import attention_reference
+from distributed_pytorch_tpu.parallel.context import ring_attention
+
+B, H, S, D = 1, 4, 8192, 128
+mesh = Mesh(np.array(jax.devices()), ("seq",))
+key = jax.random.key(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, S, D),
+                             jnp.bfloat16) for i in range(3))
+
+ring = jax.jit(shard_map(
+    partial(ring_attention, axis="seq", causal=True),
+    mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+    out_specs=P(None, None, "seq")))
+out = ring(q, k, v)
+ref = attention_reference(q, k, v, causal=True)
+err = float(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)).max())
+print(f"ring attention over {len(jax.devices())} devices, S={S}: "
+      f"max err vs full attention = {err:.2e}")

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Transformer LM over a (data x seq x model) mesh: ring attention over the
+# sequence axis, Megatron tensor parallelism, DP gradient sync via autodiff.
+# On one chip the axes collapse to 1; on a pod slice set the products to the
+# chip count.  Add --fsdp for ZeRO-3, --n-experts 8 for MoE/EP, --pp N
+# (with sp=tp=1) for GPipe pipeline parallelism.
+python -m distributed_pytorch_tpu.lm_cli \
+  --preset LM-small --steps 1000 --batch-size 8 --seq-len 2048 \
+  --dp 1 --sp 1 --tp 1 \
+  --warmup-steps 100 --decay-steps 1000 --eval-every 200 \
+  --checkpoint-dir /tmp/lm_ckpt \
+  --generate "The world " --max-new 128 --temperature 0.8 "$@"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# VGG-11 / CIFAR-10 data-parallel training — the reference workload
+# (BrianZCS/distributed_pytorch main_ddp.py), TPU-native.
+# Single host (all local chips become DP replicas):
+python -m distributed_pytorch_tpu.cli --strategy ddp --epochs 1 \
+  --compute-dtype bfloat16 --checkpoint-dir /tmp/vgg_ckpt "$@"
+# Multi-host: run scripts/start_ddp.sh on every host with NODE_RANK set,
+# or pass --master-ip/--num-nodes/--rank per the reference contract.

@@ -102,3 +102,17 @@ def test_reference_lse():
     np.testing.assert_allclose(
         np.asarray(lse), np.asarray(jax.nn.logsumexp(s, -1)),
         atol=1e-5, rtol=1e-5)
+
+
+def test_default_blocks_fit_any_8_aligned_seq():
+    """Defaults auto-shrink to divide the sequence (e.g. 1536 is a multiple
+    of 256/512 but not of the 512/1024 defaults)."""
+    key = jax.random.key(5)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (1, 1, 192, 64))
+               for i in range(3))
+    ref = attn.attention_reference(q, k, v, causal=True)
+    out = attn.flash_attention(q, k, v, causal=True)  # default blocks
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="8-aligned"):
+        attn.flash_attention(q[:, :, :100], k[:, :, :100], v[:, :, :100])
