@@ -64,7 +64,7 @@ def build_parser() -> argparse.ArgumentParser:
     # Training hyper-parameters; defaults are the reference's exact values.
     p.add_argument("--strategy", default="ddp",
                    choices=["none", "gather_scatter", "all_reduce", "ddp",
-                            "bucketed"])
+                            "bucketed", "quantized"])
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106

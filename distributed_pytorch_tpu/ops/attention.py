@@ -358,13 +358,18 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def _fit_block(limit: int, s: int) -> int:
     """Largest 8-aligned divisor of ``s`` that is <= ``limit`` (block sizes
-    must tile the sequence exactly; 8 is the f32 sublane granule)."""
+    must tile the sequence exactly; 8 is the f32 sublane granule).
+
+    Refuses degenerate tilings: a block below 128 (one MXU lane tile) is
+    accepted only when it is the whole sequence — otherwise an awkward
+    length like 8*prime would silently run a pathologically tiny grid."""
     for b in range(min(limit, s), 7, -1):
-        if s % b == 0 and b % 8 == 0:
+        if s % b == 0 and b % 8 == 0 and (b >= 128 or b == s):
             return b
     raise ValueError(
-        f"sequence length {s} has no 8-aligned divisor <= {limit}; pad the "
-        f"sequence to a multiple of 8")
+        f"sequence length {s} has no MXU-friendly divisor <= {limit} "
+        f"(need an 8-aligned divisor >= 128, or s itself); pad the "
+        f"sequence or pass explicit block sizes")
 
 
 def flash_attention(
