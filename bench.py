@@ -58,8 +58,9 @@ def bench_tpu(batch_per_replica: int, warmup: int, iters: int) -> float:
 
     _log(f"[bench] platform={platform} devices={n_dev} "
          f"global_batch={global_batch} strategy={cfg.strategy}")
-    # Warm-up compiles the scan; repeat to absorb one-time costs.
-    for _ in range(max(warmup // iters, 1)):
+    # Warm-up (in steps): at least one full window so the scan is compiled
+    # and the caches are hot before the timed window.
+    for _ in range(max(round(warmup / iters), 1)):
         losses = trainer.train_steps(images, labels)
     float(losses[-1])
 
@@ -130,8 +131,11 @@ def bench_torch_cpu(batch: int, warmup: int, iters: int) -> float:
 
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    # iters=100 amortizes the single end-of-window host fetch (~10s of ms
+    # through a tunneled device) to sub-ms noise per step; warmup (steps)
+    # rounds to whole windows, minimum one.
+    warmup = int(os.environ.get("BENCH_WARMUP", "100"))
+    iters = int(os.environ.get("BENCH_ITERS", "100"))
 
     sps_chip = bench_tpu(batch, warmup, iters)
 

@@ -78,9 +78,15 @@ def _synthetic(n: int, seed: int) -> Dataset:
 
     Each class gets a fixed random 'template' image; samples are the template
     plus noise, so a real model can actually learn (used by loss-decreases
-    and loss-parity tests when the real dataset is unavailable)."""
+    and loss-parity tests when the real dataset is unavailable).
+
+    The templates ARE the class definition, so they come from a fixed seed
+    shared by every split; only the sample noise/labels vary with ``seed``
+    (otherwise train and test would be different classification problems
+    and test accuracy could never beat chance)."""
+    templates = np.random.default_rng(0).integers(
+        0, 256, (10, 32, 32, 3)).astype(np.float32)
     rng = np.random.default_rng(seed)
-    templates = rng.integers(0, 256, (10, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 10, n).astype(np.int32)
     noise = rng.normal(0, 64, (n, 32, 32, 3)).astype(np.float32)
     images = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
