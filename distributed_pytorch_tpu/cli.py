@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically if a checkpoint exists")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the first epoch")
+    p.add_argument("--shard-eval", action="store_true",
+                   help="shard the test set over the mesh (psum'd metrics) "
+                        "instead of the reference's redundant per-rank "
+                        "evaluation")
     p.add_argument("--debug-checks", action="store_true",
                    help="after each epoch, verify DP invariants: replicated "
                         "params/opt-state bitwise-identical on every device "
@@ -182,9 +186,15 @@ def main(argv: list[str] | None = None) -> int:
         if args.debug_checks:
             trainer.check_consistency()
             log.info("epoch %d: replica-consistency checks passed", epoch + 1)
-        evaluation.evaluate(
-            trainer.params, trainer.eval_state(), test_loader,
-            model_name=args.model, compute_dtype=cfg.dtype)
+        if args.shard_eval and trainer.mesh is not None:
+            evaluation.evaluate_sharded(
+                trainer.params, trainer.eval_state(), test_loader.dataset,
+                trainer.mesh, batch_size=args.batch_size,
+                model_name=args.model, compute_dtype=cfg.dtype)
+        else:
+            evaluation.evaluate(
+                trainer.params, trainer.eval_state(), test_loader,
+                model_name=args.model, compute_dtype=cfg.dtype)
         if ckpt is not None:
             ckpt.save(trainer, epoch + 1)
 
