@@ -147,6 +147,9 @@ def main(argv: list[str] | None = None) -> int:
             port=args.port, timeout_s=args.rendezvous_timeout)
     setup_logging(args.log_level)
     log = get_logger("cli")
+    if args.shard_eval and jax.process_count() > 1:
+        raise SystemExit("--shard-eval is single-process for now "
+                         "(fail fast, before a whole epoch is spent)")
 
     cfg = TrainConfig(
         model=args.model, lr=args.lr, momentum=args.momentum,
@@ -186,6 +189,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.debug_checks:
             trainer.check_consistency()
             log.info("epoch %d: replica-consistency checks passed", epoch + 1)
+        if args.shard_eval and trainer.mesh is None:
+            log.warning("--shard-eval ignored: strategy %s runs without a "
+                        "mesh", args.strategy)
         if args.shard_eval and trainer.mesh is not None:
             evaluation.evaluate_sharded(
                 trainer.params, trainer.eval_state(), test_loader.dataset,

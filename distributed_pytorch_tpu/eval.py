@@ -27,15 +27,37 @@ from .ops import nn as ops
 PyTree = Any
 
 
-@partial(jax.jit, static_argnames=("model_name", "dtype"))
-def _eval_batch(params, state, images, labels, mask, *, model_name, dtype):
+def _batch_metrics(params, state, images, labels, mask, *, model_name,
+                   dtype):
+    """Masked (ce_sum, correct, n_real) for one padded batch — the single
+    compute core behind both the replicated and the sharded eval paths."""
     x = aug.normalize(images)  # test transform: ToTensor+Normalize (main.py:80-82)
     logits, _ = vgg.apply(params, state, x, name=model_name, train=False,
                           dtype=dtype)
     ce = ops.cross_entropy_per_sample(logits, labels) * mask
     correct = jnp.sum((jnp.argmax(logits, axis=-1) == labels) * mask)
+    return jnp.sum(ce), correct, jnp.sum(mask)
+
+
+def _pad_batch(images, labels, batch_size):
+    """Pad a ragged batch to the static shape + validity mask."""
+    n = len(labels)
+    if n < batch_size:
+        pad = batch_size - n
+        images = np.concatenate(
+            [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+        labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+    mask = (np.arange(batch_size) < n).astype(np.float32)
+    return images, labels, mask, n
+
+
+@partial(jax.jit, static_argnames=("model_name", "dtype"))
+def _eval_batch(params, state, images, labels, mask, *, model_name, dtype):
+    ce_sum, correct, n_real = _batch_metrics(
+        params, state, images, labels, mask, model_name=model_name,
+        dtype=dtype)
     # per-batch mean over real samples == torch CrossEntropyLoss reduction
-    return jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1), correct
+    return ce_sum / jnp.maximum(n_real, 1), correct
 
 
 def evaluate(params: PyTree, state: PyTree, loader, *,
@@ -52,13 +74,7 @@ def evaluate(params: PyTree, state: PyTree, loader, *,
     for images, labels in loader:
         if batch_size is None:
             batch_size = len(labels)
-        n = len(labels)
-        if n < batch_size:  # pad ragged last batch to the static shape
-            pad = batch_size - n
-            images = np.concatenate([images, np.zeros((pad,) + images.shape[1:],
-                                                      images.dtype)])
-            labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
-        mask = (np.arange(batch_size) < n).astype(np.float32)
+        images, labels, mask, n = _pad_batch(images, labels, batch_size)
         loss, corr = _eval_batch(params, state, jnp.asarray(images),
                                  jnp.asarray(labels), jnp.asarray(mask),
                                  model_name=model_name, dtype=compute_dtype)
@@ -74,6 +90,31 @@ def evaluate(params: PyTree, state: PyTree, loader, *,
     return avg_loss, acc
 
 
+@partial(jax.jit, static_argnames=("mesh", "model_name", "dtype"))
+def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
+                   dtype):
+    """Mesh-sharded (ce_sum, correct, n_real) — jit-cached across epochs
+    (mesh/model/dtype are hashable statics, so repeat calls reuse the
+    executable instead of recompiling per evaluate_sharded call)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.mesh import DATA_AXIS
+
+    def shard_fn(params, state, images, labels, mask):
+        ce_sum, correct, n_real = _batch_metrics(
+            params, state, images, labels, mask, model_name=model_name,
+            dtype=dtype)
+        return (jax.lax.psum(ce_sum, DATA_AXIS),
+                jax.lax.psum(correct, DATA_AXIS),
+                jax.lax.psum(n_real, DATA_AXIS))
+
+    return shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P("data"), P("data"), P("data")),
+        out_specs=(P(), P(), P()))(params, state, images, labels, mask)
+
+
 def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
                      batch_size: int = 256, model_name: str = "VGG11",
                      compute_dtype: jnp.dtype | None = None,
@@ -84,16 +125,13 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
     main_gather.py:131); ``evaluate`` above keeps that replicated semantic,
     this is the capability upgrade behind a flag.
 
-    Loss definition matches ``evaluate``: sum of per-(global-)batch mean
-    losses over real samples, divided by batch count.
+    Loss definition matches ``evaluate`` (sum of per-batch mean losses over
+    real samples / batch count), enforced by requiring device-divisible
+    batches so batch boundaries are identical.  Single-process only (the
+    batches are host-local numpy; multi-host needs global-array assembly).
+    ``state`` is the unstacked rank-0 BN state, exactly as ``evaluate``
+    takes it (replicated onto every shard by the P() in_spec).
     """
-    from functools import partial as _partial
-
-    from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from .parallel.mesh import DATA_AXIS
-
     if jax.process_count() > 1:
         raise NotImplementedError(
             "--shard-eval is single-process for now: the eval batches are "
@@ -101,59 +139,21 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
             "data assembly (as Trainer._stage does) for a multi-host mesh")
     n_dev = mesh.devices.size
     if batch_size % max(n_dev, 1):
-        # keep batch boundaries (and therefore the per-batch-mean loss
-        # definition) identical to `evaluate`
         raise ValueError(f"batch_size {batch_size} must be divisible by the "
                          f"{n_dev}-device mesh for loss parity with "
                          f"evaluate()")
-    per_dev = batch_size // max(n_dev, 1)
-    global_batch = per_dev * n_dev
-
-    @_partial(jax.jit, static_argnames=("model_name", "dtype"))
-    def batch_metrics(params, state, images, labels, mask, *, model_name,
-                      dtype):
-        def shard_fn(params, state, images, labels, mask):
-            local_state = jax.tree.map(lambda s: s[0], state)
-            x = aug.normalize(images)
-            logits, _ = vgg.apply(params, local_state, x, name=model_name,
-                                  train=False, dtype=dtype)
-            ce = ops.cross_entropy_per_sample(logits, labels) * mask
-            correct = jnp.sum(
-                (jnp.argmax(logits, axis=-1) == labels) * mask)
-            return (jax.lax.psum(jnp.sum(ce), DATA_AXIS),
-                    jax.lax.psum(correct, DATA_AXIS),
-                    jax.lax.psum(jnp.sum(mask), DATA_AXIS))
-
-        return shard_map(
-            shard_fn, mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=(P(), P(), P()))(params, state, images, labels, mask)
-
-    # state arrives replicated per-device stacked (leading axis) like the
-    # trainer's; eval uses rank 0's stats on every shard for parity with
-    # `evaluate` (DDP buffer-broadcast convention)
-    state = jax.tree.map(
-        lambda s: jnp.broadcast_to(jnp.asarray(s)[None],
-                                   (n_dev,) + np.asarray(s).shape), state)
-    state = jax.device_put(state, NamedSharding(mesh, P(DATA_AXIS)))
 
     total_loss, correct, total, n_batches = 0.0, 0, 0, 0
     images_all, labels_all = dataset.images, dataset.labels
-    for start in range(0, len(labels_all), global_batch):
-        images = images_all[start:start + global_batch]
-        labels = labels_all[start:start + global_batch]
-        n = len(labels)
-        if n < global_batch:
-            pad = global_batch - n
-            images = np.concatenate(
-                [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
-            labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
-        mask = (np.arange(global_batch) < n).astype(np.float32)
-        ce_sum, corr, msum = batch_metrics(
+    for start in range(0, len(labels_all), batch_size):
+        images, labels, mask, n = _pad_batch(
+            images_all[start:start + batch_size],
+            labels_all[start:start + batch_size], batch_size)
+        ce_sum, corr, n_real = _sharded_batch(
             params, state, jnp.asarray(images), jnp.asarray(labels),
-            jnp.asarray(mask), model_name=model_name, dtype=compute_dtype)
-        total_loss += float(ce_sum) / max(float(msum), 1.0)
+            jnp.asarray(mask), mesh=mesh, model_name=model_name,
+            dtype=compute_dtype)
+        total_loss += float(ce_sum) / max(float(n_real), 1.0)
         correct += int(corr)
         total += n
         n_batches += 1

@@ -39,20 +39,26 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
 
 
 def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig):
-    """Capacity-free MoE for decode: run all experts, one-hot combine."""
+    """Capacity-free MoE for decode: run all experts, top-k one-hot combine
+    (matches training routing — Switch gates for top_k=1, pair-normalized
+    gates for top_k=2)."""
     b, s, d = h.shape
     hf = h.reshape(b * s, d)
     probs = jax.nn.softmax(
         hf.astype(jnp.float32) @ lp["moe"]["router"].astype(jnp.float32), -1)
-    gate = jnp.max(probs, -1)
-    onehot = jax.nn.one_hot(jnp.argmax(probs, -1), cfg.n_experts,
-                            dtype=hf.dtype)
+    k = cfg.moe_top_k
+    top_probs, top_idx = jax.lax.top_k(probs, k)
+    if k > 1:
+        top_probs = top_probs / jnp.sum(top_probs, -1, keepdims=True)
+    weights = jnp.einsum(
+        "tk,tke->te", top_probs,
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32))
     g = jax.nn.silu(jnp.einsum("td,edf->tef", hf,
                                lp["moe"]["w_gate"].astype(hf.dtype)))
     u = jnp.einsum("td,edf->tef", hf, lp["moe"]["w_up"].astype(hf.dtype))
     y = jnp.einsum("tef,efd->ted", g * u,
                    lp["moe"]["w_down"].astype(hf.dtype))
-    out = jnp.einsum("te,ted->td", onehot * gate.astype(hf.dtype)[:, None], y)
+    out = jnp.einsum("te,ted->td", weights.astype(hf.dtype), y)
     return out.reshape(b, s, d)
 
 

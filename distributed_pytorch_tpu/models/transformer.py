@@ -56,6 +56,7 @@ class TransformerConfig:
     # experts shard over the tensor axis (ops/moe.py).
     n_experts: int = 0
     moe_every: int = 2
+    moe_top_k: int = 1   # 1 = Switch routing, 2 = classic top-2
     capacity_factor: float = 2.0
 
     def __post_init__(self):
@@ -238,7 +239,8 @@ def block(
             h_loc = lax.dynamic_slice_in_dim(hf, idx * t_loc, t_loc)
             out_loc, aux = moe_ops.moe_apply(
                 lp["moe"], h_loc, n_experts=cfg.n_experts,
-                capacity_factor=cfg.capacity_factor, axis=tp_axis)
+                capacity_factor=cfg.capacity_factor, axis=tp_axis,
+                top_k=cfg.moe_top_k)
             down = jnp.zeros_like(hf)
             down = lax.dynamic_update_slice_in_dim(
                 down, out_loc, idx * t_loc, 0)
@@ -246,7 +248,8 @@ def block(
         else:
             down, aux = moe_ops.moe_apply(
                 lp["moe"], hf, n_experts=cfg.n_experts,
-                capacity_factor=cfg.capacity_factor, axis=None)
+                capacity_factor=cfg.capacity_factor, axis=None,
+                top_k=cfg.moe_top_k)
         down = down.reshape(b, s, d)
     else:
         gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))

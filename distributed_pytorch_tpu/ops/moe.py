@@ -60,40 +60,57 @@ def moe_apply(
     n_experts: int,                # GLOBAL expert count E
     capacity_factor: float = 2.0,
     axis: str | None = None,       # expert-parallel mesh axis
+    top_k: int = 1,                # 1 = Switch, 2 = classic top-2 MoE
 ) -> tuple[Array, Array]:
     """Returns (out (T, D), load-balance aux loss scalar).
 
     Without ``axis``, ``params`` holds all E experts.  With ``axis``,
     ``params['w_*']`` hold this device's E/n expert shard and tokens are
     exchanged over the axis with all_to_all.
+
+    ``top_k=2`` routes each token to its two best experts with gates
+    normalized over the chosen pair (Shazeer-style); choice-2 tokens fill
+    expert slots after every choice-1 token (lower drop priority).
     """
     t, d = x.shape
     e = n_experts
     n = lax.axis_size(axis) if axis is not None else 1
     if e % n:
         raise ValueError(f"{e} experts do not shard over {n} devices")
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     e_local = e // n
-    cap = max(1, math.ceil(t * capacity_factor / e))
+    cap = max(1, math.ceil(t * top_k * capacity_factor / e))
 
     # -- routing (f32 for a stable softmax) --------------------------------
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
-    gate = jnp.max(probs, axis=-1)                       # (T,)
-    expert = jnp.argmax(probs, axis=-1)                  # (T,)
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    top_probs, top_idx = jax.lax.top_k(probs, top_k)     # (T, K)
+    if top_k == 1:
+        gates = top_probs                                # Switch: raw prob
+    else:
+        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+    onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T, K, E)
 
-    # Switch load-balance aux: E * sum_e (fraction routed) * (mean prob).
-    frac = jnp.mean(onehot, axis=0)
+    # Load-balance aux over the primary assignment (Switch normalization:
+    # a perfectly uniform router gives aux == 1).
+    frac = jnp.mean(onehots[:, 0], axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac * mean_prob)
 
     # -- capacity & dispatch tensor (T, E, C) ------------------------------
-    pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based slot
+    # Slot assignment: all choice-1 tokens first (stream order), then
+    # choice-2 tokens fill what remains — choice-2 drops first under
+    # pressure, the standard top-2 priority.
+    flat = onehots.transpose(1, 0, 2).reshape(top_k * t, e)  # (K*T, E)
+    pos = (jnp.cumsum(flat, axis=0) * flat).reshape(top_k, t, e)
     keep = (pos > 0) & (pos <= cap)
-    slot = (pos - 1).astype(jnp.int32)                   # -1 when unrouted
-    dispatch = jax.nn.one_hot(slot, cap, dtype=x.dtype) * keep[
-        ..., None].astype(x.dtype)                       # (T, E, C)
-    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+    slot = (pos - 1).astype(jnp.int32)
+    dispatch_k = jax.nn.one_hot(slot, cap, dtype=x.dtype) * keep[
+        ..., None].astype(x.dtype)                       # (K, T, E, C)
+    dispatch = jnp.sum(dispatch_k, axis=0)               # (T, E, C)
+    combine = jnp.einsum("ktec,tk->tec", dispatch_k,
+                         gates.astype(x.dtype))
 
     xin = jnp.einsum("tec,td->ecd", dispatch, x)         # (E, C, D)
 

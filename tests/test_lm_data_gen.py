@@ -105,7 +105,8 @@ def test_greedy_generation_is_deterministic_argmax():
 
 def test_moe_model_generates():
     cfg = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
-                                n_heads=2, head_dim=64, n_experts=4)
+                                n_heads=2, head_dim=64, n_experts=4,
+                                moe_top_k=2)
     params = tfm.init(jax.random.key(0), cfg)
     prompt = jnp.zeros((1, 4), jnp.int32)
     out = gen.generate(params, prompt, jax.random.key(0), cfg=cfg,
