@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .models import transformer as tfm
 from .ops.nn import IGNORE_INDEX, masked_ce
+from .parallel import context as ctx
 from .parallel.mesh import make_mesh
 
 PyTree = Any
@@ -65,6 +66,11 @@ class LMTrainConfig:
     pp: int = 1          # pipeline stages (GPipe); requires sp == tp == 1
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
+    # Ring-attention sequence layout when sp > 1: 'zigzag' (balanced causal
+    # ring, ~2x fewer attention FLOPs — parallel/context.py) or 'contiguous'.
+    # The step permutes the global token stream in-jit to match; the loss is
+    # permutation-invariant, so trajectories equal the contiguous layout.
+    seq_layout: str = "zigzag"
 
 
 def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
@@ -134,6 +140,33 @@ def _fsdp_gather(params: PyTree, specs: PyTree) -> PyTree:
     return jax.tree.map(gather, params, specs)
 
 
+def _zigzag_global(cfg: LMTrainConfig, x: jax.Array) -> jax.Array:
+    """Permute the GLOBAL sequence axis into the zigzag ring layout,
+    inside jit (before shard_map).  Operating on the logical global array
+    makes the layout correct for any process topology — multi-host runs
+    where the seq axis spans processes included (a host-side permute of
+    process-local slices would scramble the layout there).  XLA compiles
+    the cross-shard gather; tokens are int32, so the exchange is tiny
+    next to one layer's activations.  Identity unless sp > 1 and the
+    layout is zigzag."""
+    if cfg.sp <= 1 or cfg.seq_layout != "zigzag":
+        return x
+    perm = ctx.zigzag_permutation(cfg.sp, x.shape[1])  # trace-time constant
+    return x[:, perm]
+
+
+def _shard_positions(cfg: LMTrainConfig, s_local: int) -> jax.Array:
+    """This seq-shard's absolute token positions (inside shard_map).
+
+    Contiguous: [me*s_local, (me+1)*s_local).  Zigzag: the shard holds
+    global chunks [me, 2*sp-1-me] (parallel/context.py zigzag layout).
+    """
+    me = jax.lax.axis_index(SEQ)
+    if cfg.sp > 1 and cfg.seq_layout == "zigzag":
+        return ctx.zigzag_positions(me, cfg.sp, s_local)
+    return me * s_local + jnp.arange(s_local)
+
+
 def make_schedule(cfg: LMTrainConfig):
     """Constant LR, or linear warmup + cosine decay to min_lr_ratio*lr."""
     if cfg.decay_steps <= 0 and cfg.warmup_steps <= 0:
@@ -171,10 +204,10 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     def local_loss(params, tokens, targets):
         if cfg.fsdp:
             params = _fsdp_gather(params, specs)
-        s_local = tokens.shape[1]
-        pos0 = jax.lax.axis_index(SEQ) * s_local
+        pos = _shard_positions(cfg, tokens.shape[1])
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
-                                seq_axis=seq_axis, tp_axis=tp_axis, pos0=pos0,
+                                seq_axis=seq_axis, seq_layout=cfg.seq_layout,
+                                tp_axis=tp_axis, pos=pos,
                                 return_aux=True)
         ce_sum, n = masked_ce(logits, targets)
         # Global mean over every shard's tokens (loss is axis-invariant;
@@ -195,6 +228,8 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
+        tokens = _zigzag_global(cfg, tokens)
+        targets = _zigzag_global(cfg, targets)
         loss, grads = grad_step(params, tokens, targets)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -260,18 +295,25 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
     def local_eval(params, tokens, targets):
         if cfg.fsdp:
             params = _fsdp_gather(params, specs)
-        pos0 = jax.lax.axis_index(SEQ) * tokens.shape[1]
+        pos = _shard_positions(cfg, tokens.shape[1])
         logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                            seq_axis=SEQ if cfg.sp > 1 else None,
-                           tp_axis=MODEL, pos0=pos0)
+                           seq_layout=cfg.seq_layout, tp_axis=MODEL, pos=pos)
         ce, n = masked_ce(logits, targets)
         return (jax.lax.psum(ce, (DATA, SEQ)),
                 jax.lax.psum(n, (DATA, SEQ)))
 
-    return jax.jit(shard_map(
+    sharded_eval = shard_map(
         local_eval, mesh=mesh,
         in_specs=(specs, P(DATA, SEQ), P(DATA, SEQ)),
-        out_specs=(P(), P())))
+        out_specs=(P(), P()))
+
+    @jax.jit
+    def eval_step(params, tokens, targets):
+        return sharded_eval(params, _zigzag_global(cfg, tokens),
+                            _zigzag_global(cfg, targets))
+
+    return eval_step
 
 
 class LMTrainer:
@@ -344,6 +386,7 @@ class LMTrainer:
         loss = total / max(count, 1)
         return {"loss": loss, "ppl": float(np.exp(min(loss, 30.0))),
                 "tokens": count}
+
 
     # -- checkpointing ----------------------------------------------------
     def save_checkpoint(self, directory: str) -> None:

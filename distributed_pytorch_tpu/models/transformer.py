@@ -15,9 +15,11 @@ code runs single-device, tensor-parallel, sequence-parallel, or both:
   rows for w_down).  The only communication is one ``psum`` after the
   attention out-projection and one after the MLP down-projection — the
   standard Megatron factoring, here compiled by XLA over ICI.
-- ``seq_axis``: activations hold this device's contiguous sequence chunk;
-  attention runs as a ring over the axis (parallel/context.py).  ``pos0``
-  carries the chunk's absolute position offset for rotary embeddings.
+- ``seq_axis``: activations hold this device's sequence chunk — laid out
+  per ``seq_layout`` ('contiguous', or the balanced 'zigzag' ring layout of
+  parallel/context.py) — and attention runs as a ring over the axis.
+  ``pos0`` (contiguous offset) or ``pos`` (explicit positions, required for
+  zigzag) carries the chunk's absolute positions for rotary embeddings.
 
 Head dim defaults to 128 — one MXU lane tile — and d_ff to 4*d_model.
 """
@@ -184,6 +186,7 @@ def block(
     pos: Array,
     attn_impl: str = "flash",
     seq_axis: str | None = None,
+    seq_layout: str = "contiguous",
     tp_axis: str | None = None,
     return_kv: bool = False,
 ) -> tuple[Array, Array] | tuple[Array, Array, tuple[Array, Array]]:
@@ -210,7 +213,9 @@ def block(
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if seq_axis is not None:
-        o = ctx.ring_attention(q, k, v, seq_axis, causal=True)
+        o = ctx.ring_attention(
+            q, k, v, seq_axis, causal=True, layout=seq_layout,
+            impl="flash" if attn_impl == "flash" else "reference")
     elif attn_impl == "flash":
         o = attn_ops.flash_attention(q, k, v, causal=True)
     else:
@@ -270,14 +275,18 @@ def apply(
     dtype: jnp.dtype | None = None,
     attn_impl: str = "flash",      # 'flash' (Pallas) | 'reference' (XLA)
     seq_axis: str | None = None,   # ring-attention sequence parallelism
+    seq_layout: str = "contiguous",  # ring chunk layout (see parallel/context)
     tp_axis: str | None = None,    # Megatron tensor parallelism
     pos0: Array | int = 0,         # absolute position of tokens[:, 0]
+    pos: Array | None = None,      # explicit absolute positions (S,)
     return_aux: bool = False,
 ) -> Array | tuple[Array, Array]:
     """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
 
-    Under ``seq_axis``, ``tokens`` is this device's contiguous chunk and
-    ``pos0`` its global offset; logits come back chunk-sharded the same way.
+    Under ``seq_axis``, ``tokens`` is this device's sequence chunk laid out
+    per ``seq_layout`` ('contiguous': one chunk whose global offset is
+    ``pos0``; 'zigzag': the balanced ring layout — pass the chunk's global
+    positions via ``pos``); logits come back chunk-sharded the same way.
     Under ``tp_axis``, the weights are the local head/FFN shards and two
     psums restore the full residual stream (MoE layers additionally
     expert-shard over the axis and exchange tokens with all_to_all).
@@ -289,13 +298,15 @@ def apply(
     x = params["embed"][tokens]  # (B, S, D)
     if dtype is not None:
         x = x.astype(dtype)
-    pos = pos0 + jnp.arange(x.shape[1])
+    if pos is None:
+        pos = pos0 + jnp.arange(x.shape[1])
     aux_total = jnp.zeros((), jnp.float32)
 
     for i in range(cfg.n_layers):
         x, aux = block(
             params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
-            pos=pos, attn_impl=attn_impl, seq_axis=seq_axis, tp_axis=tp_axis)
+            pos=pos, attn_impl=attn_impl, seq_axis=seq_axis,
+            seq_layout=seq_layout, tp_axis=tp_axis)
         aux_total = aux_total + aux
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

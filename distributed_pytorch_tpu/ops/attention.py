@@ -50,22 +50,17 @@ def _interpret_default() -> bool:
 def attention_reference(
     q: Array, k: Array, v: Array, *, causal: bool = False,
     sm_scale: float | None = None, with_lse: bool = False,
-    bias: Array | None = None,
 ):
     """Plain XLA attention over (B, H, S, D) tensors.
 
     Scores and softmax in float32 regardless of input dtype.  With
     ``with_lse`` also returns the row logsumexp (B, H, Sq) — the quantity
     ring attention needs to merge partial results across sequence chunks.
-    ``bias`` is an additive score bias broadcastable to (B, H, Sq, Sk)
-    (e.g. a NEG_INF mask for cross-chunk causality in ring attention).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
-    if bias is not None:
-        s = s + bias.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -101,7 +96,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     # Causal: the whole k block is masked iff its first key comes after the
     # last query of this q block — skip the compute (the grid still visits).
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    # The non-causal predicate is traced-true rather than literal True:
+    # pl.when(True) inlines the body, and the Pallas HLO interpreter's vma
+    # check then rejects block loads on shard_map-varying inputs (a traced
+    # cond keeps CPU interpret tests working; Mosaic folds it on TPU).
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
 
     @pl.when(live)
     def _compute():
@@ -187,10 +186,15 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
 # Flash attention: backward kernels (recompute p from q,k + saved lse)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_ref, *, sm_scale: float, causal: bool,
                    block_q: int, block_k: int):
-    """Grid (BH, num_q, num_k), k innermost: accumulate dQ for one q block."""
+    """Grid (BH, num_q, num_k), k innermost: accumulate dQ for one q block.
+
+    ``delta`` is precomputed outside the kernel as rowsum(do*o) - dlse, so
+    one kernel serves both the o-only VJP (dlse = 0) and the (o, lse) VJP
+    ring attention differentiates through (the lse cotangent folds into ds
+    as ds = p * (dp - delta) exactly)."""
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -198,7 +202,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
 
     @pl.when(live)
     def _compute():
@@ -214,8 +218,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
             s = jnp.where(qi >= kj, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, None])        # (bq, bk)
         do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
-        delta = jnp.sum(do * o, axis=1, keepdims=True)  # (bq, 1)
+        delta = delta_ref[0, 0][:, None]               # (bq, 1)
         dp = jax.lax.dot_general(
             do.astype(v_ref.dtype), v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (bq, bk)
@@ -229,7 +232,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, sm_scale: float, causal: bool,
                     block_q: int, block_k: int):
@@ -242,7 +245,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    live = (i * block_q + block_q - 1 >= j * block_k) if causal else (i >= 0)
 
     @pl.when(live)
     def _compute():
@@ -258,8 +261,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             s = jnp.where(qi >= kj, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, None])        # (bq, bk)
         do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
-        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        delta = delta_ref[0, 0][:, None]               # (bq, 1)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # (bk, d)
@@ -277,13 +279,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, grads):
+def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, do,
+         dlse=None):
     q, k, v, o, lse = residuals
-    do = grads
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     vma = _vma(q, k, v, o, do, lse)
+
+    # delta = rowsum(do*o) - dlse, packed (bh, 8, sq) like lse.  Folding the
+    # lse cotangent here is exact: d s from lse is dlse*p, so
+    # ds = p*(dp - rowsum(do*o)) + dlse*p = p*(dp - delta).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+        vma = vma | _vma(dlse)
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -294,14 +305,14 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, grads):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -312,7 +323,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, grads):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
             pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
@@ -328,7 +339,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, grads):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -356,6 +367,31 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """(o, lse) variant: lse is a differentiable OUTPUT (its cotangent from
+    an online-softmax merge folds into the backward's delta term) — the
+    kernel form ring attention needs (parallel/context.py)."""
+    o, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, lse[:, 0]
+
+
+def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return (o, lse[:, 0]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    do, dlse = g
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do,
+                dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def _fit_block(limit: int, s: int) -> int:
     """Largest 8-aligned divisor of ``s`` that is <= ``limit`` (block sizes
     must tile the sequence exactly; 8 is the f32 sublane granule).
@@ -379,13 +415,18 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
-) -> Array:
+    with_lse: bool = False,
+) -> Array | tuple[Array, Array]:
     """Tiled attention over (B, H, S, D); differentiable (custom VJP).
 
     Default block sizes auto-shrink to the largest 8-aligned divisor of each
     sequence length; explicitly passed blocks must divide the lengths
     exactly.  Off-TPU the kernels run in Pallas interpret mode so CPU tests
     exercise the exact same code path.
+
+    With ``with_lse`` also returns the row logsumexp (B, H, S) as a second
+    differentiable output — the contract ring attention's online-softmax
+    merge needs (the lse cotangent is handled exactly in the backward).
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, S, D) q, got {q.shape}")
@@ -405,7 +446,11 @@ def flash_attention(
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _interpret_default()
-    o = _flash(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
-               v.reshape(b * h, sk, d), sm_scale, causal,
-               block_q, block_k, interpret)
+    qf, kf, vf = (q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+                  v.reshape(b * h, sk, d))
+    if with_lse:
+        o, lse = _flash_lse(qf, kf, vf, sm_scale, causal,
+                            block_q, block_k, interpret)
+        return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+    o = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, interpret)
     return o.reshape(b, h, sq, d)

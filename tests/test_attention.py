@@ -104,6 +104,42 @@ def test_reference_lse():
         atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_with_lse_matches_reference(causal):
+    q, k, v = _qkv()
+    o_ref, lse_ref = attn.attention_reference(q, k, v, causal=causal,
+                                              with_lse=True)
+    o, lse = attn.flash_attention(q, k, v, causal=causal, block_q=128,
+                                  block_k=128, with_lse=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_lse_cotangent_matches_reference(causal):
+    """Loss uses BOTH outputs, so the backward must handle the lse cotangent
+    — the exact contract of ring attention's online-softmax merge."""
+    q, k, v = _qkv()
+
+    def loss(f):
+        def inner(q, k, v):
+            o, lse = f(q, k, v)
+            return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+        return inner
+
+    ref_fn = loss(lambda q, k, v: attn.attention_reference(
+        q, k, v, causal=causal, with_lse=True))
+    fl_fn = loss(lambda q, k, v: attn.flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, with_lse=True))
+    g_ref = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_default_blocks_fit_any_8_aligned_seq():
     """Defaults auto-shrink to divide the sequence (e.g. 1536 is a multiple
     of 256/512 but not of the 512/1024 defaults)."""

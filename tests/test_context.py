@@ -15,7 +15,9 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_pytorch_tpu.ops.attention import attention_reference
-from distributed_pytorch_tpu.parallel.context import _merge, ring_attention
+from distributed_pytorch_tpu.parallel.context import (
+    _merge, inverse_zigzag_permutation, ring_attention, zigzag_permutation,
+    zigzag_positions)
 
 B, H, S, D = 2, 2, 256, 64
 
@@ -75,6 +77,84 @@ def test_ring_degenerate_single_device_axis():
         np.asarray(ring(q, k, v)),
         np.asarray(attention_reference(q, k, v, causal=True)),
         atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["reference", "flash"])
+def test_zigzag_ring_matches_full_attention(impl):
+    """The zigzag layout: permute the global sequence, run the ring, undo
+    the permutation — must equal full causal attention in original order."""
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv()
+    perm = zigzag_permutation(n, S)
+    inv = inverse_zigzag_permutation(n, S)
+    ring = jax.jit(shard_map(
+        partial(ring_attention, axis="seq", causal=True, impl=impl,
+                layout="zigzag"),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq")))
+    out = ring(q[:, :, perm], k[:, :, perm], v[:, :, perm])[:, :, inv]
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["reference", "flash"])
+def test_zigzag_ring_gradients_match(impl):
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv()
+    perm = zigzag_permutation(n, S)
+    inv = inverse_zigzag_permutation(n, S)
+    ring = jax.jit(shard_map(
+        partial(ring_attention, axis="seq", causal=True, impl=impl,
+                layout="zigzag"),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq")))
+
+    def ring_loss(q, k, v):
+        out = ring(q[:, :, perm], k[:, :, perm], v[:, :, perm])[:, :, inv]
+        return jnp.sum(jnp.sin(out))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(jnp.sin(attention_reference(q, k, v, causal=True)))
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_contiguous_flash_ring_matches():
+    mesh = _mesh(4)
+    q, k, v = _qkv()
+    ring = jax.jit(shard_map(
+        partial(ring_attention, axis="seq", causal=True, impl="flash"),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq")))
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(attention_reference(q, k, v, causal=True)),
+        atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_permutation_roundtrip_and_positions():
+    n, s = 4, 64
+    perm = zigzag_permutation(n, s)
+    inv = inverse_zigzag_permutation(n, s)
+    np.testing.assert_array_equal(perm[inv], np.arange(s))
+    assert sorted(perm.tolist()) == list(range(s))
+    # Device r's slice of the permuted sequence holds chunks [r, 2n-1-r].
+    s_local, c = s // n, s // (2 * n)
+    for r in range(n):
+        got = perm[r * s_local:(r + 1) * s_local]
+        want = np.concatenate([np.arange(r * c, (r + 1) * c),
+                               np.arange((2 * n - 1 - r) * c,
+                                         (2 * n - r) * c)])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(
+            np.asarray(zigzag_positions(r, n, s_local)), want)
 
 
 def test_merge_is_associative_softmax_combine():
