@@ -49,6 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--head-dim", type=int, default=None)
     p.add_argument("--n-experts", type=int, default=None,
                    help="enable MoE layers with this many experts")
+    p.add_argument("--moe-top-k", type=int, default=None,
+                   help="experts per token (1=Switch, 2=top-2)")
+    p.add_argument("--moe-router", default=None,
+                   choices=["tokens", "experts"],
+                   help="'tokens' (top-k choice) or 'experts' "
+                        "(expert-choice routing)")
+    p.add_argument("--router-z-coef", type=float, default=None,
+                   help="router z-loss weight relative to the aux weight "
+                        "(ST-MoE uses 0.1: z weight = 0.1 * aux_coef)")
     # parallelism
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
@@ -91,7 +100,8 @@ def model_config(args) -> tfm.TransformerConfig:
     # byte-level corpus: the vocab is always 256
     overrides = {"vocab_size": lm_corpus.VOCAB_SIZE}
     for field in ("d_model", "n_layers", "n_heads", "n_kv_heads",
-                  "head_dim", "n_experts"):
+                  "head_dim", "n_experts", "moe_top_k", "moe_router",
+                  "router_z_coef"):
         val = getattr(args, field)
         if val is not None:
             overrides[field] = val

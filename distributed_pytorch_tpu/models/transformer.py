@@ -59,6 +59,8 @@ class TransformerConfig:
     n_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 1   # 1 = Switch routing, 2 = classic top-2
+    moe_router: str = "tokens"   # 'tokens' (top-k) | 'experts' (expert choice)
+    router_z_coef: float = 0.0   # z-loss weight relative to the aux weight
     capacity_factor: float = 2.0
 
     def __post_init__(self):
@@ -245,7 +247,8 @@ def block(
             out_loc, aux = moe_ops.moe_apply(
                 lp["moe"], h_loc, n_experts=cfg.n_experts,
                 capacity_factor=cfg.capacity_factor, axis=tp_axis,
-                top_k=cfg.moe_top_k)
+                top_k=cfg.moe_top_k, router_mode=cfg.moe_router,
+                z_coef=cfg.router_z_coef)
             down = jnp.zeros_like(hf)
             down = lax.dynamic_update_slice_in_dim(
                 down, out_loc, idx * t_loc, 0)
@@ -254,7 +257,8 @@ def block(
             down, aux = moe_ops.moe_apply(
                 lp["moe"], hf, n_experts=cfg.n_experts,
                 capacity_factor=cfg.capacity_factor, axis=None,
-                top_k=cfg.moe_top_k)
+                top_k=cfg.moe_top_k, router_mode=cfg.moe_router,
+                z_coef=cfg.router_z_coef)
         down = down.reshape(b, s, d)
     else:
         gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))

@@ -50,17 +50,22 @@ def _interpret_default() -> bool:
 def attention_reference(
     q: Array, k: Array, v: Array, *, causal: bool = False,
     sm_scale: float | None = None, with_lse: bool = False,
+    bias: Array | None = None,
 ):
     """Plain XLA attention over (B, H, S, D) tensors.
 
     Scores and softmax in float32 regardless of input dtype.  With
     ``with_lse`` also returns the row logsumexp (B, H, Sq) — the quantity
     ring attention needs to merge partial results across sequence chunks.
+    ``bias`` is an additive score bias broadcastable to (B, H, Sq, Sk)
+    (e.g. the NEG_INF cache-validity mask of KV-cache decode, generate.py).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)

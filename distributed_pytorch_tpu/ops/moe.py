@@ -18,6 +18,12 @@ experts are sharded over a mesh axis.  Design:
   results back.  XLA lowers these to ICI all-to-alls.
 - **Load-balance aux loss**: the Switch aux ``E * sum_e f_e * p_e`` over
   this device's tokens (f = routed fraction, p = mean router prob).
+- **Router z-loss** (``z_coef``): mean squared logsumexp of the router
+  logits (ST-MoE), discouraging logit blow-up; added into the returned aux.
+- **Expert-choice routing** (``router_mode='experts'``): experts pick their
+  top-C tokens instead of tokens picking experts (Zhou et al. 2022) —
+  perfectly load-balanced by construction (balance aux is 0), tokens may
+  be served by several experts or none.
 
 All shapes are static: capacity and expert counts are trace-time constants,
 so the whole layer compiles into one XLA program.
@@ -61,8 +67,14 @@ def moe_apply(
     capacity_factor: float = 2.0,
     axis: str | None = None,       # expert-parallel mesh axis
     top_k: int = 1,                # 1 = Switch, 2 = classic top-2 MoE
+    router_mode: str = "tokens",   # 'tokens' (top-k) | 'experts' (EC)
+    z_coef: float = 0.0,           # router z-loss weight (added into aux)
 ) -> tuple[Array, Array]:
-    """Returns (out (T, D), load-balance aux loss scalar).
+    """Returns (out (T, D), auxiliary loss scalar).
+
+    The aux scalar is the Switch load-balance loss (0 under expert-choice
+    routing, which is balanced by construction) plus ``z_coef`` times the
+    router z-loss; the caller applies its overall aux weight on top.
 
     Without ``axis``, ``params`` holds all E experts.  With ``axis``,
     ``params['w_*']`` hold this device's E/n expert shard and tokens are
@@ -71,46 +83,70 @@ def moe_apply(
     ``top_k=2`` routes each token to its two best experts with gates
     normalized over the chosen pair (Shazeer-style); choice-2 tokens fill
     expert slots after every choice-1 token (lower drop priority).
+
+    ``router_mode='experts'``: each expert picks its top-C tokens by router
+    affinity (C = ceil(T * capacity_factor / E)); a token's output is the
+    gate-weighted sum over every expert that picked it.
     """
     t, d = x.shape
     e = n_experts
     n = lax.axis_size(axis) if axis is not None else 1
     if e % n:
         raise ValueError(f"{e} experts do not shard over {n} devices")
+    if router_mode not in ("tokens", "experts"):
+        raise ValueError(f"router_mode must be 'tokens' or 'experts', "
+                         f"got {router_mode!r}")
     if top_k not in (1, 2):
         raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+    if router_mode == "experts" and top_k != 1:
+        raise ValueError("expert-choice routing has no top_k (experts pick "
+                         "tokens); leave top_k=1")
     e_local = e // n
-    cap = max(1, math.ceil(t * top_k * capacity_factor / e))
+    # min(·, t): expert-choice top_k needs cap <= t; more slots than tokens
+    # is meaningless in either mode.
+    cap = min(max(1, math.ceil(t * top_k * capacity_factor / e)), t)
 
     # -- routing (f32 for a stable softmax) --------------------------------
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
-    top_probs, top_idx = jax.lax.top_k(probs, top_k)     # (T, K)
-    if top_k == 1:
-        gates = top_probs                                # Switch: raw prob
+
+    # Router z-loss (ST-MoE): mean logsumexp^2 keeps logits small.
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+
+    if router_mode == "experts":
+        # Experts choose tokens: per expert, top-cap tokens by affinity.
+        g, idx = jax.lax.top_k(probs.T, cap)             # (E, C) each
+        sel = jax.nn.one_hot(idx, t, dtype=x.dtype)      # (E, C, T)
+        dispatch = jnp.einsum("ect->tec", sel)           # (T, E, C)
+        combine = jnp.einsum("ect,ec->tec", sel, g.astype(x.dtype))
+        aux = z_coef * z_loss                            # balanced by design
     else:
-        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
-    onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T, K, E)
+        top_probs, top_idx = jax.lax.top_k(probs, top_k)     # (T, K)
+        if top_k == 1:
+            gates = top_probs                            # Switch: raw prob
+        else:
+            gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+        onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T, K, E)
 
-    # Load-balance aux over the primary assignment (Switch normalization:
-    # a perfectly uniform router gives aux == 1).
-    frac = jnp.mean(onehots[:, 0], axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(frac * mean_prob)
+        # Load-balance aux over the primary assignment (Switch
+        # normalization: a perfectly uniform router gives aux == 1).
+        frac = jnp.mean(onehots[:, 0], axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(frac * mean_prob) + z_coef * z_loss
 
-    # -- capacity & dispatch tensor (T, E, C) ------------------------------
-    # Slot assignment: all choice-1 tokens first (stream order), then
-    # choice-2 tokens fill what remains — choice-2 drops first under
-    # pressure, the standard top-2 priority.
-    flat = onehots.transpose(1, 0, 2).reshape(top_k * t, e)  # (K*T, E)
-    pos = (jnp.cumsum(flat, axis=0) * flat).reshape(top_k, t, e)
-    keep = (pos > 0) & (pos <= cap)
-    slot = (pos - 1).astype(jnp.int32)
-    dispatch_k = jax.nn.one_hot(slot, cap, dtype=x.dtype) * keep[
-        ..., None].astype(x.dtype)                       # (K, T, E, C)
-    dispatch = jnp.sum(dispatch_k, axis=0)               # (T, E, C)
-    combine = jnp.einsum("ktec,tk->tec", dispatch_k,
-                         gates.astype(x.dtype))
+        # -- capacity & dispatch tensor (T, E, C) --------------------------
+        # Slot assignment: all choice-1 tokens first (stream order), then
+        # choice-2 tokens fill what remains — choice-2 drops first under
+        # pressure, the standard top-2 priority.
+        flat = onehots.transpose(1, 0, 2).reshape(top_k * t, e)  # (K*T, E)
+        pos = (jnp.cumsum(flat, axis=0) * flat).reshape(top_k, t, e)
+        keep = (pos > 0) & (pos <= cap)
+        slot = (pos - 1).astype(jnp.int32)
+        dispatch_k = jax.nn.one_hot(slot, cap, dtype=x.dtype) * keep[
+            ..., None].astype(x.dtype)                   # (K, T, E, C)
+        dispatch = jnp.sum(dispatch_k, axis=0)           # (T, E, C)
+        combine = jnp.einsum("ktec,tk->tec", dispatch_k,
+                             gates.astype(x.dtype))
 
     xin = jnp.einsum("tec,td->ecd", dispatch, x)         # (E, C, D)
 
