@@ -9,6 +9,12 @@ Supports greedy (temperature=0) and temperature/top-k sampling.  MoE layers
 decode with a dense-evaluation trick (every expert runs on the B decode
 tokens, the router's one-hot selects) — exact w.r.t. training semantics
 minus capacity drops, and cheap at decode batch sizes.
+
+Tensor-parallel decode (``generate_tp``): the same program runs inside
+``shard_map`` over the Megatron 'model' axis with head/FFN-sharded weights
+and a head-sharded KV cache; the two per-layer psums (after the attention
+out-projection and the MLP down-projection) are the only communication, so
+decode scales to models whose weights or KV cache exceed one chip.
 """
 
 from __future__ import annotations
@@ -27,10 +33,11 @@ PyTree = Any
 
 
 def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
-               dtype=jnp.float32) -> PyTree:
+               dtype=jnp.float32, kv_heads: int | None = None) -> PyTree:
     """Zeroed per-layer K/V buffers, (B, kv_heads, max_len, head_dim) —
-    GQA models cache only the kv heads."""
-    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
+    GQA models cache only the kv heads.  ``kv_heads`` overrides the config
+    count (tensor-parallel decode caches only this shard's heads)."""
+    shape = (batch, kv_heads or cfg.kv_heads, max_len, cfg.head_dim)
     return {
         f"layer{i}": {"k": jnp.zeros(shape, dtype),
                       "v": jnp.zeros(shape, dtype)}
@@ -38,10 +45,13 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
     }
 
 
-def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig):
+def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig,
+               tp_axis: str | None = None):
     """Capacity-free MoE for decode: run all experts, top-k one-hot combine
     (matches training routing — Switch gates for top_k=1, pair-normalized
-    gates for top_k=2)."""
+    gates for top_k=2).  Under ``tp_axis`` the weights hold this shard's
+    E/n experts; each shard evaluates its local experts' gate-weighted
+    contributions and the caller's psum sums them across shards."""
     b, s, d = h.shape
     hf = h.reshape(b * s, d)
     probs = jax.nn.softmax(
@@ -53,6 +63,10 @@ def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig):
     weights = jnp.einsum(
         "tk,tke->te", top_probs,
         jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32))
+    if tp_axis is not None:
+        e_local = lp["moe"]["w_gate"].shape[0]
+        start = lax.axis_index(tp_axis) * e_local
+        weights = lax.dynamic_slice_in_dim(weights, start, e_local, axis=1)
     g = jax.nn.silu(jnp.einsum("td,edf->tef", hf,
                                lp["moe"]["w_gate"].astype(hf.dtype)))
     u = jnp.einsum("td,edf->tef", hf, lp["moe"]["w_up"].astype(hf.dtype))
@@ -62,18 +76,34 @@ def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig):
     return out.reshape(b, s, d)
 
 
-def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
-                pos: jax.Array, *, cfg: tfm.TransformerConfig,
-                dtype=None):
-    """Process one token per sequence: (B,) ids at position ``pos`` ->
-    ((B, vocab) logits, updated cache)."""
-    x = params["embed"][token][:, None, :]  # (B, 1, D)
+def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
+                    pos: jax.Array, write_at, *,
+                    cfg: tfm.TransformerConfig, dtype=None,
+                    tp_axis: str | None = None,
+                    unembed_last_only: bool = False):
+    """Cache-backed forward over a (B, S) token block at positions ``pos``
+    (S,), writing each layer's K/V into cache slots [write_at, write_at+S).
+    Returns ((B, S, vocab) logits, cache).  The one implementation behind
+    both prefill (S = prompt length, write_at = 0) and per-token decode
+    (S = 1, write_at = pos).
+
+    Causality comes from the cache-validity bias: query row j attends cache
+    slots <= pos[j] (earlier positions plus itself), never the zero-filled
+    future slots.  With ``tp_axis`` (inside shard_map) the params are
+    Megatron head/FFN shards and the cache holds this shard's kv heads; one
+    psum after the attention out-projection and one after the MLP
+    reassemble the residual stream, exactly as in training
+    (models/transformer.py block).  MoE layers use the capacity-free dense
+    evaluation (_moe_dense) — exact mixture semantics, no drops.
+    """
+    x = params["embed"][tokens]  # (B, S, D)
     if dtype is not None:
         x = x.astype(dtype)
     max_len = next(iter(cache.values()))["k"].shape[2]
-    # bias masking cache slots beyond the current position
-    slot = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
-    bias = jnp.where(slot <= pos, 0.0, NEG_INF)[None, None]  # (1,1,1,L)
+    s = tokens.shape[1]
+    # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
+    bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
 
     for i in range(cfg.n_layers):
         lp = params[f"layer{i}"]
@@ -82,33 +112,51 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
         q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
         k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
-        posv = pos[None] if pos.ndim == 0 else pos
-        q = tfm.rotary(q, posv, cfg.rope_theta)
-        k = tfm.rotary(k, posv, cfg.rope_theta)
+        q = tfm.rotary(q, pos, cfg.rope_theta)
+        k = tfm.rotary(k, pos, cfg.rope_theta)
         ck = lax.dynamic_update_slice(
-            c["k"], k.astype(c["k"].dtype), (0, 0, pos, 0))
+            c["k"], k.astype(c["k"].dtype), (0, 0, write_at, 0))
         cv = lax.dynamic_update_slice(
-            c["v"], v.astype(c["v"].dtype), (0, 0, pos, 0))
+            c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
         ka, va = ck.astype(q.dtype), cv.astype(q.dtype)
         if cfg.kv_heads != cfg.n_heads:
-            rep = cfg.n_heads // cfg.kv_heads
+            # local head counts (identical ratio under TP sharding)
+            rep = q.shape[1] // ka.shape[1]
             ka = jnp.repeat(ka, rep, axis=1)
             va = jnp.repeat(va, rep, axis=1)
         o = attention_reference(q, ka, va, bias=bias)
-        x = x + jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+        o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+        if tp_axis is not None:
+            o = lax.psum(o, tp_axis)
+        x = x + o
         h = tfm.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.is_moe_layer(i):
-            x = x + _moe_dense(lp, h, cfg)
+            down = _moe_dense(lp, h, cfg, tp_axis=tp_axis)
         else:
             gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
             up = h @ lp["w_up"].astype(h.dtype)
-            x = x + (gate * up) @ lp["w_down"].astype(h.dtype)
+            down = (gate * up) @ lp["w_down"].astype(h.dtype)
+        if tp_axis is not None:
+            down = lax.psum(down, tp_axis)
+        x = x + down
 
     x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0].astype(jnp.float32)
-              @ params["embed"].T.astype(jnp.float32))
+    if unembed_last_only:
+        x = x[:, -1:]  # prefill needs one row, not (B, S, vocab) logits
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     return logits, cache
+
+
+def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
+                pos: jax.Array, *, cfg: tfm.TransformerConfig,
+                dtype=None, tp_axis: str | None = None):
+    """Process one token per sequence: (B,) ids at position ``pos`` ->
+    ((B, vocab) logits, updated cache)."""
+    logits, cache = _forward_cached(
+        params, cache, token[:, None], jnp.atleast_1d(pos), pos,
+        cfg=cfg, dtype=dtype, tp_axis=tp_axis)
+    return logits[:, 0], cache
 
 
 def _sample(key, logits, temperature: float, top_k: int | None):
@@ -119,6 +167,44 @@ def _sample(key, logits, temperature: float, top_k: int | None):
         kth = jnp.sort(logits, -1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, NEG_INF, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def _generate_impl(
+    params: PyTree,
+    prompt: jax.Array,       # (B, S0) int32
+    key: jax.Array,
+    *,
+    cfg: tfm.TransformerConfig,
+    max_new: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    b, s0 = prompt.shape
+    # Under TP the params are head shards — cache this shard's kv heads only.
+    cache = init_cache(cfg, b, s0 + max_new,
+                       kv_heads=params["layer0"]["wk"].shape[1])
+
+    step = partial(decode_step, cfg=cfg, tp_axis=tp_axis)
+
+    # Prefill: ONE batched causal forward over the whole prompt (matmul-bound
+    # MXU work) through the cache-backed path — not a per-token scan of tiny
+    # (B, 1, D) ops.
+    logits, cache = _forward_cached(
+        params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, tp_axis=tp_axis,
+        unembed_last_only=True)
+    last_logits = logits[:, 0]
+
+    def sample_step(carry, t):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = _sample(sub, logits, temperature, top_k)
+        logits, cache = step(params, cache, tok, s0 + t)
+        return (cache, logits, key), tok
+
+    (_, _, _), tokens = lax.scan(
+        sample_step, (cache, last_logits, key), jnp.arange(max_new))
+    return jnp.concatenate([prompt, tokens.T], axis=1)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k"))
@@ -137,38 +223,81 @@ def generate(
     One jitted program: a prefill scan feeds the prompt through the cache,
     then a sampling scan emits tokens (each step's sample feeds the next).
     """
-    b, s0 = prompt.shape
-    cache = init_cache(cfg, b, s0 + max_new)
+    return _generate_impl(params, prompt, key, cfg=cfg, max_new=max_new,
+                          temperature=temperature, top_k=top_k)
 
-    step = partial(decode_step, cfg=cfg)
 
-    # Prefill: ONE batched causal forward over the whole prompt (matmul-bound
-    # MXU work), seeding each layer's cache from the block's rotary-embedded
-    # K/V — not a per-token scan of tiny (B, 1, D) ops.
-    x = params["embed"][prompt]
-    pos = jnp.arange(s0)
-    for i in range(cfg.n_layers):
-        x, _, (k, v) = tfm.block(
-            params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
-            pos=pos, attn_impl="reference", return_kv=True)
-        c = cache[f"layer{i}"]
-        cache[f"layer{i}"] = {
-            "k": lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
-                                          (0, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
-                                          (0, 0, 0, 0)),
-        }
-    x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    last_logits = (x[:, -1].astype(jnp.float32)
-                   @ params["embed"].T.astype(jnp.float32))
+_TP_JIT_CACHE: dict = {}
 
-    def sample_step(carry, t):
-        cache, logits, key = carry
-        key, sub = jax.random.split(key)
-        tok = _sample(sub, logits, temperature, top_k)
-        logits, cache = step(params, cache, tok, s0 + t)
-        return (cache, logits, key), tok
 
-    (_, _, _), tokens = lax.scan(
-        sample_step, (cache, last_logits, key), jnp.arange(max_new))
-    return jnp.concatenate([prompt, tokens.T], axis=1)
+def generate_tp(
+    params: PyTree,          # tfm.shard_specs-sharded on ``mesh``
+    prompt: jax.Array,       # (B, S0) int32 (replicated)
+    key: jax.Array,
+    *,
+    cfg: tfm.TransformerConfig,
+    mesh,
+    axis: str = "model",
+    max_new: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    specs: PyTree | None = None,
+) -> jax.Array:
+    """Tensor-parallel decode: ``generate`` inside shard_map over ``axis``.
+
+    ``params`` stay in their training-time Megatron sharding (no host
+    gather); each device runs the decode program on its head/FFN shard with
+    a head-sharded KV cache, communicating only the two per-layer psums.
+    Sampling keys are replicated, so every shard draws identical tokens.
+
+    ``specs`` overrides the parameter PartitionSpecs (default: the Megatron
+    ``tfm.shard_specs``).  Pass the training-time specs for ZeRO-3/FSDP
+    params (lm.param_specs): dims sharded over axes other than ``axis`` are
+    all-gathered inside the program right before use, instead of jit
+    silently replicating the shards at dispatch.
+
+    The compiled program is cached per (cfg, mesh, decode shape, specs) —
+    repeated sampling calls do not retrace.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ntp = mesh.shape[axis]
+    if cfg.n_heads % ntp or cfg.kv_heads % ntp:
+        raise ValueError(
+            f"heads ({cfg.n_heads} q / {cfg.kv_heads} kv) must divide over "
+            f"the {ntp}-way '{axis}' axis")
+    if cfg.n_experts and cfg.n_experts % ntp:
+        raise ValueError(f"{cfg.n_experts} experts do not shard over "
+                         f"{ntp} devices")
+    if specs is None:
+        specs = tfm.shard_specs(cfg, tp_axis=axis)
+    spec_leaves, spec_def = jax.tree.flatten(specs)
+    cache_key = (cfg, mesh, axis, max_new, temperature, top_k,
+                 tuple(spec_leaves), spec_def)
+    fn = _TP_JIT_CACHE.get(cache_key)
+    if fn is None:
+        def run(params, prompt, key):
+            def gather(p, spec):
+                # reassemble dims sharded over non-tp axes (ZeRO-3 'data'
+                # shards) — the transposeless analogue of lm._fsdp_gather
+                for dim, ax in enumerate(spec):
+                    if ax is not None and ax != axis:
+                        p = lax.all_gather(p, ax, axis=dim, tiled=True)
+                return p
+
+            params = jax.tree.map(gather, params, specs)
+            out = _generate_impl(params, prompt, key, cfg=cfg,
+                                 max_new=max_new, temperature=temperature,
+                                 top_k=top_k, tp_axis=axis)
+            # Certify replication for the P() out_spec: gathered ZeRO-3
+            # leaves are still *marked* varying over their gather axes, so
+            # the sampled tokens inherit that mark — a pmax over identical
+            # values is a no-op that restores provable invariance.
+            inv = tuple(a for a in mesh.axis_names if a != axis)
+            return lax.pmax(out, inv) if inv else out
+
+        fn = jax.jit(shard_map(
+            run, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P()))
+        _TP_JIT_CACHE[cache_key] = fn
+    return fn(params, prompt, key)

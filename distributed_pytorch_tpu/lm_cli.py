@@ -214,15 +214,26 @@ def main(argv: list[str] | None = None) -> int:
             log.warning("generation with pp>1 not supported; skipping")
         else:
             from . import generate as gen
-            from .utils.checkpoint import _fetch
-            # host-gather params (collective-safe on multi-host shardings)
-            params = jax.tree.map(_fetch, trainer.params)
             prompt = lm_corpus.encode(args.generate)[None]
-            out = gen.generate(
-                params,
-                prompt.astype(np.int32), jax.random.key(args.seed),
-                cfg=cfg.model, max_new=args.max_new,
-                temperature=args.temperature)
+            if cfg.tp > 1:
+                # decode on the training mesh: params stay in their Megatron
+                # (and, under --fsdp, ZeRO-3) sharding — no host gather
+                from .lm import param_specs
+                out = gen.generate_tp(
+                    trainer.params, prompt.astype(np.int32),
+                    jax.random.key(args.seed), cfg=cfg.model,
+                    mesh=trainer.mesh, max_new=args.max_new,
+                    temperature=args.temperature,
+                    specs=param_specs(cfg) if cfg.fsdp else None)
+            else:
+                from .utils.checkpoint import _fetch
+                # host-gather params (collective-safe on multi-host shardings)
+                params = jax.tree.map(_fetch, trainer.params)
+                out = gen.generate(
+                    params,
+                    prompt.astype(np.int32), jax.random.key(args.seed),
+                    cfg=cfg.model, max_new=args.max_new,
+                    temperature=args.temperature)
             text = lm_corpus.decode(np.asarray(out[0]))
             print(text)
 

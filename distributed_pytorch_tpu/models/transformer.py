@@ -190,14 +190,12 @@ def block(
     seq_axis: str | None = None,
     seq_layout: str = "contiguous",
     tp_axis: str | None = None,
-    return_kv: bool = False,
-) -> tuple[Array, Array] | tuple[Array, Array, tuple[Array, Array]]:
+) -> tuple[Array, Array]:
     """One transformer block: (layer params, (B, S, D)) -> (x, moe aux).
 
-    The single implementation of the layer body, shared by ``apply``, the
-    pipeline-parallel stage runner (parallel/pipeline.py), and — with
-    ``return_kv`` exposing the rotary-embedded K/V for cache seeding — the
-    decode prefill (generate.py).
+    The single implementation of the layer body, shared by ``apply`` and
+    the pipeline-parallel stage runner (parallel/pipeline.py); decode has
+    its own cache-backed twin (generate.py _forward_cached).
     """
     b, s, d = x.shape
     # -- attention ---------------------------------------------------------
@@ -207,7 +205,6 @@ def block(
     v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
     q = rotary(q, pos, cfg.rope_theta)
     k = rotary(k, pos, cfg.rope_theta)
-    kv_cacheable = (k, v)  # kv_heads-sized, pre-repeat (the decode cache size)
     if cfg.kv_heads != cfg.n_heads:
         # GQA: q heads share repeated K/V heads (params and decode cache stay
         # kv_heads-sized; the repeat is a view XLA folds into the attention)
@@ -266,8 +263,6 @@ def block(
         down = (gate * up) @ lp["w_down"].astype(h.dtype)
     if tp_axis is not None:
         down = lax.psum(down, tp_axis)  # Megatron reduction 2
-    if return_kv:
-        return x + down, aux, kv_cacheable
     return x + down, aux
 
 
