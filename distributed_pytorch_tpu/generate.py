@@ -80,7 +80,8 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
                     pos: jax.Array, write_at, *,
                     cfg: tfm.TransformerConfig, dtype=None,
                     tp_axis: str | None = None,
-                    unembed_last_only: bool = False):
+                    unembed_last_only: bool = False,
+                    k_len: int | None = None):
     """Cache-backed forward over a (B, S) token block at positions ``pos``
     (S,), writing each layer's K/V into cache slots [write_at, write_at+S).
     Returns ((B, S, vocab) logits, cache).  The one implementation behind
@@ -99,10 +100,14 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     x = params["embed"][tokens]  # (B, S, D)
     if dtype is not None:
         x = x.astype(dtype)
-    max_len = next(iter(cache.values()))["k"].shape[2]
+    # ``k_len`` (static) restricts attention to the first cache slots —
+    # prefill passes the prompt length so it does not attend the max_new
+    # zero-filled (masked anyway) future slots; decode attends the full
+    # static cache (its write position is dynamic).
+    k_len = k_len or next(iter(cache.values()))["k"].shape[2]
     s = tokens.shape[1]
     # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
-    slot = jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
     bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
 
     for i in range(cfg.n_layers):
@@ -119,7 +124,8 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         cv = lax.dynamic_update_slice(
             c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
-        ka, va = ck.astype(q.dtype), cv.astype(q.dtype)
+        ka = ck[:, :, :k_len].astype(q.dtype)
+        va = cv[:, :, :k_len].astype(q.dtype)
         if cfg.kv_heads != cfg.n_heads:
             # local head counts (identical ratio under TP sharding)
             rep = q.shape[1] // ka.shape[1]
@@ -192,7 +198,7 @@ def _generate_impl(
     # (B, 1, D) ops.
     logits, cache = _forward_cached(
         params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, tp_axis=tp_axis,
-        unembed_last_only=True)
+        unembed_last_only=True, k_len=s0)
     last_logits = logits[:, 0]
 
     def sample_step(carry, t):

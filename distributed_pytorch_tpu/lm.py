@@ -63,7 +63,7 @@ class LMTrainConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
-    pp: int = 1          # pipeline stages (GPipe); requires sp == tp == 1
+    pp: int = 1          # pipeline stages (GPipe); composes with dp/tp
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
     # Ring-attention sequence layout when sp > 1: 'zigzag' (balanced causal
@@ -75,14 +75,19 @@ class LMTrainConfig:
 
 def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
     if cfg.pp > 1:
-        if cfg.sp != 1 or cfg.tp != 1:
-            raise ValueError("pp composes with dp only (sp == tp == 1)")
+        if cfg.sp != 1:
+            raise ValueError("pp composes with dp and tp (sp must be 1)")
         if cfg.model.n_experts:
             raise ValueError(
                 "pp does not support MoE models (n_experts > 0): expert "
                 "layers cannot stack into homogeneous pipeline stages")
-        return make_mesh(cfg.dp * cfg.pp, axis_names=(DATA, PIPE),
-                         axis_shape=(cfg.dp, cfg.pp), devices=devices)
+        if cfg.tp > 1 and (cfg.model.n_heads % cfg.tp
+                           or cfg.model.kv_heads % cfg.tp):
+            raise ValueError(f"heads must divide over tp={cfg.tp}")
+        return make_mesh(cfg.dp * cfg.pp * cfg.tp,
+                         axis_names=(DATA, PIPE, MODEL),
+                         axis_shape=(cfg.dp, cfg.pp, cfg.tp),
+                         devices=devices)
     if cfg.tp > 1:
         if cfg.model.n_heads % cfg.tp:
             raise ValueError(f"n_heads {cfg.model.n_heads} must divide over "
@@ -167,6 +172,15 @@ def _shard_positions(cfg: LMTrainConfig, s_local: int) -> jax.Array:
     return me * s_local + jnp.arange(s_local)
 
 
+def pp_stage_specs(cfg: LMTrainConfig) -> PyTree:
+    """Stage-stacked param specs for the pp layout — the single derivation
+    of the pipe (+ optional Megatron) sharding, shared by the trainer's
+    param placement and the train step's shard_map specs."""
+    from .parallel import pipeline as pp
+    return pp.stage_specs(cfg.model, cfg.pp,
+                          tp_axis=MODEL if cfg.tp > 1 else None)
+
+
 def make_schedule(cfg: LMTrainConfig):
     """Constant LR, or linear warmup + cosine decay to min_lr_ratio*lr."""
     if cfg.decay_steps <= 0 and cfg.warmup_steps <= 0:
@@ -248,6 +262,8 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
     dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
     n_micro = cfg.microbatches or 2 * cfg.pp
 
+    tp_axis = MODEL if cfg.tp > 1 else None
+
     def local_loss(stage_params, shared, tokens, targets):
         b_local = tokens.shape[0]
         if b_local % n_micro:
@@ -258,12 +274,13 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         tokens = tokens.reshape(n_micro, mb, -1)
         targets = targets.reshape(n_micro, mb, -1)
         ce_sum, n = pp.pipeline_loss(stage_params, shared, tokens, targets,
-                                     cfg=cfg.model, axis=PIPE, dtype=dtype)
+                                     cfg=cfg.model, axis=PIPE, dtype=dtype,
+                                     tp_axis=tp_axis)
         ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE))
         n = jax.lax.psum(n, (DATA, PIPE))
         return ce_sum / jnp.maximum(n, 1)
 
-    stage_specs = pp.stage_specs(cfg.model, cfg.pp)
+    stage_specs = pp_stage_specs(cfg)
     shared_specs = {"embed": P(), "final_norm": P()}
 
     grad_step = shard_map(
@@ -323,7 +340,8 @@ class LMTrainer:
     def __init__(self, cfg: LMTrainConfig, mesh: Mesh | None = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_lm_mesh(cfg)
-        want = cfg.dp * (cfg.pp if cfg.pp > 1 else cfg.sp * cfg.tp)
+        want = cfg.dp * (cfg.pp * cfg.tp if cfg.pp > 1
+                         else cfg.sp * cfg.tp)
         assert self.mesh.devices.size == want, (
             f"mesh has {self.mesh.devices.size} devices, config wants {want}")
 
@@ -335,7 +353,7 @@ class LMTrainer:
         if cfg.pp > 1:
             from .parallel import pipeline as pp
             stages, shared = pp.split_layer_params(params, cfg.model, cfg.pp)
-            stage_specs = pp.stage_specs(cfg.model, cfg.pp)
+            stage_specs = pp_stage_specs(cfg)
             params = {
                 "stages": jax.tree.map(
                     lambda x, s: jax.device_put(

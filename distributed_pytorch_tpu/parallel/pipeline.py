@@ -16,7 +16,10 @@ thread per stage):
   loss — the classic (n-1)/(M+n-1) pipeline bubble;
 - the backward schedule is NOT hand-written: ``jax.grad`` through the scan
   and ppermute yields the reverse pipeline (ppermute's transpose reverses
-  the ring), with ``jax.checkpoint`` on the stage body for activation remat.
+  the ring), with ``jax.checkpoint`` on the stage body for activation remat;
+- pp composes with tensor parallelism: stage layer weights additionally
+  carry the Megatron head/FFN sharding over ``tp_axis`` and the block's two
+  psums run inside every stage (mesh (data, pipe, model)).
 
 Embedding/unembedding weights are replicated to every stage (cheap at these
 scales) so first/last-stage special-casing is a mask, not a branch.
@@ -72,26 +75,34 @@ def merge_layer_params(stage_params: PyTree, shared: PyTree,
     return params
 
 
-def stage_specs(cfg: tfm.TransformerConfig, n_stages: int) -> PyTree:
-    """The P('pipe') spec tree matching split_layer_params' stage output —
-    computed once from the real split structure (no homogeneity guess)."""
+def stage_specs(cfg: tfm.TransformerConfig, n_stages: int,
+                tp_axis: str | None = None) -> PyTree:
+    """The spec tree matching split_layer_params' stage output: leading
+    stage dim over 'pipe'; with ``tp_axis``, each leaf's trailing dims also
+    carry the Megatron head/FFN sharding (models/transformer.shard_specs),
+    shifted right by the two stacking dims (stage, layer-in-stage)."""
     from jax.sharding import PartitionSpec as P
 
     stages_shape = jax.eval_shape(
         lambda k: split_layer_params(tfm.init(k, cfg), cfg, n_stages)[0],
         jax.random.key(0))
-    return jax.tree.map(lambda _: P("pipe"), stages_shape)
+    if tp_axis is None:
+        return jax.tree.map(lambda _: P("pipe"), stages_shape)
+    layer_tp = tfm.shard_specs(cfg, tp_axis=tp_axis)["layer0"]
+    return jax.tree.map(lambda spec, _: P("pipe", None, *spec),
+                        layer_tp, stages_shape)
 
 
 def _stage(stage_layers: PyTree, x: jax.Array,
-           cfg: tfm.TransformerConfig, attn_impl: str) -> jax.Array:
+           cfg: tfm.TransformerConfig, attn_impl: str,
+           tp_axis: str | None = None) -> jax.Array:
     """Run this device's layers_per_stage blocks (a homogeneous layer scan
     over the shared models/transformer.py:block body)."""
     pos = jnp.arange(x.shape[1])
 
     def body(x, lp):
         x, _ = tfm.block(lp, x, cfg=cfg, is_moe=False, pos=pos,
-                         attn_impl=attn_impl)
+                         attn_impl=attn_impl, tp_axis=tp_axis)
         return x, None
 
     x, _ = lax.scan(body, x, stage_layers)
@@ -108,6 +119,7 @@ def pipeline_loss(
     axis: str = "pipe",
     dtype: jnp.dtype | None = None,
     attn_impl: str = "flash",
+    tp_axis: str | None = None,
 ) -> jax.Array:
     """Mean masked CE over all microbatches, computed through the pipeline.
 
@@ -128,7 +140,8 @@ def pipeline_loss(
     if dtype is not None:
         x_all = x_all.astype(dtype)
 
-    stage_fn = jax.checkpoint(partial(_stage, cfg=cfg, attn_impl=attn_impl))
+    stage_fn = jax.checkpoint(partial(_stage, cfg=cfg, attn_impl=attn_impl,
+                                      tp_axis=tp_axis))
     perm = [(i, i + 1) for i in range(n - 1)]  # stage s -> s+1
 
     # Scan carries must be varying over every axis their updates vary over:

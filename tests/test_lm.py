@@ -130,11 +130,11 @@ def test_moe_lm_mesh_parity_and_training():
     assert losses[-1] < losses[0]
 
 
-def test_pp_with_tp_rejected():
+def test_pp_with_sp_rejected():
     from distributed_pytorch_tpu.lm import make_lm_mesh
     import pytest
     with pytest.raises(ValueError, match="pp composes"):
-        make_lm_mesh(LMTrainConfig(pp=2, tp=2))
+        make_lm_mesh(LMTrainConfig(pp=2, sp=2))
 
 
 def test_fsdp_shards_params_and_matches_dense():
@@ -201,3 +201,22 @@ def test_evaluate_and_lr_schedule():
     assert float(sched(0)) < 1e-4
     np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-5)
     assert float(sched(100)) < 2e-4  # decayed toward min_lr_ratio * lr
+
+
+def test_pp_with_tp_composes():
+    """dp=2 x pp=2 x tp=2: the pipeline's stage bodies run Megatron psums;
+    losses must match the dense single-device trajectory."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    tokens, targets = _data(b=8, s=128)
+    model = tfm.TransformerConfig(vocab_size=1024, d_model=256, n_layers=4,
+                                  n_heads=2)
+    losses = {}
+    for name, kw in {"base": dict(dp=1),
+                     "pp_tp": dict(dp=2, pp=2, tp=2)}.items():
+        cfg = LMTrainConfig(model=model, compute_dtype=None, **kw)
+        tr = LMTrainer(cfg)
+        losses[name] = [float(tr.train_step(tokens, targets))
+                        for _ in range(3)]
+    np.testing.assert_allclose(losses["base"], losses["pp_tp"],
+                               rtol=2e-4, atol=2e-4)
