@@ -22,12 +22,14 @@ Two deliberate upgrades over the reference's setup:
   exits with the failed worker's code.  SIGTERM to the launcher itself also
   tears the gang down (no orphaned workers holding chips).
 
-  Scope: each agent supervises ONLY its own node's workers.  A worker death
-  on another node surfaces there; this node's workers then fail out of the
-  collective via the rendezvous/heartbeat timeout (parallel/init.py's
-  ``--rendezvous-timeout``, vs the reference's infinite hang).  Because
-  restarts are per-node and uncoordinated, ``--max-restarts > 0`` with
-  ``--nnodes > 1`` would produce mixed-generation gangs and is rejected.
+  Multi-node restarts are COORDINATED through a generation-numbered
+  rendezvous (torchrun's round concept): the node-0 agent hosts a tiny TCP
+  coordinator (master_port+1); every agent passes a barrier per generation
+  before spawning, reports local worker failures to the coordinator, and
+  polls it so a death on ANY node tears down every node's workers within
+  the monitor interval.  All agents then rejoin the barrier for generation
+  g+1 and respawn together — no mixed-generation gangs.  Workers see their
+  generation as ``RESTART_ATTEMPT`` (checkpoint/resume hook).
 - **TPU process model.** On TPU one *process per host* owns all local chips
   (JAX single-controller-per-host), so ``--nproc-per-node`` defaults to 1 and
   values >1 are for CPU simulation/testing, where each worker is given a
@@ -37,15 +39,122 @@ Two deliberate upgrades over the reference's setup:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 
 DEFAULT_PORT = 6585  # reference start_ddp.sh:1 / main_all_reduce.py:96
 TERM_GRACE_S = 10.0
+BARRIER_TIMEOUT_S = 600.0   # max skew between agents reaching a generation
+RPC_TIMEOUT_S = 5.0         # status/fail round-trip budget
+CONNECT_RETRY_S = 60.0      # waiting for the node-0 coordinator to come up
+
+
+class _Coordinator:
+    """Generation rendezvous service hosted by the node-0 agent.
+
+    One JSON message per TCP connection:
+      {"op": "barrier", "node": R, "gen": G} -> blocks until all nnodes
+          agents arrive at generation G (or abort) -> {"ok": bool, "abort"}
+      {"op": "fail", "gen": G, "code": C}    -> records G as failed
+      {"op": "status", "gen": G}             -> {"failed", "code", "abort"}
+      {"op": "done", "node": R}              -> node R is finished (its own
+          gang result is settled): no further generations, but running
+          gangs are NOT torn down
+      {"op": "abort"}                        -> no further generations AND
+          running workers should be terminated (fatal)
+    """
+
+    def __init__(self, nnodes: int, port: int):
+        self.nnodes = nnodes
+        self.cond = threading.Condition()
+        self.arrived: dict[int, set[int]] = {}
+        self.failed: dict[int, int] = {}
+        self.abort = False
+        self.done = False
+        self.finished: set[int] = set()
+        self.srv = socket.create_server(("0.0.0.0", port))
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:  # closed
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                msg = json.loads(conn.makefile("r").readline())
+                op = msg["op"]
+                if op == "barrier":
+                    gen = msg["gen"]
+                    with self.cond:
+                        self.arrived.setdefault(gen, set()).add(msg["node"])
+                        self.cond.notify_all()
+                        ok = self.cond.wait_for(
+                            lambda: (len(self.arrived.get(gen, ()))
+                                     >= self.nnodes or self.abort
+                                     or self.done),
+                            timeout=BARRIER_TIMEOUT_S)
+                    reply = {"ok": (bool(ok) and not self.abort
+                                    and not self.done),
+                             "abort": self.abort}
+                elif op == "fail":
+                    with self.cond:
+                        self.failed.setdefault(msg["gen"],
+                                               int(msg.get("code", 1)))
+                        self.cond.notify_all()
+                    reply = {"ok": True}
+                elif op == "done":
+                    with self.cond:
+                        self.done = True
+                        self.finished.add(int(msg.get("node", -1)))
+                        self.cond.notify_all()
+                    reply = {"ok": True}
+                elif op == "abort":
+                    with self.cond:
+                        self.abort = True
+                        self.cond.notify_all()
+                    reply = {"ok": True}
+                else:  # status
+                    gen = msg["gen"]
+                    with self.cond:
+                        reply = {"failed": gen in self.failed,
+                                 "code": self.failed.get(gen, 0),
+                                 "abort": self.abort}
+                conn.sendall((json.dumps(reply) + "\n").encode())
+            except (OSError, ValueError, KeyError):
+                pass
+
+    def wait_all_finished(self, timeout: float) -> bool:
+        """Block until every node has reported done (so peers still polling
+        never see a vanished coordinator); False on timeout."""
+        with self.cond:
+            return self.cond.wait_for(
+                lambda: len(self.finished) >= self.nnodes, timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+def _rpc(addr: str, port: int, msg: dict, timeout: float) -> dict:
+    with socket.create_connection((addr, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall((json.dumps(msg) + "\n").encode())
+        return json.loads(s.makefile("r").readline())
 
 
 @dataclass
@@ -103,13 +212,9 @@ class LocalAgent:
         master_port: int = DEFAULT_PORT,
         max_restarts: int = 0,
         monitor_interval_s: float = 0.1,
+        agent_port: int | None = None,
         log=print,
     ):
-        if max_restarts > 0 and nnodes > 1:
-            raise ValueError(
-                "--max-restarts requires --nnodes 1: restarts are per-node "
-                "and an uncoordinated restart would rejoin a gang whose "
-                "other nodes still run the previous generation")
         self.argv = argv
         self.nnodes = nnodes
         self.node_rank = node_rank
@@ -118,8 +223,12 @@ class LocalAgent:
         self.master_port = master_port
         self.max_restarts = max_restarts
         self.monitor_interval_s = monitor_interval_s
+        # coordinator endpoint (nnodes > 1): node 0 hosts, everyone dials
+        self.agent_port = (agent_port if agent_port is not None
+                           else master_port + 1)
         self.log = log
         self._procs: dict[int, subprocess.Popen] = {}
+        self._gen = 0  # current rendezvous generation (RESTART_ATTEMPT)
 
     def specs(self) -> list[WorkerSpec]:
         world = self.nnodes * self.nproc
@@ -140,7 +249,9 @@ class LocalAgent:
     def _spawn(self) -> None:
         for spec in self.specs():
             cmd = [sys.executable] + self.argv
-            self._procs[spec.rank] = subprocess.Popen(cmd, env=spec.env())
+            env = spec.env()
+            env["RESTART_ATTEMPT"] = str(self._gen)
+            self._procs[spec.rank] = subprocess.Popen(cmd, env=env)
             self.log(f"[launch] node {self.node_rank}: started rank "
                      f"{spec.rank} (pid {self._procs[spec.rank].pid})")
 
@@ -163,13 +274,16 @@ class LocalAgent:
                     pass
                 p.wait()
 
-    def _monitor(self) -> GangResult:
+    def _monitor(self, watch_remote: bool = False) -> GangResult:
         """Block until the gang finishes or any worker fails.
 
         This is the failure *detection* the reference lacks: a non-zero or
         signal-killed worker is noticed within ``monitor_interval_s`` and
         the survivors are torn down instead of hanging in a collective.
+        With ``watch_remote`` the coordinator is polled too, so a worker
+        death on ANOTHER node tears this node's workers down as promptly.
         """
+        last_remote_check = 0.0
         while True:
             running = False
             for rank, p in self._procs.items():
@@ -192,12 +306,62 @@ class LocalAgent:
                     per_rank={r: p.returncode
                               for r, p in self._procs.items()},
                 )
+            now = time.monotonic()
+            if watch_remote and now - last_remote_check >= max(
+                    self.monitor_interval_s, 0.2):
+                last_remote_check = now
+                try:
+                    rep = self._rpc_coord({"op": "status", "gen": self._gen},
+                                          RPC_TIMEOUT_S)
+                except (OSError, ValueError):
+                    rep = {"failed": False, "abort": True, "code": 1}
+                    self.log("[launch] coordinator unreachable; "
+                             "terminating gang")
+                if rep.get("failed") or rep.get("abort"):
+                    self.log(f"[launch] remote failure in generation "
+                             f"{self._gen}; terminating local workers")
+                    self._terminate_all()
+                    return GangResult(
+                        returncode=rep.get("code") or 1,
+                        per_rank={r: q.returncode
+                                  for r, q in self._procs.items()},
+                    )
             time.sleep(self.monitor_interval_s)
 
+    # -- gang orchestration -------------------------------------------------
+    def _rpc_coord(self, msg: dict, timeout: float) -> dict:
+        return _rpc(self.master_addr, self.agent_port, msg, timeout)
+
+    def _barrier(self, gen: int) -> bool:
+        """Arrive at generation ``gen``; True when all nodes are in.  The
+        node-0 coordinator may come up after us — retry the dial."""
+        deadline = time.monotonic() + CONNECT_RETRY_S
+        while True:
+            try:
+                rep = self._rpc_coord(
+                    {"op": "barrier", "node": self.node_rank, "gen": gen},
+                    BARRIER_TIMEOUT_S + RPC_TIMEOUT_S)
+                return bool(rep.get("ok"))
+            except (OSError, ValueError):
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(0.2)
+
     def run(self) -> GangResult:
-        """Run the gang, restarting up to ``max_restarts`` times on failure."""
+        """Run the gang, restarting up to ``max_restarts`` times on failure.
+
+        Single node: plain supervise-and-restart.  Multi node: every
+        (re)start passes a coordinator barrier per generation, so all nodes
+        always run the same generation (see module docstring).
+        """
+        if self.nnodes == 1:
+            return self._run_local()
+        return self._run_coordinated()
+
+    def _run_local(self) -> GangResult:
         attempt = 0
         while True:
+            self._gen = attempt
             self._procs = {}
             self._spawn()
             try:
@@ -213,6 +377,61 @@ class LocalAgent:
             attempt += 1
             self.log(f"[launch] restarting gang (attempt {attempt}/"
                      f"{self.max_restarts})")
+
+    def _send(self, msg: dict) -> None:
+        """Best-effort coordinator notification."""
+        try:
+            self._rpc_coord(msg, RPC_TIMEOUT_S)
+        except (OSError, ValueError):
+            pass
+
+    def _run_coordinated(self) -> GangResult:
+        coord = (_Coordinator(self.nnodes, self.agent_port)
+                 if self.node_rank == 0 else None)
+        try:
+            gen = 0
+            last: GangResult | None = None
+            while True:
+                self._gen = gen
+                if not self._barrier(gen):
+                    # Denied: another node settled (done/abort) or the
+                    # rendezvous timed out.  Report the real failure that
+                    # got us here, not a synthetic code.
+                    self.log(f"[launch] rendezvous for generation {gen} "
+                             f"denied (done/abort/timeout)")
+                    return last or GangResult(returncode=1)
+                self._procs = {}
+                self._spawn()
+                try:
+                    result = self._monitor(watch_remote=True)
+                except BaseException:
+                    self._terminate_all()
+                    raise
+                result.restarts_used = gen
+                if result.returncode == 0:
+                    # No further generations for laggards — but running
+                    # peers finishing this generation are NOT torn down.
+                    return result
+                last = result
+                self._send({"op": "fail", "gen": gen,
+                            "code": result.returncode})
+                if gen >= self.max_restarts:
+                    self._send({"op": "abort"})
+                    return result
+                gen += 1
+                self.log(f"[launch] restarting gang, generation {gen}/"
+                         f"{self.max_restarts}")
+        finally:
+            # Settle this node with the coordinator no matter how we exit,
+            # then (node 0) keep the coordinator alive until every node has
+            # settled — a vanished coordinator reads as a remote failure to
+            # peers still polling.
+            self._send({"op": "done", "node": self.node_rank})
+            if coord is not None:
+                if not coord.wait_all_finished(BARRIER_TIMEOUT_S):
+                    self.log("[launch] not all nodes settled before "
+                             "coordinator shutdown")
+                coord.close()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -236,6 +455,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference never sets it — start_ddp.sh:1)")
     p.add_argument("--monitor-interval", type=float, default=0.1,
                    help="seconds between worker liveness polls")
+    p.add_argument("--agent-port", type=int, default=None,
+                   help="coordinator port for multi-node restarts "
+                        "(default master_port+1; node 0 hosts)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command: a script path or '-m module', "
                         "optionally preceded by '--'")
@@ -258,6 +480,7 @@ def main(argv: list[str] | None = None) -> int:
         master_port=args.master_port,
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
+        agent_port=args.agent_port,
     )
     # A scheduler's SIGTERM must tear down the gang, not orphan it; raising
     # SystemExit routes through run()'s BaseException cleanup.

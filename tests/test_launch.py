@@ -106,10 +106,61 @@ def test_parser_matches_torchrun_flags():
     assert "-m" in args.cmd
 
 
-def test_multinode_restarts_rejected():
-    import pytest
-    with pytest.raises(ValueError, match="nnodes 1"):
-        LocalAgent(["x.py"], nnodes=2, max_restarts=1, log=_quiet)
+def _run_two_agents(prog, tmp_path, max_restarts, port):
+    """Drive two coordinated agents (nodes 0 and 1) in threads; the agents
+    spawn real worker subprocesses."""
+    import threading
+
+    results = {}
+
+    def agent(node):
+        a = LocalAgent(["-c", prog], nnodes=2, node_rank=node,
+                       nproc_per_node=1, master_addr="127.0.0.1",
+                       master_port=port, max_restarts=max_restarts,
+                       monitor_interval_s=0.05, log=_quiet)
+        results[node] = a.run()
+
+    threads = [threading.Thread(target=agent, args=(n,)) for n in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "agent did not finish"
+    return results
+
+
+def test_coordinated_multinode_restart(tmp_path):
+    """Node 1's worker fails in generation 0; BOTH nodes must tear down,
+    rejoin the rendezvous, and succeed together in generation 1."""
+    prog = (
+        "import os, sys, time\n"
+        "gen = int(os.environ['RESTART_ATTEMPT'])\n"
+        "if gen == 0 and os.environ['NODE_RANK'] == '1': sys.exit(5)\n"
+        "if gen == 0: time.sleep(60)\n"  # node 0 must be torn down remotely
+        "sys.exit(0)\n"
+    )
+    results = _run_two_agents(prog, tmp_path, max_restarts=2, port=17310)
+    assert results[0].returncode == 0, results
+    assert results[1].returncode == 0, results
+    assert results[0].restarts_used == 1
+    assert results[1].restarts_used == 1
+
+
+def test_coordinated_restarts_exhausted(tmp_path):
+    """With no restart budget, a failure on one node fails every node
+    promptly (no hang waiting for a generation that never comes)."""
+    import time as _t
+
+    prog = (
+        "import os, sys, time\n"
+        "if os.environ['NODE_RANK'] == '1': sys.exit(9)\n"
+        "time.sleep(60)\n"
+    )
+    t0 = _t.monotonic()
+    results = _run_two_agents(prog, tmp_path, max_restarts=0, port=17311)
+    assert _t.monotonic() - t0 < 60
+    assert results[1].returncode == 9
+    assert results[0].returncode != 0
 
 
 def test_sigterm_to_launcher_tears_down_gang(tmp_path):
