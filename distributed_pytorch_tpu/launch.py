@@ -310,10 +310,17 @@ class LocalAgent:
             if watch_remote and now - last_remote_check >= max(
                     self.monitor_interval_s, 0.2):
                 last_remote_check = now
-                try:
-                    rep = self._rpc_coord({"op": "status", "gen": self._gen},
-                                          RPC_TIMEOUT_S)
-                except (OSError, ValueError):
+                rep = None
+                for attempt in (0, 1):  # one retry: a single RST/timeout
+                    try:                # must not consume a restart budget
+                        rep = self._rpc_coord(
+                            {"op": "status", "gen": self._gen},
+                            RPC_TIMEOUT_S)
+                        break
+                    except (OSError, ValueError):
+                        if attempt == 0:
+                            time.sleep(0.5)
+                if rep is None:
                     rep = {"failed": False, "abort": True, "code": 1}
                     self.log("[launch] coordinator unreachable; "
                              "terminating gang")

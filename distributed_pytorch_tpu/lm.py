@@ -384,6 +384,8 @@ class LMTrainer:
         self.params = params
         self._eval_fn = None
         self._step = 0
+        self._ckptr = None
+        self.restored_meta: dict = {}
 
     def evaluate(self, batches) -> dict[str, float]:
         """Held-out loss/perplexity over an iterable of (tokens, targets)."""
@@ -407,26 +409,40 @@ class LMTrainer:
 
 
     # -- checkpointing ----------------------------------------------------
-    def save_checkpoint(self, directory: str) -> None:
-        """Snapshot params/opt-state/step (utils/checkpoint.py); all
-        processes must call (sharded fetches are collectives)."""
+    def _checkpointer(self, directory: str):
+        """One cached async checkpointer per directory, so the background
+        writer handle survives across save calls (writes never interleave
+        and the interpreter flushes the last one at exit)."""
         from .utils.checkpoint import PyTreeCheckpointer
-        PyTreeCheckpointer(directory).save(
+        if self._ckptr is None or self._ckptr.directory != directory:
+            self._ckptr = PyTreeCheckpointer(directory, async_write=True)
+        return self._ckptr
+
+    def save_checkpoint(self, directory: str,
+                        extra_meta: dict | None = None) -> None:
+        """Snapshot params/opt-state/step (utils/checkpoint.py); all
+        processes must call (sharded fetches are collectives).  The fetch is
+        synchronous; serialization/IO overlap the next train steps
+        (async_write).  ``extra_meta`` rides along in the JSON meta — the
+        CLI records the data-loader position here."""
+        self._checkpointer(directory).save(
             {"params": self.params, "opt": self.opt_state}, self._step,
-            meta={"dp": self.cfg.dp, "sp": self.cfg.sp, "tp": self.cfg.tp,
-                  "pp": self.cfg.pp})
+            meta=dict(extra_meta or {},
+                      dp=self.cfg.dp, sp=self.cfg.sp, tp=self.cfg.tp,
+                      pp=self.cfg.pp))
 
     def maybe_restore(self, directory: str) -> int:
         """Restore the latest checkpoint if present; returns the step to
-        resume from (0 = fresh)."""
-        from .utils.checkpoint import PyTreeCheckpointer
-        got = PyTreeCheckpointer(directory).restore(
+        resume from (0 = fresh).  The full checkpoint meta (including any
+        ``extra_meta`` recorded at save) lands in ``self.restored_meta``."""
+        got = self._checkpointer(directory).restore(
             {"params": self.params, "opt": self.opt_state})
         if got is None:
             return 0
         trees, meta = got
         self.params, self.opt_state = trees["params"], trees["opt"]
         self._step = meta["step"]
+        self.restored_meta = meta
         return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):

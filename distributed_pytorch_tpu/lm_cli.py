@@ -178,16 +178,31 @@ def main(argv: list[str] | None = None) -> int:
     step = start
     t_last, s_last = time.perf_counter(), start
     steps_per_epoch = len(loader)
+    # Loader position: checkpoints carry it explicitly (epoch + offset);
+    # deriving it from the step counter is the fallback for checkpoints
+    # written before the position was recorded.  An explicit position
+    # survives steps_per_epoch drift (e.g. a corpus that grew) exactly.
+    pos = trainer.restored_meta.get("loader") if start else None
+    if pos is not None and pos.get("steps_per_epoch") != steps_per_epoch:
+        log.warning(
+            "checkpoint loader position was recorded at %s steps/epoch, "
+            "now %d — resuming from the recorded (epoch, offset) anyway",
+            pos.get("steps_per_epoch"), steps_per_epoch)
+    if pos is not None:
+        epoch, skip = int(pos["epoch"]), int(pos["offset"])
+        if skip >= steps_per_epoch:  # recorded at an epoch boundary
+            epoch, skip = epoch + 1, 0
+    else:
+        epoch, skip = step // steps_per_epoch, step % steps_per_epoch
     while step < args.steps:
-        # Derive (epoch, batch offset) from the global step so a resumed run
-        # consumes exactly the batches the interrupted run would have.
-        loader.set_epoch(step // steps_per_epoch)
-        skip = step % steps_per_epoch
+        loader.set_epoch(epoch)
         for i, (tokens, targets) in enumerate(loader):
             if i < skip:
                 continue
             loss = trainer.train_step(tokens, targets)
             step += 1
+            loader_pos = {"epoch": epoch, "offset": i + 1,
+                          "steps_per_epoch": steps_per_epoch}
             if step % args.log_every == 0:
                 dt = time.perf_counter() - t_last
                 tok_s = ((step - s_last) * args.batch_size * args.seq_len
@@ -197,7 +212,8 @@ def main(argv: list[str] | None = None) -> int:
                 t_last, s_last = time.perf_counter(), step
             if (args.checkpoint_dir
                     and step % args.checkpoint_every == 0):
-                trainer.save_checkpoint(args.checkpoint_dir)
+                trainer.save_checkpoint(args.checkpoint_dir,
+                                        extra_meta={"loader": loader_pos})
             if (val_loader is not None
                     and step % args.eval_every == 0):
                 m = trainer.evaluate(iter(val_loader))
@@ -205,9 +221,13 @@ def main(argv: list[str] | None = None) -> int:
                          step, m["loss"], m["ppl"], m["tokens"])
             if step >= args.steps:
                 break
+        epoch, skip = epoch + 1, 0
 
-    if args.checkpoint_dir:
-        trainer.save_checkpoint(args.checkpoint_dir)
+    if args.checkpoint_dir and step > start:
+        # (skip when nothing trained: rewriting the just-restored
+        # checkpoint would erase its recorded loader position)
+        trainer.save_checkpoint(args.checkpoint_dir,
+                                extra_meta={"loader": loader_pos})
 
     if args.generate is not None:
         if cfg.pp > 1:

@@ -13,7 +13,13 @@ Design notes (TPU-native):
 - writes are atomic (tmp file + rename) so a preempted save never corrupts
   the latest checkpoint — preemption is the normal failure mode on TPU pods;
 - only process 0 writes (params/opt-state are replicated across hosts);
-  every process restores from the shared directory.
+  every process restores from the shared directory;
+- ``async_write``: the device->host fetch stays synchronous (it is a
+  collective and must see a settled device state), but serialization and
+  disk IO run on a background thread so training resumes immediately —
+  the orbax-style overlap of checkpoint writing with compute.  The writer
+  thread is non-daemonic (a clean interpreter exit flushes it) and each
+  save joins the previous write first (no interleaved files).
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 
 import jax
 import numpy as np
@@ -28,6 +35,51 @@ import numpy as np
 from ..parallel.mesh import data_sharding, replicated
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+class _AsyncWriter:
+    """At most one in-flight background write; join-before-submit.
+
+    Shared per directory (module registry below) so EVERY checkpointer
+    instance pointing at the same path serializes against the same
+    in-flight write — a reader constructed after a writer still waits for
+    the pending publish.  A background failure is captured and re-raised
+    from the next wait()/submit(), so a failed save cannot masquerade as
+    success (the synchronous path's behavior)."""
+
+    def __init__(self):
+        self._t: threading.Thread | None = None
+        self._exc: BaseException | None = None
+
+    def submit(self, fn) -> None:
+        self.wait()
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                self._exc = e
+
+        self._t = threading.Thread(target=run)  # non-daemon: exit flushes
+        self._t.start()
+
+    def wait(self) -> None:
+        if self._t is not None:
+            self._t.join()
+            self._t = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError("background checkpoint write failed") from exc
+
+
+_WRITERS: dict[str, _AsyncWriter] = {}
+_WRITERS_LOCK = threading.Lock()
+
+
+def _writer_for(directory: str) -> _AsyncWriter:
+    key = os.path.abspath(directory)
+    with _WRITERS_LOCK:
+        return _WRITERS.setdefault(key, _AsyncWriter())
 
 
 def _fetch(leaf) -> np.ndarray:
@@ -93,10 +145,17 @@ def _atomic_write(directory: str, index: int, payload: dict,
 class Checkpointer:
     """Epoch-granularity checkpoints in ``directory`` (ckpt_<epoch>.npz)."""
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3,
+                 async_write: bool = False):
         self.directory = directory
         self.keep = keep
+        self.async_write = async_write
+        self._writer = _writer_for(directory)
         os.makedirs(directory, exist_ok=True)
+
+    def wait(self) -> None:
+        """Block until any in-flight background write has been published."""
+        self._writer.wait()
 
     # -- save -------------------------------------------------------------
     def save(self, trainer, epoch: int) -> str | None:
@@ -115,10 +174,16 @@ class Checkpointer:
         meta = {"epoch": epoch, "step": trainer._step,
                 "model": trainer.cfg.model, "strategy": trainer.cfg.strategy,
                 "n_replicas": trainer.n_replicas}
+        path = os.path.join(self.directory, f"ckpt_{epoch}.npz")
+        if self.async_write:
+            self._writer.submit(lambda: _atomic_write(
+                self.directory, epoch, payload, meta, self.keep))
+            return path
         return _atomic_write(self.directory, epoch, payload, meta, self.keep)
 
     # -- restore ----------------------------------------------------------
     def list(self) -> list[tuple[int, str]]:
+        self._writer.wait()  # reads must see the settled directory
         return _list_ckpts(self.directory)
 
     def latest(self) -> tuple[int, str] | None:
@@ -169,10 +234,17 @@ class PyTreeCheckpointer:
     sharding, so a resumed run is layout-identical to a fresh one.
     """
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3,
+                 async_write: bool = False):
         self.directory = directory
         self.keep = keep
+        self.async_write = async_write
+        self._writer = _writer_for(directory)
         os.makedirs(directory, exist_ok=True)
+
+    def wait(self) -> None:
+        """Block until any in-flight background write has been published."""
+        self._writer.wait()
 
     def save(self, trees: dict, step: int, meta: dict | None = None):
         payload: dict[str, np.ndarray] = {}
@@ -181,10 +253,17 @@ class PyTreeCheckpointer:
                 payload[name + k] = v
         if jax.process_index() != 0:
             return None
-        return _atomic_write(self.directory, step, payload,
-                             dict(meta or {}, step=step), self.keep)
+        full_meta = dict(meta or {}, step=step)
+        path = os.path.join(self.directory, f"ckpt_{step}.npz")
+        if self.async_write:
+            self._writer.submit(lambda: _atomic_write(
+                self.directory, step, payload, full_meta, self.keep))
+            return path
+        return _atomic_write(self.directory, step, payload, full_meta,
+                             self.keep)
 
     def list(self) -> list[tuple[int, str]]:
+        self._writer.wait()  # reads must see the settled directory
         return _list_ckpts(self.directory)
 
     def restore(self, like: dict) -> tuple[dict, dict] | None:

@@ -93,3 +93,44 @@ def test_atomic_save_no_tmp_left(tmp_path):
     Checkpointer(str(tmp_path)).save(t, epoch=1)
     import os
     assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_async_write_publishes_and_flushes(tmp_path):
+    """async_write returns before the file lands; list()/restore() wait for
+    the background write, so readers always see the settled directory."""
+    from distributed_pytorch_tpu.utils.checkpoint import PyTreeCheckpointer
+
+    import jax.numpy as jnp
+    tree = {"w": jnp.arange(1000.0), "b": jnp.ones((10,))}
+    ck = PyTreeCheckpointer(str(tmp_path), async_write=True)
+    ck.save({"t": tree}, 1, meta={"tag": "a"})
+    ck.save({"t": tree}, 2, meta={"tag": "b"})  # joins write 1 first
+    assert [s for s, _ in ck.list()] == [1, 2]
+    got = ck.restore({"t": tree})
+    assert got is not None
+    trees, meta = got
+    assert meta["step"] == 2 and meta["tag"] == "b"
+    np.testing.assert_array_equal(np.asarray(trees["t"]["w"]),
+                                  np.asarray(tree["w"]))
+
+
+def test_lm_checkpoint_carries_loader_position(tmp_path):
+    """extra_meta (the CLI's loader position) round-trips through
+    save_checkpoint/maybe_restore."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    cfg = LMTrainConfig(model=tfm.TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=1, n_heads=1, head_dim=64),
+        compute_dtype=None)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, (2, 64)).astype(np.int32)
+    tr.train_step(tokens, np.roll(tokens, -1, 1))
+    pos = {"epoch": 3, "offset": 7, "steps_per_epoch": 11}
+    tr.save_checkpoint(str(tmp_path), extra_meta={"loader": pos})
+
+    tr2 = LMTrainer(cfg)
+    step = tr2.maybe_restore(str(tmp_path))
+    assert step == 1
+    assert tr2.restored_meta["loader"] == pos
