@@ -63,8 +63,12 @@ class LMTrainConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
-    pp: int = 1          # pipeline stages (GPipe); composes with dp/tp
+    pp: int = 1          # pipeline stages; composes with dp/tp
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
+    # Virtual pipeline stages per device (Megatron interleaved placement):
+    # the fill/drain bubble shrinks by this factor (parallel/pipeline.py
+    # wave schedule).  Requires n_layers % (pp * interleave) == 0.
+    interleave: int = 1
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
     # Ring-attention sequence layout when sp > 1: 'zigzag' (balanced causal
     # ring, ~2x fewer attention FLOPs — parallel/context.py) or 'contiguous'.
@@ -74,6 +78,12 @@ class LMTrainConfig:
 
 
 def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
+    if cfg.interleave < 1:
+        raise ValueError(f"interleave must be >= 1, got {cfg.interleave}")
+    if cfg.interleave > 1 and cfg.pp == 1:
+        raise ValueError(
+            "interleave (virtual pipeline stages) requires pp > 1; with "
+            "pp=1 it would be silently ignored")
     if cfg.pp > 1:
         if cfg.sp != 1:
             raise ValueError("pp composes with dp and tp (sp must be 1)")
@@ -178,7 +188,8 @@ def pp_stage_specs(cfg: LMTrainConfig) -> PyTree:
     param placement and the train step's shard_map specs."""
     from .parallel import pipeline as pp
     return pp.stage_specs(cfg.model, cfg.pp,
-                          tp_axis=MODEL if cfg.tp > 1 else None)
+                          tp_axis=MODEL if cfg.tp > 1 else None,
+                          interleave=cfg.interleave)
 
 
 def make_schedule(cfg: LMTrainConfig):
@@ -275,7 +286,8 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         targets = targets.reshape(n_micro, mb, -1)
         ce_sum, n = pp.pipeline_loss(stage_params, shared, tokens, targets,
                                      cfg=cfg.model, axis=PIPE, dtype=dtype,
-                                     tp_axis=tp_axis)
+                                     tp_axis=tp_axis,
+                                     interleave=cfg.interleave)
         ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE))
         n = jax.lax.psum(n, (DATA, PIPE))
         return ce_sum / jnp.maximum(n, 1)
@@ -352,7 +364,8 @@ class LMTrainer:
         tx = make_optimizer(cfg)
         if cfg.pp > 1:
             from .parallel import pipeline as pp
-            stages, shared = pp.split_layer_params(params, cfg.model, cfg.pp)
+            stages, shared = pp.split_layer_params(
+                params, cfg.model, cfg.pp, interleave=cfg.interleave)
             stage_specs = pp_stage_specs(cfg)
             params = {
                 "stages": jax.tree.map(
@@ -429,7 +442,7 @@ class LMTrainer:
             {"params": self.params, "opt": self.opt_state}, self._step,
             meta=dict(extra_meta or {},
                       dp=self.cfg.dp, sp=self.cfg.sp, tp=self.cfg.tp,
-                      pp=self.cfg.pp))
+                      pp=self.cfg.pp, interleave=self.cfg.interleave))
 
     def maybe_restore(self, directory: str) -> int:
         """Restore the latest checkpoint if present; returns the step to

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--interleave", type=int, default=1,
+                   help="virtual pipeline stages per device (shrinks the "
+                        "pipeline bubble by this factor)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
     # training
@@ -124,7 +127,8 @@ def main(argv: list[str] | None = None) -> int:
         compute_dtype=(None if args.compute_dtype == "float32"
                        else args.compute_dtype),
         warmup_steps=args.warmup_steps, decay_steps=args.decay_steps,
-        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, fsdp=args.fsdp)
+        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
+        interleave=args.interleave, fsdp=args.fsdp)
     trainer = LMTrainer(cfg)
     log.info("model: %s | mesh: dp=%d sp=%d tp=%d pp=%d over %d devices",
              cfg.model, args.dp, args.sp, args.tp, args.pp,

@@ -1,25 +1,46 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+"""Pipeline parallelism: microbatch pipelining over a mesh axis.
 
 The reference's parallelism inventory is data-parallel only (SURVEY.md
 section 5); this module adds the pipeline axis for models whose layer stack
 does not fit one chip.  Design (the JAX SPMD formulation, not a scheduler
 thread per stage):
 
-- the transformer's L identical blocks are split into ``n = axis_size(pipe)``
-  contiguous stages; each stage's layer parameters are stacked with a leading
-  stage dim and sharded ``P('pipe')``, so each device holds L/n layers;
-- a ``lax.scan`` runs the GPipe schedule: at tick t, stage s processes
-  microbatch ``t - s`` (when valid); activations hop stage s -> s+1 with one
-  ``lax.ppermute`` per tick (ICI neighbor exchange);
-- every device executes the same program every tick (SPMD lockstep); ticks
-  outside a stage's valid window compute on zeros and are masked out of the
-  loss — the classic (n-1)/(M+n-1) pipeline bubble;
+- the transformer's L identical blocks are split into ``n * v`` logical
+  chunks (n = axis_size(pipe) devices, v = ``interleave`` virtual stages
+  per device); chunk ``j`` lives on device ``j % n``, so each device holds
+  v round-robin chunks of L/(n*v) layers — Megatron's interleaved stage
+  placement;
+- a ``lax.scan`` runs a circular **wave** schedule: microbatches are
+  admitted in waves of n, one per tick; a microbatch hops device
+  s -> s+1 -> ... -> n-1 -> 0 -> ... around the ring v times (one
+  ``lax.ppermute`` per tick), visiting chunks in order.  Within a wave
+  each device is busy every tick with exactly one (chunk, microbatch) —
+  lockstep-collision-free — and wave w+1 starts the tick device 0 frees
+  up, so steady state has zero idle ticks;
+- the fill/drain bubble is (n-1)/(v*M + n-1) in chunk-ticks — the v-fold
+  bubble reduction of interleaved scheduling, here in a forward-only scan
+  (``interleave=1`` degenerates to the classic GPipe schedule);
+- ticks outside a device's valid window compute on zeros and are masked
+  out of the loss;
 - the backward schedule is NOT hand-written: ``jax.grad`` through the scan
   and ppermute yields the reverse pipeline (ppermute's transpose reverses
-  the ring), with ``jax.checkpoint`` on the stage body for activation remat;
-- pp composes with tensor parallelism: stage layer weights additionally
+  the ring), with ``jax.checkpoint`` on the chunk body for activation
+  remat;
+- pp composes with tensor parallelism: chunk layer weights additionally
   carry the Megatron head/FFN sharding over ``tp_axis`` and the block's two
-  psums run inside every stage (mesh (data, pipe, model)).
+  psums run inside every chunk (mesh (data, pipe, model)).
+
+Schedule index math (device s, tick t, N = n*v):
+  rel = t - s                      # ticks since the wavefront passed s
+  w   = rel // N                   # wave index
+  k   = (rel mod N) // n           # which of my v chunks is active
+  m   = w*n + (rel mod n)          # microbatch index
+  active iff rel >= 0 and m < M.  Chunk ``k*n + s`` receives from chunk
+  ``k*n + s - 1``, which processed the same microbatch on the previous
+  device at tick t-1 — so one ring hop per tick moves every in-flight
+  microbatch forward one chunk.  Device 0 at k == 0 injects the fresh
+  microbatch embedding instead; device n-1 at k == v-1 finishes
+  microbatch m.
 
 Embedding/unembedding weights are replicated to every stage (cheap at these
 scales) so first/last-stage special-casing is a mask, not a branch.
@@ -40,26 +61,33 @@ PyTree = Any
 
 
 def split_layer_params(params: PyTree, cfg: tfm.TransformerConfig,
-                       n_stages: int):
-    """Re-pack per-layer params into stage-stacked leaves.
+                       n_stages: int, interleave: int = 1):
+    """Re-pack per-layer params into device-stacked chunk leaves.
 
     Returns ``(stage_params, shared)`` where each ``stage_params`` leaf has
-    shape (n_stages, layers_per_stage, *leaf) — shard its leading dim over
-    'pipe' — and ``shared`` holds embed/final_norm (replicated everywhere).
+    shape (n_stages, interleave, layers_per_chunk, *leaf) — shard its
+    leading dim over 'pipe' — and ``shared`` holds embed/final_norm
+    (replicated everywhere).  Logical chunk ``j`` (contiguous layers) lands
+    at [j % n_stages, j // n_stages] (round-robin interleaved placement).
     """
     if cfg.n_experts:
         raise ValueError(
             "pipeline parallelism requires a dense layer stack (layer "
             "params must stack homogeneously); MoE models (n_experts > 0) "
             "are not supported with pp > 1")
-    if cfg.n_layers % n_stages:
+    n_chunks = n_stages * interleave
+    if cfg.n_layers % n_chunks:
         raise ValueError(
-            f"{cfg.n_layers} layers do not split into {n_stages} stages")
-    per = cfg.n_layers // n_stages
+            f"{cfg.n_layers} layers do not split into {n_stages} stages "
+            f"x {interleave} virtual stages")
+    per = cfg.n_layers // n_chunks
     layers = [params[f"layer{i}"] for i in range(cfg.n_layers)]
     stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    # (L, ...) -> (v, n, per, ...) [chunk j = k*n + s] -> (n, v, per, ...)
     stage_params = jax.tree.map(
-        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+        lambda x: jnp.moveaxis(
+            x.reshape((interleave, n_stages, per) + x.shape[1:]), 0, 1),
+        stacked)
     shared = {"embed": params["embed"], "final_norm": params["final_norm"]}
     return stage_params, shared
 
@@ -68,7 +96,8 @@ def merge_layer_params(stage_params: PyTree, shared: PyTree,
                        cfg: tfm.TransformerConfig) -> PyTree:
     """Inverse of split_layer_params (for checkpoint export/tests)."""
     flat = jax.tree.map(
-        lambda x: x.reshape((-1,) + x.shape[2:]), stage_params)
+        lambda x: jnp.moveaxis(x, 0, 1).reshape((-1,) + x.shape[3:]),
+        stage_params)
     params = {"embed": shared["embed"], "final_norm": shared["final_norm"]}
     for i in range(cfg.n_layers):
         params[f"layer{i}"] = jax.tree.map(lambda x: x[i], flat)
@@ -76,27 +105,30 @@ def merge_layer_params(stage_params: PyTree, shared: PyTree,
 
 
 def stage_specs(cfg: tfm.TransformerConfig, n_stages: int,
-                tp_axis: str | None = None) -> PyTree:
+                tp_axis: str | None = None,
+                interleave: int = 1) -> PyTree:
     """The spec tree matching split_layer_params' stage output: leading
-    stage dim over 'pipe'; with ``tp_axis``, each leaf's trailing dims also
+    device dim over 'pipe'; with ``tp_axis``, each leaf's trailing dims also
     carry the Megatron head/FFN sharding (models/transformer.shard_specs),
-    shifted right by the two stacking dims (stage, layer-in-stage)."""
+    shifted right past the three stacking dims (device, virtual stage,
+    layer-in-chunk)."""
     from jax.sharding import PartitionSpec as P
 
     stages_shape = jax.eval_shape(
-        lambda k: split_layer_params(tfm.init(k, cfg), cfg, n_stages)[0],
+        lambda k: split_layer_params(tfm.init(k, cfg), cfg, n_stages,
+                                     interleave)[0],
         jax.random.key(0))
     if tp_axis is None:
         return jax.tree.map(lambda _: P("pipe"), stages_shape)
     layer_tp = tfm.shard_specs(cfg, tp_axis=tp_axis)["layer0"]
-    return jax.tree.map(lambda spec, _: P("pipe", None, *spec),
+    return jax.tree.map(lambda spec, _: P("pipe", None, None, *spec),
                         layer_tp, stages_shape)
 
 
-def _stage(stage_layers: PyTree, x: jax.Array,
+def _chunk(chunk_layers: PyTree, x: jax.Array,
            cfg: tfm.TransformerConfig, attn_impl: str,
            tp_axis: str | None = None) -> jax.Array:
-    """Run this device's layers_per_stage blocks (a homogeneous layer scan
+    """Run one chunk's layers_per_chunk blocks (a homogeneous layer scan
     over the shared models/transformer.py:block body)."""
     pos = jnp.arange(x.shape[1])
 
@@ -105,8 +137,18 @@ def _stage(stage_layers: PyTree, x: jax.Array,
                          attn_impl=attn_impl, tp_axis=tp_axis)
         return x, None
 
-    x, _ = lax.scan(body, x, stage_layers)
+    x, _ = lax.scan(body, x, chunk_layers)
     return x
+
+
+def num_ticks(m_micro: int, n: int, interleave: int) -> int:
+    """Scan length of the wave schedule: the tick after microbatch M-1
+    (wave ceil(M/n)-1, in-wave slot (M-1)%n) clears the last chunk of
+    device n-1."""
+    waves = -(-m_micro // n)
+    big_n = n * interleave
+    return ((waves - 1) * big_n + (interleave - 1) * n
+            + ((m_micro - 1) % n) + n)
 
 
 def pipeline_loss(
@@ -120,29 +162,32 @@ def pipeline_loss(
     dtype: jnp.dtype | None = None,
     attn_impl: str = "flash",
     tp_axis: str | None = None,
+    interleave: int = 1,
 ) -> jax.Array:
     """Mean masked CE over all microbatches, computed through the pipeline.
 
-    Runs inside shard_map with ``stage_params`` leaves carrying this stage's
-    (1, layers_per_stage, ...) slice.  Returns the loss summed over this
-    shard's tokens plus the valid-token count (both to be psum'd by the
-    caller across data/pipe axes).
+    Runs inside shard_map with ``stage_params`` leaves carrying this
+    device's (1, interleave, layers_per_chunk, ...) slice.  Returns the
+    loss summed over this shard's tokens plus the valid-token count (both
+    to be psum'd by the caller across data/pipe axes).
     """
     from ..ops.nn import masked_ce
 
     me = lax.axis_index(axis)
     n = lax.axis_size(axis)
-    local_layers = jax.tree.map(lambda x: x[0], stage_params)  # (per, ...)
+    v = interleave
+    big_n = n * v
+    local = jax.tree.map(lambda x: x[0], stage_params)  # (v, per, ...)
     m_micro, mb, s = tokens.shape
 
-    # Embed all microbatches (replicated embed; masked-out stages feed zeros).
+    # Embed all microbatches (replicated embed; masked-out ticks feed zeros).
     x_all = shared["embed"][tokens]  # (M, mb, S, D)
     if dtype is not None:
         x_all = x_all.astype(dtype)
 
-    stage_fn = jax.checkpoint(partial(_stage, cfg=cfg, attn_impl=attn_impl,
+    chunk_fn = jax.checkpoint(partial(_chunk, cfg=cfg, attn_impl=attn_impl,
                                       tp_axis=tp_axis))
-    perm = [(i, i + 1) for i in range(n - 1)]  # stage s -> s+1
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: chunk k*n+s -> +1
 
     # Scan carries must be varying over every axis their updates vary over:
     # the pipe axis (stage params) plus whatever the inputs carry (e.g. a
@@ -157,26 +202,35 @@ def pipeline_loss(
 
     def tick(carry, t):
         prev_out, ce_acc, n_acc = carry
-        # Activation arriving from the previous stage (stage 0 receives its
-        # fresh microbatch embedding instead).
+        # Activation arriving from the previous device's chunk (one ring
+        # hop per tick); device 0's first chunk takes the fresh microbatch
+        # embedding instead.
         recv = lax.ppermute(prev_out, axis, perm)
-        m_in = jnp.clip(t, 0, m_micro - 1)
+        rel = t - me
+        w = rel // big_n                   # wave (floor: negative pre-fill)
+        k = (rel % big_n) // n             # active virtual stage (>= 0)
+        m = w * n + (rel % n)              # microbatch index
+        valid = (rel >= 0) & (m >= 0) & (m < m_micro)
+        chunk_layers = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, jnp.clip(k, 0, v - 1), 0,
+                                               keepdims=False), local)
+        m_in = jnp.clip(m, 0, m_micro - 1)
         fresh = lax.dynamic_index_in_dim(x_all, m_in, 0, keepdims=False)
-        x_in = jnp.where(me == 0, fresh, recv)
-        out = stage_fn(local_layers, x_in)
-        # Last stage finishes microbatch t-(n-1): unembed + masked CE.
-        m_out = jnp.clip(t - (n - 1), 0, m_micro - 1)
-        valid = (me == n - 1) & (t - (n - 1) >= 0) & (t - (n - 1) < m_micro)
+        x_in = jnp.where((me == 0) & (k == 0), fresh, recv)
+        out = chunk_fn(chunk_layers, x_in)
+        # Last logical chunk (device n-1, slot v-1) finishes microbatch m:
+        # unembed + masked CE.
+        finish = (me == n - 1) & (k == v - 1) & valid
         h = tfm.rms_norm(out, shared["final_norm"], cfg.norm_eps)
         logits = h.astype(jnp.float32) @ shared["embed"].T.astype(jnp.float32)
-        tgt = lax.dynamic_index_in_dim(targets, m_out, 0, keepdims=False)
+        tgt = lax.dynamic_index_in_dim(targets, m_in, 0, keepdims=False)
         ce, cnt = masked_ce(logits, tgt)
-        ce_acc = ce_acc + jnp.where(valid, ce, 0.0)
-        n_acc = n_acc + jnp.where(valid, cnt, 0)
+        ce_acc = ce_acc + jnp.where(finish, ce, 0.0)
+        n_acc = n_acc + jnp.where(finish, cnt, 0)
         return (out, ce_acc, n_acc), None
 
     ce0 = _varying(jnp.zeros(()))
     n0 = _varying(jnp.zeros((), jnp.int32))
     (_, ce_sum, n_sum), _ = lax.scan(
-        tick, (zero_x, ce0, n0), jnp.arange(m_micro + n - 1))
+        tick, (zero_x, ce0, n0), jnp.arange(num_ticks(m_micro, n, v)))
     return ce_sum, n_sum
