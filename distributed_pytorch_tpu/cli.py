@@ -93,6 +93,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard the test set over the mesh (psum'd metrics) "
                         "instead of the reference's redundant per-rank "
                         "evaluation")
+    p.add_argument("--fold-bn-eval", action="store_true",
+                   help="fold BatchNorm statistics into the conv weights "
+                        "for evaluation (mathematically identical, one "
+                        "fewer normalize pass per conv)")
     p.add_argument("--debug-checks", action="store_true",
                    help="after each epoch, verify DP invariants: replicated "
                         "params/opt-state bitwise-identical on every device "
@@ -196,11 +200,13 @@ def main(argv: list[str] | None = None) -> int:
             evaluation.evaluate_sharded(
                 trainer.params, trainer.eval_state(), test_loader.dataset,
                 trainer.mesh, batch_size=args.batch_size,
-                model_name=args.model, compute_dtype=cfg.dtype)
+                model_name=args.model, compute_dtype=cfg.dtype,
+                fold_bn=args.fold_bn_eval)
         else:
             evaluation.evaluate(
                 trainer.params, trainer.eval_state(), test_loader,
-                model_name=args.model, compute_dtype=cfg.dtype)
+                model_name=args.model, compute_dtype=cfg.dtype,
+                fold_bn=args.fold_bn_eval)
         if ckpt is not None:
             ckpt.save(trainer, epoch + 1)
 

@@ -28,12 +28,16 @@ PyTree = Any
 
 
 def _batch_metrics(params, state, images, labels, mask, *, model_name,
-                   dtype):
+                   dtype, folded=False):
     """Masked (ce_sum, correct, n_real) for one padded batch — the single
-    compute core behind both the replicated and the sharded eval paths."""
+    compute core behind both the replicated and the sharded eval paths.
+    With ``folded``, ``params`` is a vgg.fold_bn tree (state unused)."""
     x = aug.normalize(images)  # test transform: ToTensor+Normalize (main.py:80-82)
-    logits, _ = vgg.apply(params, state, x, name=model_name, train=False,
-                          dtype=dtype)
+    if folded:
+        logits = vgg.apply_folded(params, x, name=model_name, dtype=dtype)
+    else:
+        logits, _ = vgg.apply(params, state, x, name=model_name, train=False,
+                              dtype=dtype)
     ce = ops.cross_entropy_per_sample(logits, labels) * mask
     correct = jnp.sum((jnp.argmax(logits, axis=-1) == labels) * mask)
     return jnp.sum(ce), correct, jnp.sum(mask)
@@ -51,11 +55,12 @@ def _pad_batch(images, labels, batch_size):
     return images, labels, mask, n
 
 
-@partial(jax.jit, static_argnames=("model_name", "dtype"))
-def _eval_batch(params, state, images, labels, mask, *, model_name, dtype):
+@partial(jax.jit, static_argnames=("model_name", "dtype", "folded"))
+def _eval_batch(params, state, images, labels, mask, *, model_name, dtype,
+                folded=False):
     ce_sum, correct, n_real = _batch_metrics(
         params, state, images, labels, mask, model_name=model_name,
-        dtype=dtype)
+        dtype=dtype, folded=folded)
     # per-batch mean over real samples == torch CrossEntropyLoss reduction
     return ce_sum / jnp.maximum(n_real, 1), correct
 
@@ -63,12 +68,17 @@ def _eval_batch(params, state, images, labels, mask, *, model_name, dtype):
 def evaluate(params: PyTree, state: PyTree, loader, *,
              model_name: str = "VGG11",
              compute_dtype: jnp.dtype | None = None,
+             fold_bn: bool = False,
              log=print) -> tuple[float, float]:
     """Full-test-set eval; returns (avg_loss, accuracy).
 
     ``avg_loss`` is the sum of per-batch mean losses divided by the batch
     count — the reference's exact (slightly unusual) definition
-    (main.py:59,63)."""
+    (main.py:59,63).  ``fold_bn`` folds the BatchNorm statistics into the
+    conv weights once up front (models/vgg.fold_bn) — mathematically
+    identical, one fewer normalize pass per conv layer."""
+    if fold_bn:
+        params = vgg.fold_bn(params, state, name=model_name)
     total_loss, correct, total, n_batches = 0.0, 0, 0, 0
     batch_size = None
     for images, labels in loader:
@@ -77,7 +87,8 @@ def evaluate(params: PyTree, state: PyTree, loader, *,
         images, labels, mask, n = _pad_batch(images, labels, batch_size)
         loss, corr = _eval_batch(params, state, jnp.asarray(images),
                                  jnp.asarray(labels), jnp.asarray(mask),
-                                 model_name=model_name, dtype=compute_dtype)
+                                 model_name=model_name, dtype=compute_dtype,
+                                 folded=fold_bn)
         total_loss += float(loss)
         correct += int(corr)
         total += n
@@ -90,9 +101,9 @@ def evaluate(params: PyTree, state: PyTree, loader, *,
     return avg_loss, acc
 
 
-@partial(jax.jit, static_argnames=("mesh", "model_name", "dtype"))
+@partial(jax.jit, static_argnames=("mesh", "model_name", "dtype", "folded"))
 def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
-                   dtype):
+                   dtype, folded=False):
     """Mesh-sharded (ce_sum, correct, n_real) — jit-cached across epochs
     (mesh/model/dtype are hashable statics, so repeat calls reuse the
     executable instead of recompiling per evaluate_sharded call)."""
@@ -104,7 +115,7 @@ def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
     def shard_fn(params, state, images, labels, mask):
         ce_sum, correct, n_real = _batch_metrics(
             params, state, images, labels, mask, model_name=model_name,
-            dtype=dtype)
+            dtype=dtype, folded=folded)
         return (jax.lax.psum(ce_sum, DATA_AXIS),
                 jax.lax.psum(correct, DATA_AXIS),
                 jax.lax.psum(n_real, DATA_AXIS))
@@ -118,6 +129,7 @@ def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
 def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
                      batch_size: int = 256, model_name: str = "VGG11",
                      compute_dtype: jnp.dtype | None = None,
+                     fold_bn: bool = False,
                      log=print) -> tuple[float, float]:
     """Mesh-sharded evaluation: the test set is split over the data axis and
     per-shard sums are psum'd — an O(devices) speedup the reference
@@ -137,6 +149,8 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
             "--shard-eval is single-process for now: the eval batches are "
             "host-local numpy and would need make_array_from_process_local_"
             "data assembly (as Trainer._stage does) for a multi-host mesh")
+    if fold_bn:
+        params = vgg.fold_bn(params, state, name=model_name)
     n_dev = mesh.devices.size
     if batch_size % max(n_dev, 1):
         raise ValueError(f"batch_size {batch_size} must be divisible by the "
@@ -152,7 +166,7 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
         ce_sum, corr, n_real = _sharded_batch(
             params, state, jnp.asarray(images), jnp.asarray(labels),
             jnp.asarray(mask), mesh=mesh, model_name=model_name,
-            dtype=compute_dtype)
+            dtype=compute_dtype, folded=fold_bn)
         total_loss += float(ce_sum) / max(float(n_real), 1.0)
         correct += int(corr)
         total += n

@@ -116,6 +116,54 @@ def apply(
     return logits.astype(jnp.float32), new_state
 
 
+def fold_bn(params: PyTree, state: PyTree, *, name: str = "VGG11") -> PyTree:
+    """Fold BatchNorm running statistics into the conv weights (inference).
+
+    BN(conv(x)) with frozen statistics is an affine map of the conv output,
+    so it folds exactly: w' = w * g, b' = (b - mean) * g + beta with
+    g = scale * rsqrt(var + eps).  The returned tree has only conv{i}/fc
+    leaves — use with :func:`apply_folded`.  Eval-only (training needs live
+    batch statistics); saves one normalize pass per conv layer.
+    """
+    folded: dict = {}
+    idx = 0
+    for layer_cfg in CFG[name]:
+        if layer_cfg == "M":
+            continue
+        conv, bn = params[f"conv{idx}"], params[f"bn{idx}"]
+        st = state[f"bn{idx}"]
+        g = bn["scale"] * jax.lax.rsqrt(st["var"] + ops.BN_EPS)
+        folded[f"conv{idx}"] = {
+            "kernel": conv["kernel"] * g[None, None, None, :],
+            "bias": (conv["bias"] - st["mean"]) * g + bn["bias"],
+        }
+        idx += 1
+    folded["fc"] = params["fc"]
+    return folded
+
+
+def apply_folded(
+    folded: PyTree,
+    x: Array,
+    *,
+    name: str = "VGG11",
+    dtype: jnp.dtype | None = None,
+) -> Array:
+    """Inference forward pass over :func:`fold_bn` params (conv -> ReLU,
+    no separate BN); returns (B, 10) float32 logits."""
+    if dtype is not None:
+        x = x.astype(dtype)
+    idx = 0
+    for layer_cfg in CFG[name]:
+        if layer_cfg == "M":
+            x = ops.max_pool(x)
+        else:
+            x = ops.relu(ops.conv2d(folded[f"conv{idx}"], x))
+            idx += 1
+    x = x.reshape(x.shape[0], -1)
+    return ops.dense(folded["fc"], x).astype(jnp.float32)
+
+
 def param_count(params: PyTree) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
 

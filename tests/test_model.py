@@ -117,3 +117,31 @@ def test_param_count_matches_torch_reference_model():
     params, _ = vgg.init(jax.random.key(1))
     assert len(t_params) == vgg.tensor_count(params) == 34
     assert sum(p.numel() for p in t_params) == vgg.param_count(params)
+
+
+def test_fold_bn_matches_unfolded_eval():
+    """Conv+BN folding is mathematically exact at inference: logits from
+    apply_folded must match apply(train=False) to float32 tolerance, on
+    non-trivial (trained-ish) BN statistics."""
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.models import vgg
+
+    key = jax.random.key(0)
+    params, state = vgg.init(key, "VGG11")
+    # perturb BN state/params away from the init identity
+    state = jax.tree.map(
+        lambda x: x + 0.1 * jax.random.normal(key, x.shape) ** 2, state)
+    params = dict(params)
+    for k in list(params):
+        if k.startswith("bn"):
+            params[k] = {
+                "scale": params[k]["scale"] * 1.3 + 0.1,
+                "bias": params[k]["bias"] + 0.2,
+            }
+    x = jax.random.normal(jax.random.fold_in(key, 1), (4, 32, 32, 3))
+    ref, _ = vgg.apply(params, state, x, name="VGG11", train=False)
+    folded = vgg.fold_bn(params, state, name="VGG11")
+    got = vgg.apply_folded(folded, x, name="VGG11")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
