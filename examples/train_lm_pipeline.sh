@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Pipeline-parallel LM training over a (data x pipe x model) mesh with the
+# interleaved wave schedule: --pp devices in the ring, --interleave virtual
+# stages per device (the fill/drain bubble shrinks by the interleave
+# factor), composed with tensor parallelism.  n_layers must divide by
+# pp * interleave.  Generation afterwards runs tensor-parallel-sharded on
+# the same mesh when tp > 1 (no host gather).
+python -m distributed_pytorch_tpu.lm_cli \
+  --preset LM-small --n-layers 12 --steps 1000 --batch-size 16 \
+  --seq-len 1024 --dp 1 --pp 2 --tp 2 --interleave 3 \
+  --warmup-steps 100 --decay-steps 1000 \
+  --checkpoint-dir /tmp/lm_pp_ckpt "$@"

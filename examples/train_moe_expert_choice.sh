@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Mixture-of-Experts LM with expert-choice routing (experts pick their
+# top-C tokens -- perfectly load balanced, no capacity drops to tune) and
+# the ST-MoE router z-loss; experts shard over the tensor axis and tokens
+# exchange via all_to_all (expert parallelism).  Use --moe-top-k 2 with
+# --moe-router tokens for classic top-2 instead.
+python -m distributed_pytorch_tpu.lm_cli \
+  --preset LM-small --steps 1000 --batch-size 8 --seq-len 1024 \
+  --n-experts 8 --moe-router experts --router-z-coef 0.1 \
+  --dp 1 --tp 1 --eval-every 200 "$@"
