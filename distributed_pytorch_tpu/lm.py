@@ -398,6 +398,7 @@ class LMTrainer:
         self._eval_fn = None
         self._step = 0
         self._ckptr = None
+        self._ckptr_key = None
         self.restored_meta: dict = {}
 
     def evaluate(self, batches) -> dict[str, float]:
@@ -422,23 +423,32 @@ class LMTrainer:
 
 
     # -- checkpointing ----------------------------------------------------
-    def _checkpointer(self, directory: str):
-        """One cached async checkpointer per directory, so the background
-        writer handle survives across save calls (writes never interleave
-        and the interpreter flushes the last one at exit)."""
-        from .utils.checkpoint import PyTreeCheckpointer
-        if self._ckptr is None or self._ckptr.directory != directory:
-            self._ckptr = PyTreeCheckpointer(directory, async_write=True)
+    def _checkpointer(self, directory: str, sharded: bool = False):
+        """One cached checkpointer per (directory, format): the whole-tree
+        async writer's background handle must survive across save calls
+        (writes never interleave; the interpreter flushes the last one at
+        exit)."""
+        from .utils.checkpoint import PyTreeCheckpointer, ShardedCheckpointer
+        key = (directory, sharded)
+        if self._ckptr_key != key:
+            self._ckptr = (ShardedCheckpointer(directory) if sharded
+                           else PyTreeCheckpointer(directory,
+                                                   async_write=True))
+            self._ckptr_key = key
         return self._ckptr
 
     def save_checkpoint(self, directory: str,
-                        extra_meta: dict | None = None) -> None:
+                        extra_meta: dict | None = None,
+                        sharded: bool = False) -> None:
         """Snapshot params/opt-state/step (utils/checkpoint.py); all
-        processes must call (sharded fetches are collectives).  The fetch is
-        synchronous; serialization/IO overlap the next train steps
-        (async_write).  ``extra_meta`` rides along in the JSON meta — the
-        CLI records the data-loader position here."""
-        self._checkpointer(directory).save(
+        processes must call (whole-tree fetches are collectives).  Default
+        format: one whole-tree npz, fetched synchronously with the
+        serialization/IO overlapping the next train steps (async_write).
+        ``sharded=True`` writes per-process shard files instead (no
+        allgather, no full-tree host copy — utils ShardedCheckpointer).
+        ``extra_meta`` rides along in the JSON meta — the CLI records the
+        data-loader position here."""
+        self._checkpointer(directory, sharded).save(
             {"params": self.params, "opt": self.opt_state}, self._step,
             meta=dict(extra_meta or {},
                       dp=self.cfg.dp, sp=self.cfg.sp, tp=self.cfg.tp,
@@ -446,9 +456,20 @@ class LMTrainer:
 
     def maybe_restore(self, directory: str) -> int:
         """Restore the latest checkpoint if present; returns the step to
-        resume from (0 = fresh).  The full checkpoint meta (including any
+        resume from (0 = fresh).  The format (whole-tree npz vs per-shard
+        directory) is auto-detected, so resume works regardless of which
+        saver wrote it.  The full checkpoint meta (including any
         ``extra_meta`` recorded at save) lands in ``self.restored_meta``."""
-        got = self._checkpointer(directory).restore(
+        from .utils.checkpoint import PyTreeCheckpointer, ShardedCheckpointer
+        sh_list = ShardedCheckpointer(directory).list()
+        npz_list = PyTreeCheckpointer(directory).list()
+        if not sh_list and not npz_list:
+            return 0
+        # Mixed directories: resume from whichever format holds the NEWEST
+        # step (a run that switched formats must not resurrect stale state).
+        sharded = bool(sh_list) and (
+            not npz_list or sh_list[-1][0] >= npz_list[-1][0])
+        got = self._checkpointer(directory, sharded).restore(
             {"params": self.params, "opt": self.opt_state})
         if got is None:
             return 0

@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "out the final 10%% of the corpus)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=200)
+    p.add_argument("--checkpoint-sharded", action="store_true",
+                   help="per-process shard files instead of one whole-tree "
+                        "npz: no allgather or full-tree host copy (for "
+                        "models larger than one host's memory); restore "
+                        "auto-detects the format")
     # sampling after training
     p.add_argument("--generate", default=None, metavar="PROMPT",
                    help="sample text from the trained model")
@@ -217,7 +222,8 @@ def main(argv: list[str] | None = None) -> int:
             if (args.checkpoint_dir
                     and step % args.checkpoint_every == 0):
                 trainer.save_checkpoint(args.checkpoint_dir,
-                                        extra_meta={"loader": loader_pos})
+                                        extra_meta={"loader": loader_pos},
+                                        sharded=args.checkpoint_sharded)
             if (val_loader is not None
                     and step % args.eval_every == 0):
                 m = trainer.evaluate(iter(val_loader))
@@ -231,7 +237,8 @@ def main(argv: list[str] | None = None) -> int:
         # (skip when nothing trained: rewriting the just-restored
         # checkpoint would erase its recorded loader position)
         trainer.save_checkpoint(args.checkpoint_dir,
-                                extra_meta={"loader": loader_pos})
+                                extra_meta={"loader": loader_pos},
+                                sharded=args.checkpoint_sharded)
 
     if args.generate is not None:
         if cfg.pp > 1:

@@ -134,3 +134,68 @@ def test_lm_checkpoint_carries_loader_position(tmp_path):
     step = tr2.maybe_restore(str(tmp_path))
     assert step == 1
     assert tr2.restored_meta["loader"] == pos
+
+
+def test_sharded_checkpointer_roundtrip_fsdp(tmp_path):
+    """Per-shard save/restore over a real sharded layout (FSDP + tp): every
+    leaf reassembles exactly, replicated leaves are written once, restore
+    onto a mismatched layout fails loudly."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.utils.checkpoint import ShardedCheckpointer
+
+    model = tfm.TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
+                                  n_heads=4, head_dim=32)
+    cfg = LMTrainConfig(model=model, compute_dtype=None, dp=4, tp=2,
+                        fsdp=True)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 512, (8, 64)).astype(np.int32)
+    tr.train_step(tokens, np.roll(tokens, -1, 1))
+
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save({"params": tr.params, "opt": tr.opt_state}, 1, meta={"x": 5})
+
+    tr2 = LMTrainer(cfg)  # fresh weights, same layout
+    got = ck.restore({"params": tr2.params, "opt": tr2.opt_state})
+    assert got is not None
+    trees, meta = got
+    assert meta["step"] == 1 and meta["x"] == 5
+    for a, b in zip(jax.tree.leaves(trees["params"]),
+                    jax.tree.leaves(tr.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        if hasattr(a, "sharding"):
+            assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+
+    # cross-layout restore (no fsdp -> different shard slices) works via
+    # the host-assembly fallback
+    tr3 = LMTrainer(LMTrainConfig(model=model, compute_dtype=None, dp=4,
+                                  tp=2, fsdp=False))
+    got3 = ck.restore({"params": tr3.params, "opt": tr3.opt_state})
+    assert got3 is not None
+    for a, b in zip(jax.tree.leaves(got3[0]["params"]),
+                    jax.tree.leaves(tr.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_checkpointer_dedupes_replicated(tmp_path):
+    """A fully replicated leaf is written once, not once per device."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from distributed_pytorch_tpu.utils.checkpoint import ShardedCheckpointer
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("d",))
+    big = jax.device_put(np.arange(1 << 16, dtype=np.float32),
+                         NamedSharding(mesh, P()))
+    sharded = jax.device_put(np.arange(1 << 16, dtype=np.float32),
+                             NamedSharding(mesh, P("d")))
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save({"t": {"rep": big, "shd": sharded}}, 0)
+    import json as _json
+    with open(tmp_path / "ckpt_0" / "proc0.idx.json") as f:
+        idx = _json.load(f)
+    assert len(idx["t['rep']"]) == 1   # deduped
+    assert len(idx["t['shd']"]) == 4   # one entry per shard
+    got = ck.restore({"t": {"rep": big, "shd": sharded}})
+    trees, _ = got
+    np.testing.assert_array_equal(np.asarray(trees["t"]["shd"]),
+                                  np.asarray(sharded))
