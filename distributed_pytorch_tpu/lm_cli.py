@@ -18,6 +18,7 @@ import sys
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .data import lm_corpus
@@ -255,6 +256,8 @@ def main(argv: list[str] | None = None) -> int:
                     jax.random.key(args.seed), cfg=cfg.model,
                     mesh=trainer.mesh, max_new=args.max_new,
                     temperature=args.temperature,
+                    dtype=(jnp.dtype(cfg.compute_dtype)
+                           if cfg.compute_dtype else None),
                     specs=param_specs(cfg) if cfg.fsdp else None)
             else:
                 from .utils.checkpoint import _fetch
@@ -264,7 +267,9 @@ def main(argv: list[str] | None = None) -> int:
                     params,
                     prompt.astype(np.int32), jax.random.key(args.seed),
                     cfg=cfg.model, max_new=args.max_new,
-                    temperature=args.temperature)
+                    temperature=args.temperature,
+                    dtype=(jnp.dtype(cfg.compute_dtype)
+                           if cfg.compute_dtype else None))
             text = lm_corpus.decode(np.asarray(out[0]))
             print(text)
 

@@ -352,6 +352,14 @@ class ShardedCheckpointer:
         pid = jax.process_index()
         ckpt_dir = os.path.join(self.directory, f"ckpt_{step}")
         os.makedirs(ckpt_dir, exist_ok=True)
+        if pid == 0:
+            # Re-saving an existing step: drop the completion marker FIRST,
+            # so a crash mid-rewrite cannot leave a mixed old/new checkpoint
+            # that still lists as complete.
+            try:
+                os.remove(os.path.join(ckpt_dir, "meta.json"))
+            except FileNotFoundError:
+                pass
         payload: dict[str, np.ndarray] = {}
         index: dict[str, list] = {}
         for name, tree in trees.items():
@@ -470,7 +478,8 @@ class ShardedCheckpointer:
                             f"{tuple(saved_shape)}, template expects "
                             f"{tuple(np.shape(leaf))}")
                     if not isinstance(leaf, jax.Array):
-                        new_leaves.append(read(entries[0]))
+                        new_leaves.append(_assemble(
+                            key, entries, read, tuple(np.shape(leaf))))
                         continue
                     by_slices = {
                         tuple(map(tuple, e["slices"])): e
