@@ -185,6 +185,7 @@ def _generate_impl(
     temperature: float = 1.0,
     top_k: int | None = None,
     dtype=None,
+    eos_id: int | None = None,
     tp_axis: str | None = None,
 ) -> jax.Array:
     b, s0 = prompt.shape
@@ -207,19 +208,25 @@ def _generate_impl(
     step = partial(decode_step, cfg=cfg, dtype=dtype, tp_axis=tp_axis)
 
     def sample_step(carry, t):
-        cache, logits, key = carry
+        cache, logits, key, done = carry
         key, sub = jax.random.split(key)
         tok = _sample(sub, logits, temperature, top_k)
+        if eos_id is not None:
+            # Sequences past their EOS emit eos_id forever (SPMD lockstep:
+            # the compute still runs, the sampled token is overridden).
+            tok = jnp.where(done, eos_id, tok)
+            done = done | (tok == eos_id)
         logits, cache = step(params, cache, tok, s0 + t)
-        return (cache, logits, key), tok
+        return (cache, logits, key, done), tok
 
-    (_, _, _), tokens = lax.scan(
-        sample_step, (cache, last_logits, key), jnp.arange(max_new))
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _, _), tokens = lax.scan(
+        sample_step, (cache, last_logits, key, done0), jnp.arange(max_new))
     return jnp.concatenate([prompt, tokens.T], axis=1)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k",
-                                   "dtype"))
+                                   "dtype", "eos_id"))
 def generate(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
@@ -230,6 +237,7 @@ def generate(
     temperature: float = 1.0,
     top_k: int | None = None,
     dtype=None,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Sample ``max_new`` tokens after ``prompt``; returns (B, S0+max_new).
 
@@ -237,10 +245,12 @@ def generate(
     then a sampling scan emits tokens (each step's sample feeds the next).
     ``dtype`` selects the compute AND KV-cache dtype (bf16 decode is ~2x
     faster — cache reads are the bandwidth bottleneck); sampling logits
-    stay float32.
+    stay float32.  With ``eos_id``, a sequence that samples it keeps
+    emitting it (per-sequence stop with static shapes).
     """
     return _generate_impl(params, prompt, key, cfg=cfg, max_new=max_new,
-                          temperature=temperature, top_k=top_k, dtype=dtype)
+                          temperature=temperature, top_k=top_k, dtype=dtype,
+                          eos_id=eos_id)
 
 
 _TP_JIT_CACHE: dict = {}

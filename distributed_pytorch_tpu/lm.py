@@ -70,6 +70,11 @@ class LMTrainConfig:
     # wave schedule).  Requires n_layers % (pp * interleave) == 0.
     interleave: int = 1
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
+    @property
+    def dtype(self) -> jnp.dtype | None:
+        """compute_dtype resolved to a jnp dtype (None = float32 params)."""
+        return jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+
     # Ring-attention sequence layout when sp > 1: 'zigzag' (balanced causal
     # ring, ~2x fewer attention FLOPs — parallel/context.py) or 'contiguous'.
     # The step permutes the global token stream in-jit to match; the loss is
@@ -218,7 +223,7 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     (params, opt_state, loss).  tokens/targets are (global_batch, global_seq)
     int32, sharded (data, seq)."""
     tx = make_optimizer(cfg)
-    dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+    dtype = cfg.dtype
     # tp psums always run (free over a size-1 'model' axis) — they also carry
     # the vma bookkeeping that makes the loss provably replicated.  The ring
     # only replaces local flash attention when the seq axis is actually cut.
@@ -270,7 +275,7 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
     from .parallel import pipeline as pp
 
     tx = make_optimizer(cfg)
-    dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+    dtype = cfg.dtype
     n_micro = cfg.microbatches or 2 * cfg.pp
 
     tp_axis = MODEL if cfg.tp > 1 else None
@@ -318,7 +323,7 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
     """Forward-only masked-CE: (params, tokens, targets) -> (ce_sum, count),
     globally reduced.  Works for the (data, seq, model) mesh; the pp layout
     evaluates through pipeline_loss the same way."""
-    dtype = jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None
+    dtype = cfg.dtype
     specs = param_specs(cfg)
 
     def local_eval(params, tokens, targets):

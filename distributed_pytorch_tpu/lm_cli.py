@@ -18,7 +18,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .data import lm_corpus
@@ -100,6 +99,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sample text from the trained model")
     p.add_argument("--max-new", type=int, default=128)
     p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace (TensorBoard-loadable) "
+                        "covering steps 2-11 (step 1 excluded: compile)")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -204,13 +206,21 @@ def main(argv: list[str] | None = None) -> int:
             epoch, skip = epoch + 1, 0
     else:
         epoch, skip = step // steps_per_epoch, step % steps_per_epoch
+    tracing = False
     while step < args.steps:
         loader.set_epoch(epoch)
         for i, (tokens, targets) in enumerate(loader):
             if i < skip:
                 continue
+            if args.profile_dir and step == start + 1:
+                jax.profiler.start_trace(args.profile_dir)
+                tracing = True
             loss = trainer.train_step(tokens, targets)
             step += 1
+            if tracing and step == start + 11:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                tracing = False
             loader_pos = {"epoch": epoch, "offset": i + 1,
                           "steps_per_epoch": steps_per_epoch}
             if step % args.log_every == 0:
@@ -234,6 +244,9 @@ def main(argv: list[str] | None = None) -> int:
                 break
         epoch, skip = epoch + 1, 0
 
+    if tracing:  # short runs: close the trace cleanly
+        jax.profiler.stop_trace()
+
     if args.checkpoint_dir and step > start:
         # (skip when nothing trained: rewriting the just-restored
         # checkpoint would erase its recorded loader position)
@@ -256,8 +269,7 @@ def main(argv: list[str] | None = None) -> int:
                     jax.random.key(args.seed), cfg=cfg.model,
                     mesh=trainer.mesh, max_new=args.max_new,
                     temperature=args.temperature,
-                    dtype=(jnp.dtype(cfg.compute_dtype)
-                           if cfg.compute_dtype else None),
+                    dtype=cfg.dtype,
                     specs=param_specs(cfg) if cfg.fsdp else None)
             else:
                 from .utils.checkpoint import _fetch
@@ -267,9 +279,7 @@ def main(argv: list[str] | None = None) -> int:
                     params,
                     prompt.astype(np.int32), jax.random.key(args.seed),
                     cfg=cfg.model, max_new=args.max_new,
-                    temperature=args.temperature,
-                    dtype=(jnp.dtype(cfg.compute_dtype)
-                           if cfg.compute_dtype else None))
+                    temperature=args.temperature, dtype=cfg.dtype)
             text = lm_corpus.decode(np.asarray(out[0]))
             print(text)
 

@@ -360,6 +360,10 @@ class ShardedCheckpointer:
                 os.remove(os.path.join(ckpt_dir, "meta.json"))
             except FileNotFoundError:
                 pass
+        if jax.process_count() > 1:
+            # No process may overwrite shard files until the marker is gone.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("sharded_ckpt_unmark")
         payload: dict[str, np.ndarray] = {}
         index: dict[str, list] = {}
         for name, tree in trees.items():

@@ -181,16 +181,36 @@ def test_tp_decode_matches_single_device(model_kw):
 
 def test_bf16_decode_runs_and_is_plausible():
     """bf16 compute/cache decode (the 2x-bandwidth path): runs, emits valid
-    tokens, and greedy decoding stays close to f32 (same model, short
-    horizon — bf16 noise can flip late tokens, so compare the first few)."""
+    token ids, and its cached-decode logits stay within bf16 tolerance of
+    the f32 full forward (token-identity comparisons would be flaky when
+    near-uniform random-init logits tie within rounding error)."""
     cfg = CFG
     params = tfm.init(jax.random.key(0), cfg)
     prompt = jnp.arange(7, dtype=jnp.int32)[None] + 30
-    f32 = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
-                       max_new=8, temperature=0.0)
-    bf16 = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
-                        max_new=8, temperature=0.0, dtype=jnp.bfloat16)
-    assert bf16.shape == f32.shape
-    assert (np.asarray(bf16) >= 0).all()
-    np.testing.assert_array_equal(np.asarray(bf16[:, :9]),
-                                  np.asarray(f32[:, :9]))
+    out = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
+                       max_new=8, temperature=0.0, dtype=jnp.bfloat16)
+    assert out.shape == (1, 15)
+    arr = np.asarray(out)
+    assert ((arr >= 0) & (arr < cfg.vocab_size)).all()
+    # logits parity at bf16 tolerance: one decode_step vs the f32 oracle
+    cache = gen.init_cache(cfg, 1, 16, dtype=jnp.bfloat16)
+    logits, cache = gen._forward_cached(
+        params, cache, prompt, jnp.arange(7), 0, cfg=cfg,
+        dtype=jnp.bfloat16, k_len=7)
+    ref = tfm.apply(params, prompt, cfg=cfg, attn_impl="reference")
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=0.15, rtol=0.1)
+
+
+def test_eos_early_stop_pads_remainder():
+    """Once a sequence emits eos_id, every later position is eos_id."""
+    params = tfm.init(jax.random.key(0), CFG)
+    prompt = jnp.arange(5, dtype=jnp.int32)[None] + 10
+    # First find what greedy emits, then declare that token the EOS.
+    free = gen.generate(params, prompt, jax.random.key(1), cfg=CFG,
+                        max_new=12, temperature=0.0)
+    eos = int(free[0, 5])  # the first generated token
+    out = gen.generate(params, prompt, jax.random.key(1), cfg=CFG,
+                       max_new=12, temperature=0.0, eos_id=eos)
+    tail = np.asarray(out[0, 5:])
+    assert (tail == eos).all()
