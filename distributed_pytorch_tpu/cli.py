@@ -151,10 +151,11 @@ def main(argv: list[str] | None = None) -> int:
             port=args.port, timeout_s=args.rendezvous_timeout)
     setup_logging(args.log_level)
     log = get_logger("cli")
-    if args.shard_eval and jax.process_count() > 1:
-        raise SystemExit("--shard-eval is single-process for now "
-                         "(fail fast, before a whole epoch is spent)")
-
+    if args.shard_eval and args.batch_size % max(jax.device_count(), 1):
+        raise SystemExit(
+            f"--shard-eval: --batch-size {args.batch_size} must divide "
+            f"across {jax.device_count()} devices (fail fast, before a "
+            f"whole epoch is spent)")
     cfg = TrainConfig(
         model=args.model, lr=args.lr, momentum=args.momentum,
         weight_decay=args.weight_decay, batch_size=args.batch_size,

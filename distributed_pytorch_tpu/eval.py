@@ -139,16 +139,14 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
 
     Loss definition matches ``evaluate`` (sum of per-batch mean losses over
     real samples / batch count), enforced by requiring device-divisible
-    batches so batch boundaries are identical.  Single-process only (the
-    batches are host-local numpy; multi-host needs global-array assembly).
-    ``state`` is the unstacked rank-0 BN state, exactly as ``evaluate``
-    takes it (replicated onto every shard by the P() in_spec).
+    batches so batch boundaries are identical.  Multi-host meshes work:
+    every process loads the full test set (the reference's download-
+    everywhere behavior) and each padded batch is assembled into a global
+    array with ``make_array_from_process_local_data`` — its full-shape
+    fast path slices each process's device rows out of the replicated
+    host copy.  ``state`` is the unstacked rank-0 BN state, exactly as
+    ``evaluate`` takes it (replicated onto every shard by the P() in_spec).
     """
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "--shard-eval is single-process for now: the eval batches are "
-            "host-local numpy and would need make_array_from_process_local_"
-            "data assembly (as Trainer._stage does) for a multi-host mesh")
     if fold_bn:
         params = vgg.fold_bn(params, state, name=model_name)
     n_dev = mesh.devices.size
@@ -157,6 +155,17 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
                          f"{n_dev}-device mesh for loss parity with "
                          f"evaluate()")
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_shd = NamedSharding(mesh, P("data"))
+
+    def stage(arr):
+        arr = np.asarray(arr)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                data_shd, arr, arr.shape)
+        return jnp.asarray(arr)
+
     total_loss, correct, total, n_batches = 0.0, 0, 0, 0
     images_all, labels_all = dataset.images, dataset.labels
     for start in range(0, len(labels_all), batch_size):
@@ -164,8 +173,8 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
             images_all[start:start + batch_size],
             labels_all[start:start + batch_size], batch_size)
         ce_sum, corr, n_real = _sharded_batch(
-            params, state, jnp.asarray(images), jnp.asarray(labels),
-            jnp.asarray(mask), mesh=mesh, model_name=model_name,
+            params, state, stage(images), stage(labels),
+            stage(mask), mesh=mesh, model_name=model_name,
             dtype=compute_dtype, folded=fold_bn)
         total_loss += float(ce_sum) / max(float(n_real), 1.0)
         correct += int(corr)

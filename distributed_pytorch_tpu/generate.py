@@ -268,6 +268,7 @@ def generate_tp(
     temperature: float = 1.0,
     top_k: int | None = None,
     dtype=None,
+    eos_id: int | None = None,
     specs: PyTree | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: ``generate`` inside shard_map over ``axis``.
@@ -302,7 +303,7 @@ def generate_tp(
     spec_leaves, spec_def = jax.tree.flatten(specs)
     cache_key = (cfg, mesh, axis, max_new, temperature, top_k,
                  jnp.dtype(dtype).name if dtype is not None else None,
-                 tuple(spec_leaves), spec_def)
+                 eos_id, tuple(spec_leaves), spec_def)
     fn = _TP_JIT_CACHE.get(cache_key)
     if fn is None:
         def run(params, prompt, key):
@@ -317,7 +318,8 @@ def generate_tp(
             params = jax.tree.map(gather, params, specs)
             out = _generate_impl(params, prompt, key, cfg=cfg,
                                  max_new=max_new, temperature=temperature,
-                                 top_k=top_k, dtype=dtype, tp_axis=axis)
+                                 top_k=top_k, dtype=dtype, eos_id=eos_id,
+                                 tp_axis=axis)
             # Certify replication for the P() out_spec: gathered ZeRO-3
             # leaves are still *marked* varying over their gather axes, so
             # the sampled tokens inherit that mark — a pmax over identical

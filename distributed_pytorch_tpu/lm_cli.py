@@ -245,6 +245,7 @@ def main(argv: list[str] | None = None) -> int:
         epoch, skip = epoch + 1, 0
 
     if tracing:  # short runs: close the trace cleanly
+        jax.block_until_ready(loss)
         jax.profiler.stop_trace()
 
     if args.checkpoint_dir and step > start:

@@ -209,10 +209,20 @@ def rank0_state(state: PyTree, mesh: Mesh | None) -> PyTree:
 
     Always returns host copies: the live ``state`` buffers are donated into
     the next compiled step, so a held reference would otherwise be deleted.
+    Multi-host meshes: the replica-stacked state spans processes, so the
+    fetch is a collective (every process must call this together).
     """
     if mesh is None:
         return jax.tree.map(np.asarray, state)
-    return jax.tree.map(lambda s: np.asarray(s)[0], state)
+
+    def fetch0(s):
+        if isinstance(s, jax.Array) and not s.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(s, tiled=True))[0]
+        return np.asarray(s)[0]
+
+    return jax.tree.map(fetch0, state)
 
 
 class Trainer:

@@ -225,3 +225,24 @@ def test_two_process_distributed_training():
     )
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert proc.stdout.count("OK") == 2, proc.stdout
+
+
+def test_two_process_sharded_eval():
+    """Multi-host sharded evaluation: a 2-process / 4-device mesh evaluates
+    the test set sharded over the data axis and must match the replicated
+    evaluate() exactly (global batch assembly across processes)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+         "--nproc-per-node", "2", "--master-port", "16741", "--",
+         "tests/workers/sharded_eval_worker.py"],
+        cwd="/root/repo", capture_output=True, text=True, timeout=420,
+        env=dict(
+            {k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS",)},
+            PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""),
+        ),
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert proc.stdout.count("OK") == 2, proc.stdout
