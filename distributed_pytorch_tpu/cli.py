@@ -35,6 +35,7 @@ import jax
 from . import eval as evaluation
 from .data import DataLoader, DistributedSampler, load
 from .parallel import init as dist_init
+from .parallel import strategies as _strat
 from .parallel.mesh import make_mesh
 from .train import TrainConfig, Trainer
 from .utils.logging import get_logger, setup_logging
@@ -63,8 +64,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference hangs forever: timeout=None)")
     # Training hyper-parameters; defaults are the reference's exact values.
     p.add_argument("--strategy", default="ddp",
-                   choices=["none", "gather_scatter", "all_reduce", "ddp",
-                            "bucketed", "quantized"])
+                   choices=_strat.available())
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106

@@ -442,6 +442,13 @@ class LMTrainer:
             self._ckptr_key = key
         return self._ckptr
 
+    def flush_checkpoints(self) -> None:
+        """Block until any in-flight background checkpoint write has been
+        published (call before reading the directory or exiting a driver
+        that must observe the file)."""
+        if self._ckptr is not None:
+            self._ckptr.wait()
+
     def save_checkpoint(self, directory: str,
                         extra_meta: dict | None = None,
                         sharded: bool = False) -> None:

@@ -254,6 +254,8 @@ def main(argv: list[str] | None = None) -> int:
         trainer.save_checkpoint(args.checkpoint_dir,
                                 extra_meta={"loader": loader_pos},
                                 sharded=args.checkpoint_sharded)
+    if args.checkpoint_dir:
+        trainer.flush_checkpoints()  # main() returning implies files exist
 
     if args.generate is not None:
         if cfg.pp > 1:

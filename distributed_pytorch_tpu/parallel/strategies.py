@@ -191,12 +191,9 @@ class QuantizedAllReduce:
     operand is int32, so the bytes on the wire match an fp32 all-reduce —
     this strategy demonstrates the *numerics* of quantized sync (shared
     scale makes the integer sum exact; only quantization loses precision,
-    <1% relative error per tensor) and reserves the API slot.  Actually
-    shrinking the transfer needs int8 on the wire with per-hop
-    accumulation/requantization — a custom Pallas RDMA ring collective
-    (future work); an int8 ``all_gather`` would shrink the payload too but
-    its output is vma-varying, which the training step's invariant-carry
-    contract cannot absorb without an extra invariant collective.
+    <1% relative error per tensor) and reserves the API slot.  For true
+    wire compression see ``quantized_ring`` below, which moves int8 bytes
+    on every hop.
     """
 
     name = "quantized"
@@ -220,6 +217,113 @@ class QuantizedAllReduce:
         return jax.tree.map(sync, grads)
 
 
+class QuantizedRing:
+    """Int8 ring all-reduce with TRUE wire compression: a ring
+    reduce-scatter followed by a ring all-gather built from ``ppermute``
+    hops whose payloads are the int8 tensors themselves (plus one f32
+    scale per ``block`` values, ~1.6% overhead).  Unlike ``quantized``
+    (which feeds XLA's all_reduce int32, so full-width bytes move), every
+    inter-chip transfer here is the quantized byte stream — the DynamiQ/
+    EQuARX compressed-collective design point, expressed with JAX
+    collectives instead of a custom RDMA kernel.
+
+    Numerics: each reduce-scatter hop requantizes its partial sum, so
+    quantization noise accumulates O(sqrt(n)) over the ring (the price of
+    per-hop compression; block-wise scales keep the relative error ~1e-2
+    at int8).  The all-gather forwards each reduced chunk's int8 payload
+    verbatim — no further loss.
+
+    vma note: every device dequantizes identical payloads, so the result
+    is bitwise replicated by construction — but it is assembled from
+    ``ppermute`` (varying) values, which the vma type system cannot prove
+    invariant and there is no sanctioned downcast.  The trainer therefore
+    runs this strategy with ``check_vma=False`` (see ``vma_opaque``).
+    """
+
+    name = "quantized_ring"
+    needs_mesh = True
+    vma_opaque = True  # replication holds by construction, not by proof
+
+    def __init__(self, bits: int = 8, block: int = 256):
+        self.levels = 2 ** (bits - 1) - 1
+        self.block = block
+
+    def _quant(self, x: jax.Array):
+        xb = x.reshape(-1, self.block)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(xb), axis=1, keepdims=True) / self.levels,
+            1e-30)
+        q = jnp.clip(jnp.round(xb / scale), -self.levels,
+                     self.levels).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+
+    def _dequant(self, q: jax.Array, scale: jax.Array) -> jax.Array:
+        return (q.astype(jnp.float32) * scale).ravel()
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        n = lax.axis_size(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        flat = jnp.concatenate([g.ravel().astype(jnp.float32)
+                                for g in leaves])
+        total = flat.size
+        if n == 1:
+            mean = flat
+        else:
+            me = lax.axis_index(axis)
+            chunk = -(-total // (n * self.block)) * self.block
+            parts = jnp.pad(flat, (0, n * chunk - total)).reshape(n, chunk)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            # -- ring reduce-scatter (int8 + scales per hop) ---------------
+            # After t hops my accumulator holds the partial sum of chunk
+            # (me - t) mod n over devices {me-t, ..., me}.
+            acc = lax.dynamic_index_in_dim(parts, me, 0, keepdims=False)
+
+            def rs_step(acc, t):
+                q, s = self._quant(acc)
+                q = lax.ppermute(q, axis, perm)
+                s = lax.ppermute(s, axis, perm)
+                idx = jnp.mod(me - t - 1, n)
+                nxt = self._dequant(q, s) + lax.dynamic_index_in_dim(
+                    parts, idx, 0, keepdims=False)
+                return nxt, None
+
+            acc, _ = lax.scan(rs_step, acc, jnp.arange(n - 1))
+            # acc == full sum of chunk (me + 1) mod n
+
+            # -- ring all-gather (int8 payloads forwarded verbatim) --------
+            qf, sf = self._quant(acc)
+            own = jnp.mod(me + 1, n)
+            q_all = lax.dynamic_update_index_in_dim(
+                jnp.zeros((n,) + qf.shape, jnp.int8), qf, own, 0)
+            s_all = lax.dynamic_update_index_in_dim(
+                jnp.zeros((n,) + sf.shape, jnp.float32), sf, own, 0)
+
+            def ag_step(carry, t):
+                q_all, s_all, cur_q, cur_s = carry
+                cur_q = lax.ppermute(cur_q, axis, perm)
+                cur_s = lax.ppermute(cur_s, axis, perm)
+                # payload received at hop t originated at device me-(t+1),
+                # i.e. holds reduced chunk (me - t) mod n
+                src = jnp.mod(me - t, n)
+                q_all = lax.dynamic_update_index_in_dim(q_all, cur_q, src, 0)
+                s_all = lax.dynamic_update_index_in_dim(s_all, cur_s, src, 0)
+                return (q_all, s_all, cur_q, cur_s), None
+
+            (q_all, s_all, _, _), _ = lax.scan(
+                ag_step, (q_all, s_all, qf, sf), jnp.arange(n - 1))
+            mean = (q_all.astype(jnp.float32)
+                    * s_all).reshape(-1)[:total]
+        mean = mean / n
+
+        out, offset = [], 0
+        for g in leaves:
+            out.append(mean[offset:offset + g.size]
+                       .reshape(g.shape).astype(g.dtype))
+            offset += g.size
+        return jax.tree.unflatten(treedef, out)
+
+
 _REGISTRY: dict[str, Callable[[], Strategy]] = {
     "none": NoSync,
     "all_reduce": AllReduce,
@@ -227,6 +331,7 @@ _REGISTRY: dict[str, Callable[[], Strategy]] = {
     "ddp": DDP,
     "bucketed": Bucketed,
     "quantized": QuantizedAllReduce,
+    "quantized_ring": QuantizedRing,
 }
 
 

@@ -194,6 +194,10 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
         in_specs=(P(), P(DATA_AXIS), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(), P()),
+        # Ring-collective strategies assemble their result from ppermute
+        # hops: bitwise replicated by construction, but not provably so to
+        # the vma checker (no sanctioned varying->invariant downcast).
+        check_vma=not getattr(strategy, "vma_opaque", False),
     ), donate_argnums=(0, 1, 2))
 
 

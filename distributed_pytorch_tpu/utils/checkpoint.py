@@ -347,6 +347,10 @@ class ShardedCheckpointer:
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
 
+    def wait(self) -> None:
+        """No-op: shard writes are synchronous (interface symmetry with the
+        async whole-tree checkpointer)."""
+
     # -- save -------------------------------------------------------------
     def save(self, trees: dict, step: int, meta: dict | None = None) -> str:
         pid = jax.process_index()
