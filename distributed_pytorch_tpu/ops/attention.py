@@ -30,11 +30,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
-# Defaults from block-size sweeps on v5e at S=2048 (fwd microbench + full
-# LM train step): large blocks amortize grid overhead; 512x1024 beat
-# 256x512 by ~10% on the end-to-end train step.  Short sequences clamp via
-# min(block, S) in flash_attention.
-DEFAULT_BLOCK_Q = 512
+# Defaults from block-size sweeps on v5e (fwd+bwd at S=1024..8192, plus
+# the end-to-end LM train step): the largest tile wins or ties everywhere
+# measured — grid overhead dominates before VMEM pressure does at these
+# shapes (1024x1024 beat 512x1024 by 9-26% fwd+bwd).  Small block_q
+# (256) with a large grid is pathological in the dK/dV kernel — avoid.
+# Short sequences auto-shrink via _fit_block.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
 
