@@ -19,6 +19,7 @@ decode scales to models whose weights or KV cache exceed one chip.
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Any
 
@@ -45,13 +46,33 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
     }
 
 
+def _warn_if_expert_choice(cfg: tfm.TransformerConfig) -> None:
+    """Expert-choice routing has no autoregressive decode equivalent.
+
+    EC selection ranks tokens per expert over the whole (B*S) batch, so it
+    cannot be evaluated one token at a time; decode falls back to
+    capacity-free token-choice top-k mixing, whose mixtures differ from the
+    training-time routing (see ops/moe.py moe_apply acausality caveat).
+    Warn rather than raise — the approximation is usable, but the loss is
+    not comparable to training."""
+    if cfg.n_experts and cfg.moe_router == "experts":
+        warnings.warn(
+            "decoding a model trained with expert-choice routing "
+            "(moe_router='experts'): decode uses capacity-free token-choice "
+            "top-k mixing, which differs from the training-time routing — "
+            "decode losses are not comparable to train/eval losses",
+            stacklevel=3)
+
+
 def _moe_dense(lp: PyTree, h: jax.Array, cfg: tfm.TransformerConfig,
                tp_axis: str | None = None):
     """Capacity-free MoE for decode: run all experts, top-k one-hot combine
-    (matches training routing — Switch gates for top_k=1, pair-normalized
-    gates for top_k=2).  Under ``tp_axis`` the weights hold this shard's
-    E/n experts; each shard evaluates its local experts' gate-weighted
-    contributions and the caller's psum sums them across shards."""
+    (matches token-choice training routing — Switch gates for top_k=1,
+    pair-normalized gates for top_k=2; for expert-choice-trained models
+    this is an approximation and generate/generate_tp warn).  Under
+    ``tp_axis`` the weights hold this shard's E/n experts; each shard
+    evaluates its local experts' gate-weighted contributions and the
+    caller's psum sums them across shards."""
     b, s, d = h.shape
     hf = h.reshape(b * s, d)
     probs = jax.nn.softmax(
@@ -268,6 +289,9 @@ def generate(
     stay float32.  With ``eos_id``, a sequence that samples it keeps
     emitting it (per-sequence stop with static shapes).
     """
+    # generate is jitted, so this runs at trace time: once per compiled
+    # config, not per call.
+    _warn_if_expert_choice(cfg)
     return _generate_impl(params, prompt, key, cfg=cfg, max_new=max_new,
                           temperature=temperature, top_k=top_k, dtype=dtype,
                           eos_id=eos_id, decode_segments=decode_segments)
@@ -311,6 +335,7 @@ def generate_tp(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    _warn_if_expert_choice(cfg)
     ntp = mesh.shape[axis]
     if cfg.n_heads % ntp or cfg.kv_heads % ntp:
         raise ValueError(

@@ -94,6 +94,11 @@ class _Coordinator:
     def _handle(self, conn: socket.socket) -> None:
         with conn:
             try:
+                # Bound the request read: a client that connects but never
+                # sends a line must not pin this handler thread (and, for
+                # 'barrier', the condition path) forever.  Barrier gets the
+                # long budget — its request line may lag a slow agent.
+                conn.settimeout(BARRIER_TIMEOUT_S)
                 msg = json.loads(conn.makefile("r").readline())
                 op = msg["op"]
                 if op == "barrier":

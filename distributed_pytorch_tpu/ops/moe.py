@@ -87,6 +87,16 @@ def moe_apply(
     ``router_mode='experts'``: each expert picks its top-C tokens by router
     affinity (C = ceil(T * capacity_factor / E)); a token's output is the
     gate-weighted sum over every expert that picked it.
+
+    CAVEAT (expert-choice acausality): the per-expert top-C selection ranks
+    over the flattened (B*S) token dim, so in causal LM training a token's
+    output depends on the router logits of FUTURE positions (and of other
+    sequences in the batch).  This is inherent to expert-choice routing, not
+    a bug — but it means EC train/eval loss is not reproducible by any
+    autoregressive decode (decode sees only the past, and ``generate``
+    approximates EC models with capacity-free token-choice mixing; it warns
+    when it does).  Use ``router_mode='tokens'`` when train-vs-decode loss
+    parity matters.
     """
     t, d = x.shape
     e = n_experts

@@ -50,20 +50,29 @@ class _AsyncWriter:
     def __init__(self):
         self._t: threading.Thread | None = None
         self._exc: BaseException | None = None
+        # Writers are shared across checkpointer instances via the module
+        # registry, so submit/wait can race from different threads; all
+        # _t/_exc handoff happens under this lock.
+        self._lock = threading.Lock()
 
     def submit(self, fn) -> None:
-        self.wait()
+        with self._lock:
+            self._wait_locked()
 
-        def run():
-            try:
-                fn()
-            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
-                self._exc = e
+            def run():
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001 — re-raised in wait
+                    self._exc = e
 
-        self._t = threading.Thread(target=run)  # non-daemon: exit flushes
-        self._t.start()
+            self._t = threading.Thread(target=run)  # non-daemon: exit flushes
+            self._t.start()
 
     def wait(self) -> None:
+        with self._lock:
+            self._wait_locked()
+
+    def _wait_locked(self) -> None:
         if self._t is not None:
             self._t.join()
             self._t = None

@@ -115,6 +115,34 @@ def test_moe_model_generates():
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 256).all()
 
 
+def test_expert_choice_decode_warns():
+    """Decoding an EC-routed model warns: decode falls back to token-choice
+    mixing, which differs from the training-time expert-choice routing."""
+    import warnings
+
+    cfg = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
+                                n_heads=2, head_dim=64, n_experts=4,
+                                moe_router="experts")
+    params = tfm.init(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = gen.generate(params, prompt, jax.random.key(0), cfg=cfg,
+                           max_new=4, temperature=1.0, top_k=8)
+    assert out.shape == (1, 8)
+    assert any("expert-choice" in str(w.message) for w in caught)
+
+    # Token-choice models decode silently.
+    cfg_tc = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
+                                   n_heads=2, head_dim=64, n_experts=4)
+    params_tc = tfm.init(jax.random.key(0), cfg_tc)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        gen.generate(params_tc, prompt, jax.random.key(0), cfg=cfg_tc,
+                     max_new=4, temperature=1.0, top_k=8)
+    assert not any("expert-choice" in str(w.message) for w in caught)
+
+
 # -- LM checkpointing -------------------------------------------------------
 
 def test_lm_checkpoint_roundtrip(tmp_path):
