@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .models import transformer as tfm
-from .ops.attention import NEG_INF, attention_reference
+from .ops.attention import NEG_INF, attention_reference, decode_attention
 
 PyTree = Any
 
@@ -102,7 +102,8 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
                     cfg: tfm.TransformerConfig, dtype=None,
                     tp_axis: str | None = None,
                     unembed_last_only: bool = False,
-                    k_len: int | None = None):
+                    k_len: int | None = None,
+                    use_decode_kernel: bool = False):
     """Cache-backed forward over a (B, S) token block at positions ``pos``
     (S,), writing each layer's K/V into cache slots [write_at, write_at+S).
     Returns ((B, S, vocab) logits, cache).  The one implementation behind
@@ -127,9 +128,11 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     # anyway) tail of the buffer.
     k_len = k_len or next(iter(cache.values()))["k"].shape[2]
     s = tokens.shape[1]
-    # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
-    slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
-    bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
+    kernel_path = use_decode_kernel and s == 1
+    if not kernel_path:
+        # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
+        slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
+        bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
 
     for i in range(cfg.n_layers):
         lp = params[f"layer{i}"]
@@ -145,14 +148,20 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         cv = lax.dynamic_update_slice(
             c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
-        ka = ck[:, :, :k_len].astype(q.dtype)
-        va = cv[:, :, :k_len].astype(q.dtype)
-        if cfg.kv_heads != cfg.n_heads:
-            # local head counts (identical ratio under TP sharding)
-            rep = q.shape[1] // ka.shape[1]
-            ka = jnp.repeat(ka, rep, axis=1)
-            va = jnp.repeat(va, rep, axis=1)
-        o = attention_reference(q, ka, va, bias=bias)
+        if kernel_path:
+            # Pallas decode kernel: exact pos+1 cache-read bound (dead
+            # blocks neither fetched nor computed), GQA head groups folded
+            # into MXU rows — no repeated cache reads, no k_len segmenting.
+            o = decode_attention(q, ck, cv, pos[0])
+        else:
+            ka = ck[:, :, :k_len].astype(q.dtype)
+            va = cv[:, :, :k_len].astype(q.dtype)
+            if cfg.kv_heads != cfg.n_heads:
+                # local head counts (identical ratio under TP sharding)
+                rep = q.shape[1] // ka.shape[1]
+                ka = jnp.repeat(ka, rep, axis=1)
+                va = jnp.repeat(va, rep, axis=1)
+            o = attention_reference(q, ka, va, bias=bias)
         o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
         if tp_axis is not None:
             o = lax.psum(o, tp_axis)
@@ -178,14 +187,18 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
 def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
                 pos: jax.Array, *, cfg: tfm.TransformerConfig,
                 dtype=None, tp_axis: str | None = None,
-                k_len: int | None = None):
+                k_len: int | None = None,
+                use_decode_kernel: bool = False):
     """Process one token per sequence: (B,) ids at position ``pos`` ->
     ((B, vocab) logits, updated cache).  ``k_len`` (static) restricts the
     attend to the first cache slots — segmented decode passes its
-    segment's bound so early tokens do not read the whole buffer."""
+    segment's bound so early tokens do not read the whole buffer.  With
+    ``use_decode_kernel`` the Pallas decode kernel replaces both tricks:
+    the read bound is the exact, dynamic ``pos+1``."""
     logits, cache = _forward_cached(
         params, cache, token[:, None], jnp.atleast_1d(pos), pos,
-        cfg=cfg, dtype=dtype, tp_axis=tp_axis, k_len=k_len)
+        cfg=cfg, dtype=dtype, tp_axis=tp_axis, k_len=k_len,
+        use_decode_kernel=use_decode_kernel)
     return logits[:, 0], cache
 
 
@@ -212,13 +225,26 @@ def _generate_impl(
     eos_id: int | None = None,
     decode_segments: int = 8,
     tp_axis: str | None = None,
+    decode_kernel: bool | None = None,
 ) -> jax.Array:
     b, s0 = prompt.shape
+    # Pallas decode kernel by default on TPU: exact dynamic pos+1 cache-read
+    # bounds make the static segment bounds below redundant (one compiled
+    # scan body instead of decode_segments of them).  Off-TPU the XLA
+    # segmented path remains the default (the kernel works in interpret
+    # mode but is slower than XLA on CPU).
+    use_kernel = (jax.default_backend() == "tpu"
+                  if decode_kernel is None else decode_kernel)
     # Under TP the params are head shards — cache this shard's kv heads
     # only.  The cache lives in the compute dtype: decode at long cache is
     # HBM-bandwidth-bound on cache reads, so a bf16 cache is ~2x faster
     # than f32 (measured; final logits stay f32 for sampling).
-    cache = init_cache(cfg, b, s0 + max_new,
+    max_len = s0 + max_new
+    if use_kernel:
+        # MXU-friendly cache tiling: round the buffer up to whole 512-slot
+        # blocks (the tail is zero-filled and never read — pos bound).
+        max_len = -(-max_len // 512) * 512
+    cache = init_cache(cfg, b, max_len,
                        dtype=dtype or jnp.float32,
                        kv_heads=params["layer0"]["wk"].shape[1])
 
@@ -237,14 +263,15 @@ def _generate_impl(
     # segment — so early tokens skip the not-yet-written tail.  Measured
     # ~1.7x at 8 segments for long generations (one compiled scan body per
     # segment is the price; diminishing returns beyond 8).
-    n_seg = max(min(decode_segments, max_new), 1)
+    n_seg = 1 if use_kernel else max(min(decode_segments, max_new), 1)
     done0 = jnp.zeros((b,), bool)
     carry = (cache, last_logits, key, done0)
     pieces, start = [], 0
     for i in range(n_seg):
         end = (max_new * (i + 1)) // n_seg
         step = partial(decode_step, cfg=cfg, dtype=dtype, tp_axis=tp_axis,
-                       k_len=s0 + end)
+                       k_len=None if use_kernel else s0 + end,
+                       use_decode_kernel=use_kernel)
 
         def sample_step(carry, t, step=step):
             cache, logits, key, done = carry
@@ -266,7 +293,8 @@ def _generate_impl(
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k",
-                                   "dtype", "eos_id", "decode_segments"))
+                                   "dtype", "eos_id", "decode_segments",
+                                   "decode_kernel"))
 def generate(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
@@ -279,6 +307,7 @@ def generate(
     dtype=None,
     eos_id: int | None = None,
     decode_segments: int = 8,
+    decode_kernel: bool | None = None,
 ) -> jax.Array:
     """Sample ``max_new`` tokens after ``prompt``; returns (B, S0+max_new).
 
@@ -294,7 +323,8 @@ def generate(
     _warn_if_expert_choice(cfg)
     return _generate_impl(params, prompt, key, cfg=cfg, max_new=max_new,
                           temperature=temperature, top_k=top_k, dtype=dtype,
-                          eos_id=eos_id, decode_segments=decode_segments)
+                          eos_id=eos_id, decode_segments=decode_segments,
+                          decode_kernel=decode_kernel)
 
 
 _TP_JIT_CACHE: dict = {}
@@ -314,6 +344,7 @@ def generate_tp(
     dtype=None,
     eos_id: int | None = None,
     decode_segments: int = 8,
+    decode_kernel: bool | None = None,
     specs: PyTree | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: ``generate`` inside shard_map over ``axis``.
@@ -349,7 +380,8 @@ def generate_tp(
     spec_leaves, spec_def = jax.tree.flatten(specs)
     cache_key = (cfg, mesh, axis, max_new, temperature, top_k,
                  jnp.dtype(dtype).name if dtype is not None else None,
-                 eos_id, decode_segments, tuple(spec_leaves), spec_def)
+                 eos_id, decode_segments, decode_kernel,
+                 tuple(spec_leaves), spec_def)
     fn = _TP_JIT_CACHE.get(cache_key)
     if fn is None:
         def run(params, prompt, key):
@@ -366,6 +398,7 @@ def generate_tp(
                                  max_new=max_new, temperature=temperature,
                                  top_k=top_k, dtype=dtype, eos_id=eos_id,
                                  decode_segments=decode_segments,
+                                 decode_kernel=decode_kernel,
                                  tp_axis=axis)
             # Certify replication for the P() out_spec: gathered ZeRO-3
             # leaves are still *marked* varying over their gather axes, so

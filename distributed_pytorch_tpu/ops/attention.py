@@ -38,6 +38,11 @@ Array = jax.Array
 # Short sequences auto-shrink via _fit_block.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+# Decode reads are skipped at block granularity (dead blocks past ``pos``),
+# so the decode kernel wants much finer tiles than training flash attention:
+# 256 keeps the skip useful at common cache lengths (512-4k) while the
+# per-grid-step overhead stays amortized (measured flat vs 512 at 4k cache).
+DEFAULT_DECODE_BLOCK_K = 256
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
 
 
@@ -397,6 +402,156 @@ def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention kernel (single-token query over a KV cache)
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, sm_scale: float, block_k: int, hkv: int,
+                   g: int):
+    """Grid (B, num_k_blocks), k innermost — ONE batch element per step.
+
+    The query tile is all H = hkv*g heads at once, (H, D); the cache tile is
+    (hkv, block_k, D).  A static loop over the hkv kv heads computes each
+    group's (g, block_k) scores — the GQA head-repeat folded into row
+    assembly, so every cache line is read once, not g times.  The online-
+    softmax state update then runs vectorized over all H rows.
+
+    ``pos`` arrives via scalar prefetch; blocks past ``pos`` are dead: their
+    compute is skipped with ``pl.when`` and their DMA is skipped by the
+    clamped BlockSpec index map (dead blocks map to the last live block, and
+    Pallas elides the copy when the block index repeats).  Keeping the whole
+    batch element's heads in one grid step keeps the grid coarse — per-step
+    overhead, not bandwidth, dominates a fine decode grid.
+    """
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    pos = pos_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k <= pos)
+    def _compute():
+        # per-kv-head scores, assembled to (H, block_k) rows
+        rows = []
+        for t in range(hkv):
+            qg = q_ref[0, t * g:(t + 1) * g]           # (g, D)
+            rows.append(jax.lax.dot_general(
+                qg, k_ref[0, t], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))   # (g, bk)
+        s = jnp.concatenate(rows, axis=0) * sm_scale   # (H, bk)
+        # exact pos+1 read bound: slots beyond pos are invalid (zero-filled
+        # future positions of the cache buffer)
+        slot = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(slot <= pos, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                         # (H, bk)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        pv = []
+        for t in range(hkv):
+            pg = p[t * g:(t + 1) * g].astype(v_ref.dtype)
+            pv.append(jax.lax.dot_general(
+                pg, v_ref[0, t], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))   # (g, D)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(pv, axis=0)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: Array, k_cache: Array, v_cache: Array, pos: Array, *,
+    sm_scale: float | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> Array:
+    """Single-token decode attention with exact ``pos+1`` cache-read bounds.
+
+    ``q``: (B, H, 1, D) this step's queries; ``k_cache``/``v_cache``:
+    (B, Hkv, S, D) full cache buffers (zero-filled beyond ``pos``); ``pos``:
+    scalar int32 — every sequence attends cache slots ``[0, pos]``.
+    Returns (B, H, 1, D).
+
+    TPU-first design (the fix for the segmented-decode workaround the
+    round-1 ROADMAP documented): decode at long cache is HBM-bound on cache
+    reads, and the compiled XLA path must read (and mask) the whole static
+    buffer — or a static per-segment bound.  Here the bound is dynamic and
+    exact: dead cache blocks past ``pos`` are never fetched (clamped index
+    map + copy elision) nor computed (``pl.when``).  GQA is folded in: the
+    grid runs per kv head with the G = H/Hkv sharing queries as rows of one
+    MXU tile, so cache lines are read ONCE per kv head, not repeated per
+    query head (``jnp.repeat`` in the XLA path materializes G copies).
+    """
+    b, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(f"decode_attention takes single-token queries, "
+                         f"got sq={sq}")
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    g = h // hkv
+    if h % hkv:
+        raise ValueError(f"{h} query heads do not group over {hkv} kv heads")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    block_k = (_fit_block(DEFAULT_DECODE_BLOCK_K, s) if block_k is None
+               else block_k)
+    if s % block_k:
+        raise ValueError(f"cache len {s} must divide block_k {block_k}")
+    nk = s // block_k
+
+    # (B, H, D) queries with each kv-head group's g queries contiguous rows
+    qf = q.reshape(b, h, d)
+    pos_arr = jnp.atleast_1d(pos).astype(jnp.int32)
+    vma = _vma(q, k_cache, v_cache)
+
+    def live_block(j, pos_ref):
+        return jnp.minimum(j, pos_ref[0] // block_k)
+
+    o = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale,
+                          block_k=block_k, hkv=hkv, g=g),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nk),
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda bb, j, pos_ref: (bb, 0, 0)),
+                pl.BlockSpec(
+                    (1, hkv, block_k, d),
+                    lambda bb, j, pos_ref: (bb, 0, live_block(j, pos_ref),
+                                            0)),
+                pl.BlockSpec(
+                    (1, hkv, block_k, d),
+                    lambda bb, j, pos_ref: (bb, 0, live_block(j, pos_ref),
+                                            0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, d),
+                                   lambda bb, j, pos_ref: (bb, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, d), jnp.float32),      # acc
+                pltpu.VMEM((h, 128), jnp.float32),    # running max m
+                pltpu.VMEM((h, 128), jnp.float32),    # running sum l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype, vma=vma),
+        interpret=interpret,
+    )(pos_arr, qf, k_cache, v_cache)
+    return o.reshape(b, h, 1, d)
 
 
 def _fit_block(limit: int, s: int) -> int:

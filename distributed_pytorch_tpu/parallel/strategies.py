@@ -10,10 +10,13 @@ train step, over the named mesh axis:
 - ``all_reduce`` — per-tensor mean via psum, kept sequential with explicit
                    optimization barriers (reference main_all_reduce.py:45-48:
                    34 sequential blocking all_reduces per step).
-- ``gather_scatter`` — per-tensor all_gather -> mean at rank 0 -> broadcast,
+- ``gather_scatter`` — per-tensor ppermute-to-rank-0 -> mean -> ppermute-out,
                    sequential (reference main_gather.py:42-59: two network
-                   crossings per tensor, all traffic through rank 0).  This is
-                   the deliberately-naive parameter-server baseline.
+                   crossings per tensor, ALL traffic through rank 0).  This is
+                   the deliberately-naive parameter-server baseline, slow for
+                   the reference's reason (device 0 is the bandwidth hotspot).
+- ``gather_scatter_symmetric`` — same semantics via all_gather + masked psum:
+                   no rank-0 hotspot; the ICI-friendly re-expression.
 - ``ddp``        — one whole-pytree pmean; XLA's latency-hiding scheduler
                    provides the bucketing/overlap that torch DDP implements in
                    C++ autograd hooks (reference main_ddp.py:137).
@@ -96,16 +99,73 @@ class AllReduce:
 
 
 class GatherScatter:
-    """Per-tensor gather -> rank-0 mean -> scatter (reference main_gather.py:42-59).
+    """Per-tensor gather -> rank-0 mean -> scatter with ALL traffic routed
+    through device 0 (reference main_gather.py:42-59).
 
-    Faithfully two collectives per tensor through rank 0: an ``all_gather``
-    (superset of the reference's gather-to-0) followed by a broadcast of
-    rank 0's mean, implemented as a masked psum so only rank 0's value
-    survives.  Kept sequential per tensor — this strategy's role is to be the
-    slow parameter-server baseline in the benchmark.
+    Wire-faithful to the reference's parameter-server baseline: for each
+    tensor, every rank's gradient crosses to rank 0 (n-1 ``ppermute`` sends,
+    all landing on device 0 — the gather, main_gather.py:49), rank 0 means
+    them (main_gather.py:53-55), then rank 0 sends the mean back out to each
+    rank (n-1 more ``ppermute`` sends, all departing device 0 — the scatter,
+    main_gather.py:59).  Two crossings per tensor through rank 0, per-tensor
+    sequential: device 0's links are the bandwidth hotspot, so this strategy
+    is slow for exactly the reference's reason.  (For the symmetric
+    ICI-friendly formulation that dissolves the hotspot, see
+    ``gather_scatter_symmetric``.)
+
+    vma note: each rank's result arrives via ``ppermute`` from rank 0 —
+    bitwise identical everywhere by construction, but assembled from
+    device-varying values the vma checker cannot prove invariant, hence
+    ``vma_opaque`` (the trainer compiles this strategy's step with
+    ``check_vma=False``; tests pin the numerics against the exact mean).
     """
 
     name = "gather_scatter"
+    needs_mesh = True
+    vma_opaque = True  # replication holds by construction, not by proof
+
+    def __init__(self, sequential: bool = True):
+        self.sequential = sequential
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        out = []
+        token = jnp.zeros((), jnp.float32)
+        for g in leaves:
+            if self.sequential:
+                g = _chain(g, token)
+            if n == 1:
+                out.append(g)
+                continue
+            # gather (main_gather.py:49): rank r's grad crosses to rank 0.
+            # The adds chain the hops, mirroring the synchronous dist.gather;
+            # on ranks != 0 each recv is zeros and acc is unused garbage.
+            acc = g
+            for r in range(1, n):
+                acc = acc + lax.ppermute(g, axis, [(r, 0)])
+            # rank-0 mean (main_gather.py:53-55): stack-then-mean == sum/n
+            mean = acc / n
+            # scatter (main_gather.py:59): rank 0 sends the mean to each
+            # rank; rank r receives exactly one nonzero payload.
+            result = jnp.where(idx == 0, mean, jnp.zeros_like(mean))
+            for r in range(1, n):
+                result = result + lax.ppermute(mean, axis, [(0, r)])
+            if self.sequential:
+                token = result.ravel()[0].astype(jnp.float32)
+            out.append(result)
+        return jax.tree.unflatten(treedef, out)
+
+
+class GatherScatterSymmetric:
+    """The same gather -> rank-0 mean -> broadcast semantics expressed with
+    symmetric collectives (``all_gather`` + masked ``psum``): numerically
+    identical to ``gather_scatter`` but with no rank-0 hotspot — the
+    ICI-friendly form XLA can schedule, kept as the contrast point showing
+    what re-expressing the parameter-server pattern buys on a torus."""
+
+    name = "gather_scatter_symmetric"
     needs_mesh = True
 
     def __init__(self, sequential: bool = True):
@@ -328,6 +388,7 @@ _REGISTRY: dict[str, Callable[[], Strategy]] = {
     "none": NoSync,
     "all_reduce": AllReduce,
     "gather_scatter": GatherScatter,
+    "gather_scatter_symmetric": GatherScatterSymmetric,
     "ddp": DDP,
     "bucketed": Bucketed,
     "quantized": QuantizedAllReduce,

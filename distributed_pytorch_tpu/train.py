@@ -55,6 +55,11 @@ class TrainConfig:
     strategy: str = "ddp"
     steps_per_loop: int = 1       # K optimizer steps per device dispatch
     sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
+    # torch DDP's broadcast_buffers=True: BN running stats follow rank 0
+    # (reference main_ddp.py:137 inherits this engine behavior); the manual
+    # variants keep local per-replica stats.  None = strategy default
+    # (True for the DDP-engine strategies 'ddp'/'bucketed', False otherwise).
+    broadcast_buffers: bool | None = None
     compute_dtype: str | None = None  # e.g. "bfloat16" for MXU-friendly compute
     augment: bool = True
     seed: int = 1                 # torch.manual_seed(1), main.py:70
@@ -62,6 +67,14 @@ class TrainConfig:
     @property
     def dtype(self):
         return jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+
+    @property
+    def broadcast_buffers_resolved(self) -> bool:
+        """torch DDP semantics by default exactly where the reference gets
+        them from the DDP engine; reference-faithful local BN elsewhere."""
+        if self.broadcast_buffers is not None:
+            return self.broadcast_buffers
+        return self.strategy in ("ddp", "bucketed")
 
 
 def _as_varying(tree: PyTree, axis: str) -> PyTree:
@@ -142,6 +155,7 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     """
     tx = make_optimizer(cfg)
     bn_axis = DATA_AXIS if (cfg.sync_bn and mesh is not None) else None
+    bcast_buffers = cfg.broadcast_buffers_resolved and mesh is not None
     grad_fn = jax.value_and_grad(
         partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
 
@@ -160,6 +174,21 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
             else:
                 local_params = params
             (loss, state), grads = grad_fn(local_params, state, k, imgs, lbls)
+            if bcast_buffers and axis is not None:
+                # torch DDP broadcast_buffers: BN running stats follow rank
+                # 0 (buffers broadcast from rank 0 every forward — reference
+                # main_ddp.py:137's engine).  Broadcasting rank 0's *updated*
+                # stats here, after the local update instead of before the
+                # next forward, yields the identical rank-0-authoritative
+                # trajectory (next forward sees rank 0's stats either way)
+                # while keeping the carried state replica-identical.
+                idx = jax.lax.axis_index(axis)
+                state = jax.tree.map(
+                    lambda s: _as_varying(
+                        jax.lax.psum(
+                            jnp.where(idx == 0, s, jnp.zeros_like(s)), axis),
+                        axis),
+                    state)
             grads = strategy(grads, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)

@@ -1,0 +1,95 @@
+"""Per-strategy gradient-sync cost on the virtual 8-device CPU mesh.
+
+The reference's whole pedagogical point is the strategy comparison — its only
+benchmark is the per-iteration wall-time print in each main_*.py (reference
+main_all_reduce.py:52-62; SURVEY.md section 6).  This script generates that
+table for every strategy the framework ships, with the reference's own metric
+discipline: compile excluded (AOT precompile stands in for the iter-0
+exclusion), per-iteration wall time averaged over a window.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python scripts/bench_strategies.py
+
+Absolute CPU-mesh times are meaningless for TPU; the *ordering* and the
+overhead-vs-fused-ddp deltas are the result (a virtual mesh still executes
+every collective's real schedule — 68 sequential rank-0 crossings for
+gather_scatter vs one fused reduction for ddp).
+
+Prints one JSON line per strategy plus a markdown table on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N_DEV = 8
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={N_DEV}").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.parallel import strategies as strat  # noqa: E402
+from distributed_pytorch_tpu.parallel.mesh import make_mesh  # noqa: E402
+from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
+
+PER_DEV_BATCH = int(os.environ.get("BENCH_PER_DEV_BATCH", "4"))
+WINDOW = int(os.environ.get("BENCH_WINDOW", "20"))
+
+
+def bench_strategy(name: str) -> float:
+    """Mean seconds/step over WINDOW iterations, compile + warm-up excluded
+    (the reference's iter-0-excluded window, main.py:43-48)."""
+    mesh = make_mesh(N_DEV) if name != "none" else None
+    cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False)
+    tr = Trainer(cfg, mesh=mesh)
+    n = N_DEV if mesh is not None else 1
+    rng = np.random.default_rng(0)
+    images = rng.integers(
+        0, 256, (PER_DEV_BATCH * n, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, PER_DEV_BATCH * n).astype(np.int32)
+
+    tr.train_step(images, labels)  # compile + warm-up (excluded)
+    times = []
+    for _ in range(WINDOW):
+        t0 = time.perf_counter()
+        loss = tr.train_step(images, labels)
+        float(loss)  # value fetch: the honest end-of-step barrier
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times)
+
+
+def main() -> None:
+    names = ["none", "ddp", "bucketed", "all_reduce",
+             "gather_scatter_symmetric", "gather_scatter",
+             "quantized", "quantized_ring"]
+    results: dict[str, float] = {}
+    for name in names:
+        t = bench_strategy(name)
+        results[name] = t
+        print(json.dumps({"strategy": name, "sec_per_step": round(t, 4),
+                          "window": WINDOW,
+                          "per_dev_batch": PER_DEV_BATCH}), flush=True)
+
+    ddp = results["ddp"]
+    print("\n| Strategy | s/step | vs ddp |", file=sys.stderr)
+    print("|---|---|---|", file=sys.stderr)
+    for name in names:
+        print(f"| {name} | {results[name]:.3f} | "
+              f"{results[name] / ddp:.2f}x |", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -152,3 +152,62 @@ def test_default_blocks_fit_any_8_aligned_seq():
                                atol=2e-5, rtol=2e-5)
     with pytest.raises(ValueError, match="8-aligned"):
         attn.flash_attention(q[:, :, :100], k[:, :, :100], v[:, :, :100])
+
+
+# ---------------------------------------------------------------------------
+# Decode kernel (single-token query over a KV cache, exact pos+1 bounds)
+# ---------------------------------------------------------------------------
+
+def _decode_oracle(q, kc, vc, pos):
+    """attention_reference over the repeated-head cache with the cache-
+    validity bias — the XLA decode path of generate._forward_cached."""
+    rep = q.shape[1] // kc.shape[1]
+    ka = jnp.repeat(kc, rep, axis=1)
+    va = jnp.repeat(vc, rep, axis=1)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, kc.shape[2]), 1)
+    bias = jnp.where(slot <= pos, 0.0, attn.NEG_INF)[None, None]
+    return attn.attention_reference(q, ka, va, bias=bias)
+
+
+@pytest.mark.parametrize("hkv", [1, 2, 4])
+def test_decode_attention_matches_reference(hkv):
+    """GQA group sizes 4/2/1 (hkv=4 is MHA), positions spanning first
+    block / mid-buffer / last slot."""
+    key = jax.random.key(3)
+    b, h, s, d = 2, 4, 256, 64
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, h, 1, d))
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, hkv, s, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, hkv, s, d))
+    for pos in (0, 5, 130, s - 1):
+        out = attn.decode_attention(q, kc, vc, jnp.int32(pos), block_k=128)
+        ref = _decode_oracle(q, kc, vc, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_ignores_garbage_past_pos():
+    """Slots beyond pos must not leak: fill the dead tail with huge values
+    and check the output is untouched (the exact-read-bound property)."""
+    key = jax.random.key(4)
+    b, h, s, d = 1, 2, 256, 64
+    pos = 100
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, h, 1, d))
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, h, s, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, h, s, d))
+    out_clean = attn.decode_attention(q, kc, vc, jnp.int32(pos), block_k=64)
+    kc_dirty = kc.at[:, :, pos + 1:].set(1e4)
+    vc_dirty = vc.at[:, :, pos + 1:].set(-1e4)
+    out_dirty = attn.decode_attention(q, kc_dirty, vc_dirty, jnp.int32(pos),
+                                      block_k=64)
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_dirty))
+
+
+def test_decode_attention_validates_shapes():
+    q = jnp.zeros((1, 4, 2, 64))  # sq=2: not a single-token query
+    kc = vc = jnp.zeros((1, 4, 256, 64))
+    with pytest.raises(ValueError, match="single-token"):
+        attn.decode_attention(q, kc, vc, jnp.int32(0))
+    q3 = jnp.zeros((1, 3, 1, 64))  # 3 q heads over 4 kv heads
+    with pytest.raises(ValueError, match="group"):
+        attn.decode_attention(q3, kc, vc, jnp.int32(0))

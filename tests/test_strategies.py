@@ -54,7 +54,8 @@ class TestStrategyEquivalence:
     def test_all_mesh_strategies_agree(self, mesh, batch):
         results = {
             s: _params_after_one_step(s, mesh, batch)[0]
-            for s in ["all_reduce", "gather_scatter", "ddp", "bucketed"]
+            for s in ["all_reduce", "gather_scatter",
+                      "gather_scatter_symmetric", "ddp", "bucketed"]
         }
         ref = results.pop("ddp")
         for name, params in results.items():
@@ -120,12 +121,49 @@ class TestStrategyEquivalence:
 
 class TestBatchNormSemantics:
     def test_local_bn_state_drifts_per_replica(self, mesh, batch):
-        """Reference-faithful local BN: replicas see different shards, so
-        their running stats diverge (SURVEY.md 2.3)."""
-        tr = Trainer(_cfg("ddp"), mesh)
+        """Reference-faithful local BN under the manual strategies: replicas
+        see different shards, so their running stats diverge (SURVEY.md 2.3;
+        torch's manual variants never touch buffers)."""
+        tr = Trainer(_cfg("all_reduce"), mesh)
         tr.train_step(*batch)
         mean = np.asarray(tr.state["bn0"]["mean"])
         assert mean.shape[0] == N_DEV
+        assert not np.allclose(mean[0], mean[1])
+
+    def test_ddp_broadcast_buffers_keeps_replicas_identical(self, mesh,
+                                                            batch):
+        """torch DDP's broadcast_buffers=True (reference main_ddp.py:137):
+        BN running stats follow rank 0 on every replica — while the manual
+        all_reduce variant drifts (the reference's behavioral delta between
+        main_ddp.py and main_all_reduce.py)."""
+        tr = Trainer(_cfg("ddp"), mesh)
+        tr.train_step(*batch)
+        tr.train_step(*batch)
+        mean = np.asarray(tr.state["bn0"]["mean"])
+        var = np.asarray(tr.state["bn0"]["var"])
+        for d in range(1, N_DEV):
+            np.testing.assert_array_equal(mean[0], mean[d])
+            np.testing.assert_array_equal(var[0], var[d])
+        # and the stats are real (not zeros): rank 0's local updates landed
+        assert not np.allclose(mean[0], 0.0)
+
+    def test_ddp_broadcast_buffers_tracks_rank0_trajectory(self, mesh,
+                                                           batch):
+        """The broadcast state trajectory == what rank 0's local-BN
+        trajectory would have been (rank 0 is authoritative, exactly
+        torch's buffer semantics)."""
+        tr = Trainer(_cfg("ddp"), mesh)
+        tr_local = Trainer(_cfg("ddp", broadcast_buffers=False), mesh)
+        tr.train_step(*batch)
+        tr_local.train_step(*batch)
+        np.testing.assert_allclose(
+            np.asarray(tr.state["bn0"]["mean"])[0],
+            np.asarray(tr_local.state["bn0"]["mean"])[0], rtol=1e-6)
+
+    def test_ddp_broadcast_buffers_off_restores_drift(self, mesh, batch):
+        tr = Trainer(_cfg("ddp", broadcast_buffers=False), mesh)
+        tr.train_step(*batch)
+        mean = np.asarray(tr.state["bn0"]["mean"])
         assert not np.allclose(mean[0], mean[1])
 
     def test_sync_bn_keeps_replicas_identical(self, mesh, batch):
@@ -146,8 +184,9 @@ class TestBatchNormSemantics:
 class TestStrategyUnits:
     def test_registry(self):
         assert strat.available() == [
-            "all_reduce", "bucketed", "ddp", "gather_scatter", "none",
-            "quantized", "quantized_ring"]
+            "all_reduce", "bucketed", "ddp", "gather_scatter",
+            "gather_scatter_symmetric", "none", "quantized",
+            "quantized_ring"]
         with pytest.raises(ValueError, match="unknown strategy"):
             strat.get("nope")
 
@@ -271,6 +310,35 @@ def test_quantized_ring_moves_int8_on_the_wire():
     assert ppermute_lines, text[:500]
     for ln in ppermute_lines:
         assert ("i8[" in ln) or ("f32[4,1]" in ln), ln
+
+
+def test_gather_scatter_routes_all_traffic_through_rank0():
+    """Wire-pattern fidelity (reference main_gather.py:49,59): every
+    inter-device transfer in the parameter-server strategy either lands on
+    or departs device 0 — rank 0 is the bandwidth hotspot, and each tensor
+    makes two crossings (n-1 sends in, n-1 sends out)."""
+    import re
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    grads = {"w": jnp.ones((4, 64, 8)), "b": jnp.ones((4, 8))}
+    gs = strat.get("gather_scatter")
+    jaxpr = jax.make_jaxpr(shard_map(
+        partial(gs, axis="data"), mesh=mesh,
+        in_specs=(P("data"),), out_specs=P("data"), check_vma=False))(grads)
+    text = str(jaxpr)
+    pairs = re.findall(r"ppermute\[[^\]]*perm=\(\((\d+), (\d+)\),\)", text)
+    assert pairs, text[:500]
+    # single-edge permutations only, every edge touching device 0
+    for src, dst in pairs:
+        assert src == "0" or dst == "0", (src, dst)
+    n_in = sum(1 for s, d in pairs if d == "0")
+    n_out = sum(1 for s, d in pairs if s == "0")
+    # two tensors x (n-1) crossings each way
+    assert n_in == 2 * 3 and n_out == 2 * 3, (n_in, n_out)
 
 
 def test_quantized_ring_trains_and_matches_ddp_curve():
