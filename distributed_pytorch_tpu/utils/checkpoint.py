@@ -182,7 +182,11 @@ class Checkpointer:
             return None
         meta = {"epoch": epoch, "step": trainer._step,
                 "model": trainer.cfg.model, "strategy": trainer.cfg.strategy,
-                "n_replicas": trainer.n_replicas}
+                "n_replicas": trainer.n_replicas,
+                # mesh trainers stack BN state with a leading replica axis;
+                # the single-device trainer stores it bare — restore needs
+                # to know which layout the saved arrays use
+                "stacked_state": trainer.mesh is not None}
         path = os.path.join(self.directory, f"ckpt_{epoch}.npz")
         if self.async_write:
             self._writer.submit(lambda: _atomic_write(
@@ -201,7 +205,15 @@ class Checkpointer:
 
     def maybe_restore(self, trainer) -> int:
         """Restore the latest checkpoint into ``trainer`` if one exists;
-        returns the epoch to resume from (0 = fresh start)."""
+        returns the epoch to resume from (0 = fresh start).
+
+        Cross-topology: a checkpoint written on a different mesh size (or
+        the single-device trainer) restores onto this trainer's topology.
+        Params/optimizer state are replicated, so only the replica-stacked
+        BN state needs resharding — rank 0's running stats are taken as
+        authoritative and re-stacked to the new replica count (the torch
+        DDP buffer-broadcast convention; exact per-replica stats are kept
+        when the topology matches)."""
         latest = self.latest()
         if latest is None:
             return 0
@@ -213,11 +225,22 @@ class Checkpointer:
             raise ValueError(
                 f"checkpoint is for model {meta['model']}, "
                 f"trainer is {trainer.cfg.model}")
-        if meta["n_replicas"] != trainer.n_replicas:
-            raise ValueError(
-                f"checkpoint has {meta['n_replicas']} replicas (per-replica "
-                f"BN state), trainer has {trainer.n_replicas}")
         params = _unflatten_like(trainer.params, flat, "params")
+        # Legacy checkpoints (no stacked_state key): mesh presence — and
+        # hence the stacked BN layout — follows the strategy exactly
+        # (Trainer keeps the mesh iff strategy.needs_mesh; only 'none'
+        # doesn't), including 1-device meshes where n_replicas==1 stacks.
+        saved_stacked = meta.get("stacked_state", meta["strategy"] != "none")
+        if (meta["n_replicas"] != trainer.n_replicas
+                or saved_stacked != (trainer.mesh is not None)):
+            for k in [k for k in flat if k.startswith("state")]:
+                v = flat[k]
+                if saved_stacked:
+                    v = v[0]  # rank 0 authoritative
+                if trainer.mesh is not None:
+                    v = np.broadcast_to(
+                        v[None], (trainer.n_replicas,) + v.shape)
+                flat[k] = v
         state = _unflatten_like(trainer.state, flat, "state")
         opt_state = _unflatten_like(trainer.opt_state, flat, "opt")
         if trainer.mesh is not None:

@@ -199,3 +199,103 @@ def test_sharded_checkpointer_dedupes_replicated(tmp_path):
     trees, _ = got
     np.testing.assert_array_equal(np.asarray(trees["t"]["shd"]),
                                   np.asarray(sharded))
+
+
+# -- cross-topology restore (round 2: VERDICT item 7) -----------------------
+
+def test_vgg_cross_topology_restore(tmp_path):
+    """A checkpoint written on one mesh size restores onto another (and onto
+    the single-device trainer): params/opt carry exactly; BN state takes
+    rank 0's stats re-stacked to the new replica count (the torch DDP
+    buffer-broadcast convention)."""
+    mesh4 = make_mesh(4)
+    cfg = TrainConfig(strategy="ddp", batch_size=2, augment=False)
+    t1 = Trainer(cfg, mesh=mesh4)
+    images, labels = _batch(8)
+    t1.train_step(images, labels)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(t1, epoch=2)
+    rank0_mean = np.asarray(t1.state["bn0"]["mean"])[0]
+
+    # dp4 -> dp8
+    t8 = Trainer(cfg, mesh=make_mesh(8))
+    assert ck.maybe_restore(t8) == 2
+    assert _tree_equal(t1.params, t8.params)
+    st8 = np.asarray(t8.state["bn0"]["mean"])
+    assert st8.shape[0] == 8
+    for d in range(8):
+        np.testing.assert_array_equal(st8[d], rank0_mean)
+    t8.train_step(*_batch(16, seed=1))  # training continues
+
+    # dp4 -> single-device
+    t_single = Trainer(TrainConfig(strategy="none", batch_size=4,
+                                   augment=False))
+    assert ck.maybe_restore(t_single) == 2
+    assert _tree_equal(t1.params, t_single.params)
+    np.testing.assert_array_equal(
+        np.asarray(t_single.state["bn0"]["mean"]), rank0_mean)
+    t_single.train_step(*_batch(4, seed=2))
+
+    # single-device -> dp4 (bare state re-stacked)
+    ck2 = Checkpointer(str(tmp_path / "single"))
+    ck2.save(t_single, epoch=5)
+    t4 = Trainer(cfg, mesh=make_mesh(4))
+    assert ck2.maybe_restore(t4) == 5
+    st4 = np.asarray(t4.state["bn0"]["mean"])
+    for d in range(4):
+        np.testing.assert_array_equal(
+            st4[d], np.asarray(t_single.state["bn0"]["mean"]))
+    t4.train_step(*_batch(8, seed=3))
+
+
+def test_sharded_checkpointer_cross_mesh_size(tmp_path):
+    """Save on a 4-device mesh, restore onto an 8-device mesh (and back):
+    shard slices differ, so restore goes through the host-assembly
+    fallback; values must be exact."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from distributed_pytorch_tpu.utils.checkpoint import ShardedCheckpointer
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("d",))
+    x = np.arange(8 * 32, dtype=np.float32).reshape(8, 32)
+    a4 = jax.device_put(x, NamedSharding(mesh4, P("d")))
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save({"t": {"x": a4}}, 0)
+
+    like8 = jax.device_put(np.zeros_like(x), NamedSharding(mesh8, P("d")))
+    got = ck.restore({"t": {"x": like8}})
+    assert got is not None
+    out = got[0]["t"]["x"]
+    np.testing.assert_array_equal(np.asarray(out), x)
+    assert out.sharding.is_equivalent_to(like8.sharding, out.ndim)
+
+    # and 8 -> 4
+    ck2 = ShardedCheckpointer(str(tmp_path / "w8"))
+    a8 = jax.device_put(x, NamedSharding(mesh8, P("d")))
+    ck2.save({"t": {"x": a8}}, 0)
+    like4 = jax.device_put(np.zeros_like(x), NamedSharding(mesh4, P("d")))
+    got = ck2.restore({"t": {"x": like4}})
+    np.testing.assert_array_equal(np.asarray(got[0]["t"]["x"]), x)
+
+
+def test_pytree_checkpointer_cross_mesh_size(tmp_path):
+    """PyTreeCheckpointer stores dense host arrays, so cross-mesh restore
+    is re-placement onto the template's shardings."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from distributed_pytorch_tpu.utils.checkpoint import PyTreeCheckpointer
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("d",))
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    a4 = jax.device_put(x, NamedSharding(mesh4, P("d")))
+    ck = PyTreeCheckpointer(str(tmp_path))
+    ck.save({"t": {"x": a4}}, step=7)
+
+    like8 = jax.device_put(np.zeros_like(x), NamedSharding(mesh8, P("d")))
+    got = ck.restore({"t": {"x": like8}})
+    assert got is not None
+    trees, meta = got
+    assert meta["step"] == 7
+    np.testing.assert_array_equal(np.asarray(trees["t"]["x"]), x)
+    assert trees["t"]["x"].sharding.is_equivalent_to(
+        like8.sharding, trees["t"]["x"].ndim)
