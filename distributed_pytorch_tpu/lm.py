@@ -59,16 +59,21 @@ class LMTrainConfig:
     aux_coef: float = 0.01  # MoE load-balance loss weight (Switch default)
     compute_dtype: str | None = "bfloat16"
     seed: int = 1
-    # parallel degrees; dp * sp * tp (or dp * pp) must equal the mesh size
+    # parallel degrees; dp * sp * tp * pp must equal the mesh size
     dp: int = 1
     sp: int = 1
     tp: int = 1
-    pp: int = 1          # pipeline stages; composes with dp/tp
+    pp: int = 1          # pipeline stages; composes with dp, sp, and tp
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     # Virtual pipeline stages per device (Megatron interleaved placement):
     # the fill/drain bubble shrinks by this factor (parallel/pipeline.py
     # wave schedule).  Requires n_layers % (pp * interleave) == 0.
     interleave: int = 1
+    # Tick-scan remat block for pp (parallel/pipeline.py): 0 = auto (one
+    # wave per block — 1F1B-grade O(pp*mb) activation memory), None = flat
+    # scan (O(num_ticks) memory; kept for A/B measurement), or an explicit
+    # tick count.
+    pp_remat_block: int | None = 0
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
     @property
     def dtype(self) -> jnp.dtype | None:
@@ -90,8 +95,6 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
             "interleave (virtual pipeline stages) requires pp > 1; with "
             "pp=1 it would be silently ignored")
     if cfg.pp > 1:
-        if cfg.sp != 1:
-            raise ValueError("pp composes with dp and tp (sp must be 1)")
         if cfg.model.n_experts:
             raise ValueError(
                 "pp does not support MoE models (n_experts > 0): expert "
@@ -99,9 +102,11 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
         if cfg.tp > 1 and (cfg.model.n_heads % cfg.tp
                            or cfg.model.kv_heads % cfg.tp):
             raise ValueError(f"heads must divide over tp={cfg.tp}")
-        return make_mesh(cfg.dp * cfg.pp * cfg.tp,
-                         axis_names=(DATA, PIPE, MODEL),
-                         axis_shape=(cfg.dp, cfg.pp, cfg.tp),
+        # pp composes with dp, sp (ring attention inside each stage's
+        # layer chunks) and tp — a 4-axis mesh; unused axes have size 1.
+        return make_mesh(cfg.dp * cfg.pp * cfg.sp * cfg.tp,
+                         axis_names=(DATA, PIPE, SEQ, MODEL),
+                         axis_shape=(cfg.dp, cfg.pp, cfg.sp, cfg.tp),
                          devices=devices)
     if cfg.tp > 1:
         if cfg.model.n_heads % cfg.tp:
@@ -269,9 +274,12 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
 
 
 def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
-    """Pipeline-parallel step over Mesh((data, pipe)): tokens/targets arrive
-    (global_batch, S); each data-rank cuts its local batch into microbatches
-    and drives the GPipe schedule (parallel/pipeline.py)."""
+    """Pipeline-parallel step over Mesh((data, pipe, seq, model)):
+    tokens/targets arrive (global_batch, S) sharded (data, seq); each
+    data-rank cuts its local batch into microbatches and drives the wave
+    schedule (parallel/pipeline.py).  With sp > 1 each stage's layer chunks
+    run ring attention over the 'seq' axis — long-context pipeline
+    training (pp x sp), composing further with tp."""
     from .parallel import pipeline as pp
 
     tx = make_optimizer(cfg)
@@ -279,6 +287,7 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
     n_micro = cfg.microbatches or 2 * cfg.pp
 
     tp_axis = MODEL if cfg.tp > 1 else None
+    seq_axis = SEQ if cfg.sp > 1 else None
 
     def local_loss(stage_params, shared, tokens, targets):
         b_local = tokens.shape[0]
@@ -289,12 +298,15 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         mb = b_local // n_micro
         tokens = tokens.reshape(n_micro, mb, -1)
         targets = targets.reshape(n_micro, mb, -1)
+        pos = _shard_positions(cfg, tokens.shape[-1])
         ce_sum, n = pp.pipeline_loss(stage_params, shared, tokens, targets,
                                      cfg=cfg.model, axis=PIPE, dtype=dtype,
-                                     tp_axis=tp_axis,
-                                     interleave=cfg.interleave)
-        ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE))
-        n = jax.lax.psum(n, (DATA, PIPE))
+                                     tp_axis=tp_axis, seq_axis=seq_axis,
+                                     seq_layout=cfg.seq_layout, pos=pos,
+                                     interleave=cfg.interleave,
+                                     remat_block_ticks=cfg.pp_remat_block)
+        ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE, SEQ))
+        n = jax.lax.psum(n, (DATA, PIPE, SEQ))
         return ce_sum / jnp.maximum(n, 1)
 
     stage_specs = pp_stage_specs(cfg)
@@ -303,12 +315,14 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
     grad_step = shard_map(
         jax.value_and_grad(local_loss, argnums=(0, 1)),
         mesh=mesh,
-        in_specs=(stage_specs, shared_specs, P(DATA), P(DATA)),
+        in_specs=(stage_specs, shared_specs, P(DATA, SEQ), P(DATA, SEQ)),
         out_specs=(P(), (stage_specs, shared_specs)),
     )
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
+        tokens = _zigzag_global(cfg, tokens)
+        targets = _zigzag_global(cfg, targets)
         loss, grads = grad_step(params["stages"], params["shared"],
                                 tokens, targets)
         grads = {"stages": grads[0], "shared": grads[1]}
@@ -352,13 +366,12 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
 
 class LMTrainer:
     """Owns (params, opt_state) laid out over the (data, seq, model) mesh —
-    or the (data, pipe) mesh when cfg.pp > 1."""
+    or the (data, pipe, seq, model) mesh when cfg.pp > 1."""
 
     def __init__(self, cfg: LMTrainConfig, mesh: Mesh | None = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_lm_mesh(cfg)
-        want = cfg.dp * (cfg.pp * cfg.tp if cfg.pp > 1
-                         else cfg.sp * cfg.tp)
+        want = cfg.dp * cfg.sp * cfg.tp * cfg.pp
         assert self.mesh.devices.size == want, (
             f"mesh has {self.mesh.devices.size} devices, config wants {want}")
 
@@ -492,8 +505,7 @@ class LMTrainer:
         return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
-        spec = P(DATA) if self.cfg.pp > 1 else P(DATA, SEQ)
-        shd = NamedSharding(self.mesh, spec)
+        shd = NamedSharding(self.mesh, P(DATA, SEQ))
         if jax.process_count() > 1:
             tokens = jax.make_array_from_process_local_data(shd, tokens)
             targets = jax.make_array_from_process_local_data(shd, targets)

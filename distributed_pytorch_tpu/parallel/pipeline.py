@@ -127,14 +127,22 @@ def stage_specs(cfg: tfm.TransformerConfig, n_stages: int,
 
 def _chunk(chunk_layers: PyTree, x: jax.Array,
            cfg: tfm.TransformerConfig, attn_impl: str,
-           tp_axis: str | None = None) -> jax.Array:
+           tp_axis: str | None = None,
+           seq_axis: str | None = None,
+           seq_layout: str = "contiguous",
+           pos: jax.Array | None = None) -> jax.Array:
     """Run one chunk's layers_per_chunk blocks (a homogeneous layer scan
-    over the shared models/transformer.py:block body)."""
-    pos = jnp.arange(x.shape[1])
+    over the shared models/transformer.py:block body).  With ``seq_axis``
+    the activations are sequence shards and each block's attention is the
+    ring over that axis (pp x sp composition); ``pos`` is then the shard's
+    absolute token positions."""
+    if pos is None:
+        pos = jnp.arange(x.shape[1])
 
     def body(x, lp):
         x, _ = tfm.block(lp, x, cfg=cfg, is_moe=False, pos=pos,
-                         attn_impl=attn_impl, tp_axis=tp_axis)
+                         attn_impl=attn_impl, tp_axis=tp_axis,
+                         seq_axis=seq_axis, seq_layout=seq_layout)
         return x, None
 
     x, _ = lax.scan(body, x, chunk_layers)
@@ -162,14 +170,25 @@ def pipeline_loss(
     dtype: jnp.dtype | None = None,
     attn_impl: str = "flash",
     tp_axis: str | None = None,
+    seq_axis: str | None = None,
+    seq_layout: str = "contiguous",
+    pos: jax.Array | None = None,
     interleave: int = 1,
+    remat_block_ticks: int | None = 0,
 ) -> jax.Array:
     """Mean masked CE over all microbatches, computed through the pipeline.
 
     Runs inside shard_map with ``stage_params`` leaves carrying this
     device's (1, interleave, layers_per_chunk, ...) slice.  Returns the
     loss summed over this shard's tokens plus the valid-token count (both
-    to be psum'd by the caller across data/pipe axes).
+    to be psum'd by the caller across data/pipe/seq axes).
+
+    With ``seq_axis`` (pp x sp), ``tokens``/``targets`` are sequence
+    shards: every microbatch's activations stay seq-sharded through the
+    pipeline hops, and each chunk's attention is the ring over
+    ``seq_axis``.  The ring's collectives run inside the tick, so pipeline
+    (pipe-axis ppermute) and ring (seq-axis ppermute) traffic interleave
+    tick by tick.  ``pos`` is this seq shard's absolute positions.
     """
     from ..ops.nn import masked_ce
 
@@ -186,7 +205,8 @@ def pipeline_loss(
         x_all = x_all.astype(dtype)
 
     chunk_fn = jax.checkpoint(partial(_chunk, cfg=cfg, attn_impl=attn_impl,
-                                      tp_axis=tp_axis))
+                                      tp_axis=tp_axis, seq_axis=seq_axis,
+                                      seq_layout=seq_layout, pos=pos))
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: chunk k*n+s -> +1
 
     # Scan carries must be varying over every axis their updates vary over:
@@ -231,6 +251,36 @@ def pipeline_loss(
 
     ce0 = _varying(jnp.zeros(()))
     n0 = _varying(jnp.zeros((), jnp.int32))
+
+    # -- 1F1B-grade activation memory: block-remat over the tick scan ------
+    # A flat scan of T ticks saves one (mb, S, D) carry per tick for the
+    # backward: O(T) = O(M*v) live activations — the O(num_ticks) wall.
+    # Nesting the scan (outer over blocks of ``remat_block_ticks`` ticks,
+    # inner scan checkpointed) makes the backward keep only the T/block
+    # block-boundary carries and rematerialize one block at a time, so peak
+    # live activations are O(M*v/n + n) microbatch-sized buffers — for the
+    # standard M = O(n) microbatch regime, O(pp * mb), 1F1B's bound.  The
+    # price is one extra tick-forward per backward (the usual remat trade;
+    # the per-chunk jax.checkpoint above keeps the within-block recompute
+    # itself lean).  remat_block_ticks: 0 = auto (one wave, n ticks);
+    # None = flat scan (the O(T) layout, kept for A/B memory tests).
+    ticks = num_ticks(m_micro, n, v)
+    if remat_block_ticks is None:
+        (_, ce_sum, n_sum), _ = lax.scan(
+            tick, (zero_x, ce0, n0), jnp.arange(ticks))
+        return ce_sum, n_sum
+    block = remat_block_ticks or n
+    # Padded tail ticks still run a full (masked-out) chunk forward — they
+    # are no-ops for the loss, not for compute.  The auto block (n) wastes
+    # at most n-1 ticks; an explicit oversized block wastes up to block-1.
+    t_pad = -(-ticks // block) * block
+
+    @partial(jax.checkpoint, prevent_cse=False)
+    def tick_block(carry, ts):
+        carry, _ = lax.scan(tick, carry, ts)
+        return carry, None
+
     (_, ce_sum, n_sum), _ = lax.scan(
-        tick, (zero_x, ce0, n0), jnp.arange(num_ticks(m_micro, n, v)))
+        tick_block, (zero_x, ce0, n0),
+        jnp.arange(t_pad).reshape(t_pad // block, block))
     return ce_sum, n_sum

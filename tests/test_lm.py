@@ -130,11 +130,29 @@ def test_moe_lm_mesh_parity_and_training():
     assert losses[-1] < losses[0]
 
 
-def test_pp_with_sp_rejected():
-    from distributed_pytorch_tpu.lm import make_lm_mesh
-    import pytest
-    with pytest.raises(ValueError, match="pp composes"):
-        make_lm_mesh(LMTrainConfig(pp=2, sp=2))
+def test_pp_with_sp_matches_dense_oracle():
+    """pp x sp composition (round 2): ring attention inside pipeline
+    stages over a (data, pipe, seq) mesh follows the dense single-device
+    trajectory exactly (same seed, same data, f32)."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=128, d_model=128, n_layers=2,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, (8, 128)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    targets[:, -1] = IGNORE
+
+    losses = {}
+    for name, kw in (("dense", dict(dp=1)),
+                     ("pp2sp2", dict(dp=1, pp=2, sp=2, microbatches=4)),
+                     ("pp2sp2dp2", dict(dp=2, pp=2, sp=2, microbatches=2))):
+        tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None, **kw))
+        losses[name] = [float(tr.train_step(tokens, targets))
+                        for _ in range(2)]
+    np.testing.assert_allclose(losses["pp2sp2"], losses["dense"], rtol=2e-4)
+    np.testing.assert_allclose(losses["pp2sp2dp2"], losses["dense"],
+                               rtol=2e-4)
 
 
 def test_fsdp_shards_params_and_matches_dense():
@@ -257,3 +275,36 @@ def test_interleave_split_merge_roundtrip():
     back = pp.merge_layer_params(stages, shared, cfg)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pp_block_remat_bounds_activation_memory():
+    """1F1B-grade memory (round 2): the block-rematted tick scan (default)
+    must compile to substantially less temp memory than the flat O(num_ticks)
+    scan at a microbatch-heavy config, with an identical loss."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=128, d_model=128, n_layers=2,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, (32, 128)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1).astype(np.int32)
+    targets[:, -1] = IGNORE
+
+    def build(remat):
+        cfg = LMTrainConfig(model=model, compute_dtype=None, dp=1, pp=2,
+                            microbatches=16, pp_remat_block=remat)
+        tr = LMTrainer(cfg)
+        lowered = tr.step_fn.lower(tr.params, tr.opt_state,
+                                   jnp.asarray(tokens), jnp.asarray(targets))
+        stats = lowered.compile().memory_analysis()
+        return stats.temp_size_in_bytes, tr
+
+    flat_bytes, tr_flat = build(None)
+    blocked_bytes, tr_blocked = build(0)
+    # 17 saved tick carries vs ~9 block carries + one in-flight block; the
+    # non-activation temp dilutes the ratio — 1.4x is a conservative floor
+    # (measured 1.8x at this config).
+    assert blocked_bytes * 1.4 < flat_bytes, (blocked_bytes, flat_bytes)
+    l_flat = float(tr_flat.train_step(tokens, targets))
+    l_blocked = float(tr_blocked.train_step(tokens, targets))
+    assert abs(l_flat - l_blocked) < 1e-5
