@@ -117,7 +117,9 @@ class GatherScatter:
     bitwise identical everywhere by construction, but assembled from
     device-varying values the vma checker cannot prove invariant, hence
     ``vma_opaque`` (the trainer compiles this strategy's step with
-    ``check_vma=False``; tests pin the numerics against the exact mean).
+    ``check_vma=False``, replaces the lost static proof with a one-time
+    dynamic replication check after the first step, and tests pin the
+    numerics against the exact mean).
     """
 
     name = "gather_scatter"

@@ -291,6 +291,15 @@ class Trainer:
         self._multi_fn = None   # jitted K-step program, built lazily
         self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
+        # vma-opaque strategies (ppermute-assembled results) compile with
+        # check_vma=False — the static replication proof is off, so the
+        # first real step is followed by a one-time DYNAMIC verification
+        # that params/opt-state are still bitwise replicated (the failure
+        # mode the static checker would have caught is a missing/broken
+        # collective, which desyncs immediately, not gradually).
+        self._verify_replication = bool(
+            getattr(self.strategy, "vma_opaque", False)
+            and self.mesh is not None)
 
     # -- one optimizer step over a *global* batch -------------------------
     def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
@@ -363,6 +372,9 @@ class Trainer:
         self.params, self.state, self.opt_state, losses = (
             self._executable(args)(*args))
         self._step += k
+        if self._verify_replication:
+            self._verify_replication = False
+            self.check_consistency()
         return losses
 
     def train_epoch(self, loaders, epoch: int, *, log=print):

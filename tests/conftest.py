@@ -22,3 +22,11 @@ import jax  # noqa: E402
 # config back to cpu so tests always run on the virtual 8-device mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Persistent XLA compilation cache: the suite is compile-dominated (one CPU
+# core on the TPU host), and most programs are identical run to run —
+# warm-cache suite time is a fraction of cold.  The cache dir is local to
+# the repo (gitignored); safe to delete any time.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -151,6 +151,23 @@ class TestEvaluate:
             total += float(ops.cross_entropy_loss(logits, jnp.asarray(labels)))
         assert loss == pytest.approx(total / 3, rel=1e-5)
 
+    def test_eval_print_matches_reference_bytes(self):
+        """The printed eval line is byte-identical to the reference's
+        format (main.py:64-66: 'Test set: Average loss: {:.4f}, Accuracy:
+        {}/{} ({:.0f}%)\\n')."""
+        ds = cifar10._synthetic(32, seed=3)
+        cfg = TrainConfig(model="TINY", batch_size=16, strategy="none")
+        tr = Trainer(cfg)
+        lines = []
+        loss, acc = eval_mod.evaluate(tr.params, tr.eval_state(),
+                                      DataLoader(ds, 16),
+                                      model_name="TINY", log=lines.append)
+        correct = round(acc * 32)
+        want = ('Test set: Average loss: {:.4f}, Accuracy: {}/{} '
+                '({:.0f}%)\n').format(loss, correct, 32,
+                                      100. * correct / 32)
+        assert lines == [want]
+
     def test_eval_uses_rank0_state_under_mesh(self):
         mesh = make_mesh(4)
         cfg = TrainConfig(model="TINY", batch_size=4, strategy="ddp",
