@@ -86,7 +86,8 @@ def decode(tokens: np.ndarray) -> str:
 
 @dataclass
 class LMCorpus:
-    """One long token stream (int32 in [0, 256))."""
+    """One long token stream: int32 in [0, 256), or a lazy uint8 memmap
+    (``load_corpus(mmap=True)``) — the loader casts per batch either way."""
 
     tokens: np.ndarray
     synthetic: bool = False
@@ -96,11 +97,28 @@ class LMCorpus:
 
 
 def load_corpus(path: str | None = None, *,
-                synthetic_bytes: int = 1 << 20) -> LMCorpus:
-    """Load a text file as a byte-level corpus, else the synthetic fallback."""
+                synthetic_bytes: int = 1 << 20,
+                mmap: bool = False) -> LMCorpus:
+    """Load a text file as a byte-level corpus, else the synthetic fallback.
+
+    ``mmap=True`` memory-maps the file instead of reading it: the corpus
+    never materializes in host RAM — each batch's windows are read lazily
+    through the page cache, so a rank only ever touches its own shard's
+    pages.  This is the ingestion path for corpora larger than one host's
+    memory (every rank opens the same file; the per-rank window striding in
+    ``LMDataLoader`` does the sharding).  Byte-level vocabulary means the
+    on-disk bytes ARE the token stream — no detokenized copy exists.
+    """
     if path is not None:
+        if mmap:
+            return LMCorpus(np.memmap(path, dtype=np.uint8, mode="r"),
+                            synthetic=False)
         with open(path, "rb") as f:
             return LMCorpus(encode(f.read()), synthetic=False)
+    if mmap:
+        raise ValueError(
+            "mmap=True requires a corpus path: the synthetic fallback is "
+            "generated in RAM, which defeats the larger-than-memory intent")
     return LMCorpus(encode(synthetic_corpus(synthetic_bytes)), synthetic=True)
 
 

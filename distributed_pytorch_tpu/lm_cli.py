@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--corpus", default=None,
                    help="path to a text file (byte-level); default: "
                         "deterministic synthetic corpus")
+    p.add_argument("--mmap-corpus", action="store_true",
+                   help="memory-map --corpus instead of loading it into "
+                        "RAM (for corpora larger than host memory; each "
+                        "rank lazily reads only its own windows' pages)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=0,
                    help="evaluate held-out loss/ppl every N steps (holds "
@@ -148,7 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         if start:
             log.info("resumed at step %d", start)
 
-    corpus = lm_corpus.load_corpus(args.corpus)
+    corpus = lm_corpus.load_corpus(args.corpus, mmap=args.mmap_corpus)
     log.info("corpus: %d tokens (%s)", len(corpus),
              "synthetic" if corpus.synthetic else args.corpus)
     val_loader = None

@@ -259,3 +259,26 @@ def test_eos_early_stop_pads_remainder():
                        max_new=12, temperature=0.0, eos_id=eos)
     tail = np.asarray(out[0, 5:])
     assert (tail == eos).all()
+
+
+def test_mmap_corpus_matches_eager(tmp_path):
+    """mmap ingestion (the larger-than-RAM path) yields batch-identical
+    windows to the eager loader, without materializing the stream."""
+    text = lm_corpus.synthetic_corpus(1 << 14, seed=5)
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(text)
+
+    eager = lm_corpus.load_corpus(str(path))
+    lazy = lm_corpus.load_corpus(str(path), mmap=True)
+    assert isinstance(lazy.tokens, np.memmap)
+    assert len(eager) == len(lazy)
+
+    for rank in range(2):
+        dl_e = lm_corpus.LMDataLoader(eager, batch_size=4, seq_len=64,
+                                      num_replicas=2, rank=rank, seed=3)
+        dl_l = lm_corpus.LMDataLoader(lazy, batch_size=4, seq_len=64,
+                                      num_replicas=2, rank=rank, seed=3)
+        for (t_e, y_e), (t_l, y_l) in zip(dl_e, dl_l):
+            np.testing.assert_array_equal(t_e, t_l)
+            np.testing.assert_array_equal(y_e, y_l)
+            assert t_l.dtype == np.int32
