@@ -137,6 +137,14 @@ class LMDataLoader:
     ``drop_last`` defaults to True: a partial final batch would change the
     compiled step's shapes (recompile) and break divisibility over the
     data-parallel mesh axis.
+
+    ``shuffle_mode``: 'permutation' (default) materializes the exact
+    DistributedSampler epoch permutation — O(n_windows) index memory.
+    'affine' draws a full-period modular-affine bijection
+    (idx = (a*x + b) mod n, gcd(a, n) = 1) per epoch instead: O(1) memory,
+    for corpora whose window COUNT is itself too large to index in host
+    RAM (pairs with ``load_corpus(mmap=True)``).  Weaker statistical
+    shuffle (a strided walk), same determinism and sharding guarantees.
     """
 
     def __init__(
@@ -150,11 +158,15 @@ class LMDataLoader:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = True,
+        shuffle_mode: str = "permutation",
     ):
         if len(corpus) < seq_len + 1:
             raise ValueError(
                 f"corpus of {len(corpus)} tokens is shorter than one "
                 f"window ({seq_len} + 1)")
+        if shuffle_mode not in ("permutation", "affine"):
+            raise ValueError(f"shuffle_mode must be 'permutation' or "
+                             f"'affine', got {shuffle_mode!r}")
         self.corpus = corpus
         self.batch_size = batch_size
         self.seq_len = seq_len
@@ -163,6 +175,7 @@ class LMDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        self.shuffle_mode = shuffle_mode
         self._epoch = 0
         # -1: the last window must have a next-byte target available
         self.n_windows = (len(corpus) - 1) // seq_len
@@ -176,24 +189,44 @@ class LMDataLoader:
             return self.per_rank // self.batch_size
         return -(-self.per_rank // self.batch_size)
 
-    def _window_order(self) -> np.ndarray:
-        order = np.arange(self.n_windows)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self._epoch)
-            order = rng.permutation(order)
-        # pad to a multiple of num_replicas by cycling the permutation (the
-        # DistributedSampler convention — correct even when the pad exceeds
-        # n_windows), then stride by rank: every rank gets per_rank windows
-        order = np.resize(order, self.per_rank * self.num_replicas)
-        return order[self.rank::self.num_replicas]
+    def _epoch_bijection(self):
+        """This epoch's window bijection as a vectorized int->int map.
+
+        Applied at padded-order position p as bijection(p % n_windows):
+        identical to cycling the materialized permutation (the
+        DistributedSampler convention — correct even when the pad exceeds
+        n_windows)."""
+        n = self.n_windows
+        if not self.shuffle:
+            return lambda x: x
+        rng = np.random.default_rng(self.seed + self._epoch)
+        if self.shuffle_mode == "permutation":
+            perm = rng.permutation(n)
+            return lambda x: perm[x]
+        # affine: (a*x + b) mod n with gcd(a, n) == 1 is a bijection on
+        # [0, n) — no index array ever materializes.  Python-int math per
+        # element: a*x reaches (n-1)^2, which silently wraps int64 beyond
+        # n ~ 3e9 windows — exactly this mode's target scale — and a
+        # wrapped product breaks the bijection; batches are small, so the
+        # arbitrary-precision loop is free.
+        import math
+        while True:
+            a = int(rng.integers(1, max(n, 2)))
+            if math.gcd(a, n) == 1:
+                break
+        b = int(rng.integers(0, max(n, 1)))
+        return lambda x: np.array([(a * int(v) + b) % n for v in np.atleast_1d(x)],
+                                  dtype=np.int64)
 
     def __iter__(self):
         toks = self.corpus.tokens
-        order = self._window_order()
-        end = (len(order) // self.batch_size * self.batch_size
-               if self.drop_last else len(order))
+        bij = self._epoch_bijection()
+        end = (self.per_rank // self.batch_size * self.batch_size
+               if self.drop_last else self.per_rank)
         for start in range(0, end, self.batch_size):
-            idx = order[start:start + self.batch_size]
+            js = np.arange(start, min(start + self.batch_size, end))
+            p = js * self.num_replicas + self.rank
+            idx = bij(p % max(self.n_windows, 1))
             batch = np.stack([
                 toks[i * self.seq_len: i * self.seq_len + self.seq_len + 1]
                 for i in idx])

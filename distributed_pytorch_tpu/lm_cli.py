@@ -125,7 +125,11 @@ def model_config(args) -> tfm.TransformerConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.mmap_corpus and not args.corpus:
+        parser.error("--mmap-corpus requires --corpus (the synthetic "
+                     "fallback is generated in RAM)")
     if args.rendezvous == "env":
         dist_init.init_from_env()
     else:

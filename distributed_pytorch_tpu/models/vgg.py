@@ -31,7 +31,9 @@ from ..ops import nn as ops
 Array = jax.Array
 PyTree = Any
 
-# Reference model.py:3-8, verbatim cfg lists.
+# Reference model.py:3-8, verbatim cfg lists; TINY is this package's own
+# smoke/CI config (same 5-pool topology, ~64x fewer params) — not part of
+# the reference family.
 CFG = {
     "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
     "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
@@ -39,6 +41,7 @@ CFG = {
               512, 512, 512, "M"],
     "VGG19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
               512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+    "TINY": [8, "M", 16, "M", 16, 16, "M", 32, 32, "M", 32, 32, "M"],
 }
 
 NUM_CLASSES = 10

@@ -25,10 +25,16 @@ def _data(b=4, s=256, vocab=1024):
 
 @pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (1, 4, 2)])
 def test_trajectory_invariant_to_mesh_layout(dp, sp, tp):
-    tokens, targets = _data()
+    # Small explicit model: the invariance property is dimension-independent
+    # and VGG/LM-tiny-sized compiles dominate one-core suite time.
+    from distributed_pytorch_tpu.models import transformer as tfm
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    tokens, targets = _data(s=128, vocab=256)
     runs = {}
     for name, (d, s, t) in {"base": (1, 1, 1), "par": (dp, sp, tp)}.items():
-        cfg = LMTrainConfig(dp=d, sp=s, tp=t, compute_dtype=None)
+        cfg = LMTrainConfig(model=model, dp=d, sp=s, tp=t,
+                            compute_dtype=None)
         tr = LMTrainer(cfg)
         losses = [float(tr.train_step(tokens, targets)) for _ in range(3)]
         runs[name] = (losses, jax.tree.map(np.asarray, tr.params))
@@ -42,8 +48,12 @@ def test_trajectory_invariant_to_mesh_layout(dp, sp, tp):
 
 
 def test_loss_falls():
-    tokens, targets = _data(b=2, s=128)
-    tr = LMTrainer(LMTrainConfig(dp=2, sp=2, tp=2, compute_dtype=None))
+    from distributed_pytorch_tpu.models import transformer as tfm
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    tokens, targets = _data(b=2, s=128, vocab=256)
+    tr = LMTrainer(LMTrainConfig(model=model, dp=2, sp=2, tp=2,
+                                 compute_dtype=None))
     losses = [float(tr.train_step(tokens, targets)) for _ in range(6)]
     assert losses[-1] < losses[0], losses
     assert np.isfinite(losses).all()
@@ -77,9 +87,9 @@ def test_pipeline_parallel_matches_dense():
     single-device trajectory exactly (same loss mean over microbatches)."""
     from distributed_pytorch_tpu.models import transformer as tfm
 
-    model = tfm.TransformerConfig(vocab_size=512, d_model=128, n_layers=4,
-                                  n_heads=2, head_dim=64)
-    tokens, targets = _data(b=8, s=128, vocab=512)
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=4,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    tokens, targets = _data(b=8, s=64, vocab=256)
     runs = {}
     for name, kw in {"base": dict(), "pp4": dict(pp=4),
                      "dp2pp2": dict(dp=2, pp=2)}.items():
@@ -113,7 +123,7 @@ def test_moe_lm_mesh_parity_and_training():
     model = tfm.TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
                                   n_heads=4, head_dim=32, n_experts=4,
                                   capacity_factor=8.0)  # no drops => parity
-    tokens, targets = _data(b=4, s=128, vocab=512)
+    tokens, targets = _data(b=4, s=64, vocab=512)
     runs = {}
     for name, kw in {"base": dict(), "ep4": dict(tp=4),
                      "3d": dict(dp=2, sp=2, tp=2)}.items():

@@ -282,3 +282,32 @@ def test_mmap_corpus_matches_eager(tmp_path):
             np.testing.assert_array_equal(t_e, t_l)
             np.testing.assert_array_equal(y_e, y_l)
             assert t_l.dtype == np.int32
+
+
+def test_affine_shuffle_mode_is_sharded_bijection():
+    """'affine' shuffling (O(1) index memory for huge-window corpora):
+    ranks partition the window set exactly like the permutation mode, and
+    epochs differ."""
+    corpus = lm_corpus.LMCorpus(np.arange(64 * 65, dtype=np.int32))
+    n_windows = (len(corpus) - 1) // 64
+    seen = []
+    for rank in range(4):
+        dl = lm_corpus.LMDataLoader(corpus, batch_size=2, seq_len=64,
+                                    num_replicas=4, rank=rank, seed=0,
+                                    shuffle_mode="affine")
+        for tokens, targets in dl:
+            seen.extend(tokens[:, 0].tolist())
+            np.testing.assert_array_equal(targets[:, :-1], tokens[:, 1:])
+    assert len(seen) == 4 * (-(-n_windows // 4))
+    assert len(set(seen)) >= n_windows - 3  # padding dupes only
+
+    dl = lm_corpus.LMDataLoader(corpus, batch_size=2, seq_len=64, seed=0,
+                                shuffle_mode="affine")
+    dl.set_epoch(0)
+    first0 = next(iter(dl))[0]
+    dl.set_epoch(1)
+    first1 = next(iter(dl))[0]
+    assert not np.array_equal(first0, first1)
+
+    with pytest.raises(ValueError, match="shuffle_mode"):
+        lm_corpus.LMDataLoader(corpus, 2, 64, shuffle_mode="bogus")

@@ -64,14 +64,7 @@ class TestOptimizerParity:
             np.asarray(params["w"]), wt.detach().numpy(), atol=1e-6)
 
 
-TINY = [8, "M", 16, "M", 16, 16, "M", 32, 32, "M", 32, 32, "M"]
-
-
-@pytest.fixture(autouse=True)
-def _tiny_model():
-    from distributed_pytorch_tpu.models import vgg
-    vgg.CFG.setdefault("TINY", TINY)
-    yield
+# "TINY" is a first-class smoke config in models/vgg.py CFG.
 
 
 class TestLearning:
@@ -197,7 +190,8 @@ def test_train_steps_scan_matches_single_steps():
     for strategy, mesh in (("none", None), ("ddp", make_mesh(4))):
         # small lr: keeps the trajectory numerically tame so scan-vs-unrolled
         # fusion differences stay at float32 noise level
-        cfg = TrainConfig(strategy=strategy, batch_size=gb, lr=1e-3)
+        cfg = TrainConfig(model="TINY", strategy=strategy, batch_size=gb,
+                          lr=1e-3)
         a = Trainer(cfg, mesh=mesh)
         single_losses = [float(a.train_step(images[i], labels[i]))
                          for i in range(k)]
@@ -232,8 +226,8 @@ def test_train_epoch_steps_per_loop_matches():
     ds = _Synth(40)  # 5 batches of 8 -> chunks of 2 + ragged tail of 1
     params = {}
     for spl in (1, 2):
-        cfg = TrainConfig(strategy="none", batch_size=8, steps_per_loop=spl,
-                          lr=1e-3, augment=False)
+        cfg = TrainConfig(model="TINY", strategy="none", batch_size=8,
+                          steps_per_loop=spl, lr=1e-3, augment=False)
         tr = Trainer(cfg)
         loader = DataLoader(ds, 8, shuffle=True, seed=0)
         tr.train_epoch([loader], 0, log=None)

@@ -1,21 +1,30 @@
-"""Worker for the 2-process distributed-training integration test.
+"""Worker for the multi-process distributed-training integration tests.
 
 Launched by distributed_pytorch_tpu.launch with env-var rendezvous; each
-process gets 2 fake CPU devices, so the gang trains over a real 2-process /
-4-device mesh: jax.distributed rendezvous, cross-process collectives, and
-the make_array_from_process_local_data batch-assembly path.
+process gets TEST_DEVICES_PER_PROC (default 2) fake CPU devices, so the
+gang trains over a real world_size-process mesh: jax.distributed
+rendezvous, cross-process collectives, and the
+make_array_from_process_local_data batch-assembly path.  TEST_MODEL
+(default VGG11) selects the model — the 4-process test uses TINY to keep
+the one-core compile cost sane.
 """
 
 import os
 import sys
 
+_DEV_PER_PROC = int(os.environ.get("TEST_DEVICES_PER_PROC", "2"))
+_MODEL = os.environ.get("TEST_MODEL", "VGG11")
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=2").strip()
+    + f" --xla_force_host_platform_device_count={_DEV_PER_PROC}").strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+from _cache import enable_compile_cache  # noqa: E402 (same dir)
+
+enable_compile_cache(jax)
 
 import numpy as np  # noqa: E402
 
@@ -27,16 +36,19 @@ from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
 def main() -> int:
     dist_init.init_from_env(timeout_s=120)
     rank, world = dist_init.process_info()
-    assert world == 2, world
+    want_world = int(os.environ["WORLD_SIZE"])
+    assert world == want_world, (world, want_world)
     n_dev = len(jax.devices())
-    assert n_dev == 4, f"expected 4 global devices, got {n_dev}"
+    want_dev = world * _DEV_PER_PROC
+    assert n_dev == want_dev, f"expected {want_dev} global devices, {n_dev}"
 
     mesh = make_mesh()
-    trainer = Trainer(TrainConfig(strategy="ddp", batch_size=4, lr=1e-3),
+    trainer = Trainer(TrainConfig(model=_MODEL, strategy="ddp",
+                                  batch_size=4, lr=1e-3),
                       mesh=mesh)
     # per-host share of the global batch: local devices * per-replica batch
     rng = np.random.default_rng(rank)
-    local = 2 * 4
+    local = _DEV_PER_PROC * 4
     losses = []
     for _ in range(3):
         images = rng.integers(0, 256, (local, 32, 32, 3)).astype(np.uint8)

@@ -15,6 +15,9 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+from _cache import enable_compile_cache  # noqa: E402 (same dir)
+
+enable_compile_cache(jax)
 
 import numpy as np  # noqa: E402
 
@@ -27,7 +30,7 @@ from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
 def main() -> int:
     dist_init.init_from_env(timeout_s=120)
     mesh = make_mesh()
-    trainer = Trainer(TrainConfig(strategy="ddp", batch_size=4), mesh=mesh)
+    trainer = Trainer(TrainConfig(model=os.environ.get("TEST_MODEL", "VGG11"), strategy="ddp", batch_size=4), mesh=mesh)
 
     class DS:
         rng = np.random.default_rng(0)
