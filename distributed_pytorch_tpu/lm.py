@@ -95,10 +95,12 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
             "interleave (virtual pipeline stages) requires pp > 1; with "
             "pp=1 it would be silently ignored")
     if cfg.pp > 1:
-        if cfg.model.n_experts:
+        from .parallel.pipeline import _uniform_moe
+        if cfg.model.n_experts and not _uniform_moe(cfg.model):
             raise ValueError(
-                "pp does not support MoE models (n_experts > 0): expert "
-                "layers cannot stack into homogeneous pipeline stages")
+                "pp supports MoE only for uniform stacks (moe_every=1, "
+                "every layer MoE); a dense/MoE-alternating stack cannot "
+                "stack into homogeneous pipeline stages")
         if cfg.tp > 1 and (cfg.model.n_heads % cfg.tp
                            or cfg.model.kv_heads % cfg.tp):
             raise ValueError(f"heads must divide over tp={cfg.tp}")
@@ -299,15 +301,22 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         tokens = tokens.reshape(n_micro, mb, -1)
         targets = targets.reshape(n_micro, mb, -1)
         pos = _shard_positions(cfg, tokens.shape[-1])
-        ce_sum, n = pp.pipeline_loss(stage_params, shared, tokens, targets,
-                                     cfg=cfg.model, axis=PIPE, dtype=dtype,
-                                     tp_axis=tp_axis, seq_axis=seq_axis,
-                                     seq_layout=cfg.seq_layout, pos=pos,
-                                     interleave=cfg.interleave,
-                                     remat_block_ticks=cfg.pp_remat_block)
+        ce_sum, n, aux = pp.pipeline_loss(
+            stage_params, shared, tokens, targets,
+            cfg=cfg.model, axis=PIPE, dtype=dtype,
+            tp_axis=tp_axis, seq_axis=seq_axis,
+            seq_layout=cfg.seq_layout, pos=pos,
+            interleave=cfg.interleave,
+            remat_block_ticks=cfg.pp_remat_block)
         ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE, SEQ))
         n = jax.lax.psum(n, (DATA, PIPE, SEQ))
-        return ce_sum / jnp.maximum(n, 1)
+        # aux: layers are SPLIT across 'pipe' (sum) and each rank's
+        # accumulator spans all microbatches (mean); data/seq shards each
+        # computed their own routing (mean) — mirrors the dense path's
+        # sum-over-layers + pmean-over-(data, seq).
+        aux = jax.lax.psum(aux, PIPE) / n_micro
+        aux = jax.lax.pmean(aux, (DATA, SEQ))
+        return ce_sum / jnp.maximum(n, 1) + cfg.aux_coef * aux
 
     stage_specs = pp_stage_specs(cfg)
     shared_specs = {"embed": P(), "final_norm": P()}

@@ -87,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memory-map --corpus instead of loading it into "
                         "RAM (for corpora larger than host memory; each "
                         "rank lazily reads only its own windows' pages)")
+    p.add_argument("--shuffle-mode", default=None,
+                   choices=["permutation", "affine"],
+                   help="epoch shuffle: 'permutation' (exact "
+                        "DistributedSampler semantics, O(n_windows) index "
+                        "memory) or 'affine' (O(1) memory modular-affine "
+                        "bijection).  Default: affine with --mmap-corpus "
+                        "(whose target scale cannot index windows in RAM), "
+                        "permutation otherwise")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--eval-every", type=int, default=0,
                    help="evaluate held-out loss/ppl every N steps (holds "
@@ -185,9 +193,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.batch_size % max(procs, 1):
         raise SystemExit(f"--batch-size {args.batch_size} must divide "
                          f"across {procs} processes")
+    shuffle_mode = args.shuffle_mode or (
+        "affine" if args.mmap_corpus else "permutation")
     loader = lm_corpus.LMDataLoader(
         corpus, args.batch_size // procs, args.seq_len,
-        num_replicas=procs, rank=jax.process_index(), seed=args.seed)
+        num_replicas=procs, rank=jax.process_index(), seed=args.seed,
+        shuffle_mode=shuffle_mode)
     if len(loader) == 0:
         raise SystemExit(
             f"corpus yields 0 batches: {loader.per_rank} windows/process "

@@ -28,7 +28,11 @@ thread per stage):
   remat;
 - pp composes with tensor parallelism: chunk layer weights additionally
   carry the Megatron head/FFN sharding over ``tp_axis`` and the block's two
-  psums run inside every chunk (mesh (data, pipe, model)).
+  psums run inside every chunk (mesh (data, pipe, seq, model)); with
+  sequence parallelism (ring attention inside chunks over 'seq'); and with
+  uniformly-MoE stacks (moe_every=1 — every layer MoE, so chunk params
+  stack homogeneously; per-(chunk, microbatch) aux accumulates through the
+  ticks).
 
 Schedule index math (device s, tick t, N = n*v):
   rel = t - s                      # ticks since the wavefront passed s
@@ -60,6 +64,13 @@ from ..models import transformer as tfm
 PyTree = Any
 
 
+def _uniform_moe(cfg: tfm.TransformerConfig) -> bool:
+    """True when EVERY layer is an MoE layer (moe_every == 1): the one MoE
+    shape whose layer params stack homogeneously into pipeline chunks."""
+    return bool(cfg.n_experts) and all(
+        cfg.is_moe_layer(i) for i in range(cfg.n_layers))
+
+
 def split_layer_params(params: PyTree, cfg: tfm.TransformerConfig,
                        n_stages: int, interleave: int = 1):
     """Re-pack per-layer params into device-stacked chunk leaves.
@@ -69,12 +80,17 @@ def split_layer_params(params: PyTree, cfg: tfm.TransformerConfig,
     leading dim over 'pipe' — and ``shared`` holds embed/final_norm
     (replicated everywhere).  Logical chunk ``j`` (contiguous layers) lands
     at [j % n_stages, j // n_stages] (round-robin interleaved placement).
+
+    MoE models pipeline iff the stack is uniform (``moe_every == 1``, every
+    layer MoE): a dense/MoE-alternating stack has heterogeneous layer
+    params that cannot stack into one scanned chunk body.
     """
-    if cfg.n_experts:
+    if cfg.n_experts and not _uniform_moe(cfg):
         raise ValueError(
-            "pipeline parallelism requires a dense layer stack (layer "
-            "params must stack homogeneously); MoE models (n_experts > 0) "
-            "are not supported with pp > 1")
+            "pipeline parallelism requires a homogeneous layer stack: "
+            "dense models, or uniformly-MoE models (moe_every=1).  A "
+            "dense/MoE-alternating stack (moe_every > 1) cannot stack "
+            "into pipeline chunks")
     n_chunks = n_stages * interleave
     if cfg.n_layers % n_chunks:
         raise ValueError(
@@ -130,23 +146,33 @@ def _chunk(chunk_layers: PyTree, x: jax.Array,
            tp_axis: str | None = None,
            seq_axis: str | None = None,
            seq_layout: str = "contiguous",
-           pos: jax.Array | None = None) -> jax.Array:
+           pos: jax.Array | None = None,
+           is_moe: bool = False) -> tuple[jax.Array, jax.Array]:
     """Run one chunk's layers_per_chunk blocks (a homogeneous layer scan
-    over the shared models/transformer.py:block body).  With ``seq_axis``
-    the activations are sequence shards and each block's attention is the
-    ring over that axis (pp x sp composition); ``pos`` is then the shard's
-    absolute token positions."""
+    over the shared models/transformer.py:block body); returns (x, summed
+    MoE aux).  With ``seq_axis`` the activations are sequence shards and
+    each block's attention is the ring over that axis (pp x sp
+    composition); ``pos`` is then the shard's absolute token positions.
+    ``is_moe`` applies to every layer (uniform stacks only — see
+    split_layer_params)."""
     if pos is None:
         pos = jnp.arange(x.shape[1])
 
-    def body(x, lp):
-        x, _ = tfm.block(lp, x, cfg=cfg, is_moe=False, pos=pos,
-                         attn_impl=attn_impl, tp_axis=tp_axis,
-                         seq_axis=seq_axis, seq_layout=seq_layout)
-        return x, None
+    def body(carry, lp):
+        x, aux_acc = carry
+        x, aux = tfm.block(lp, x, cfg=cfg, is_moe=is_moe, pos=pos,
+                           attn_impl=attn_impl, tp_axis=tp_axis,
+                           seq_axis=seq_axis, seq_layout=seq_layout)
+        return (x, aux_acc + aux), None
 
-    x, _ = lax.scan(body, x, chunk_layers)
-    return x
+    # aux carry starts with x's vma so the scan carry types are stable
+    aux0 = jnp.zeros((), jnp.float32)
+    missing = tuple(a for a in jax.typeof(x).vma
+                    if a not in jax.typeof(aux0).vma)
+    if missing:
+        aux0 = lax.pcast(aux0, missing, to="varying")
+    (x, aux), _ = lax.scan(body, (x, aux0), chunk_layers)
+    return x, aux
 
 
 def num_ticks(m_micro: int, n: int, interleave: int) -> int:
@@ -179,9 +205,12 @@ def pipeline_loss(
     """Mean masked CE over all microbatches, computed through the pipeline.
 
     Runs inside shard_map with ``stage_params`` leaves carrying this
-    device's (1, interleave, layers_per_chunk, ...) slice.  Returns the
-    loss summed over this shard's tokens plus the valid-token count (both
-    to be psum'd by the caller across data/pipe/seq axes).
+    device's (1, interleave, layers_per_chunk, ...) slice.  Returns
+    ``(ce_sum, n_valid, aux_sum)``: the loss summed over this shard's
+    tokens, the valid-token count, and this pipe rank's summed MoE aux
+    over its chunks and all microbatches (0.0 for dense stacks) — the
+    caller psums ce/n across data/pipe/seq, psums aux over 'pipe' (layers
+    are split across ranks) and means it over microbatches and data/seq.
 
     With ``seq_axis`` (pp x sp), ``tokens``/``targets`` are sequence
     shards: every microbatch's activations stay seq-sharded through the
@@ -206,7 +235,8 @@ def pipeline_loss(
 
     chunk_fn = jax.checkpoint(partial(_chunk, cfg=cfg, attn_impl=attn_impl,
                                       tp_axis=tp_axis, seq_axis=seq_axis,
-                                      seq_layout=seq_layout, pos=pos))
+                                      seq_layout=seq_layout, pos=pos,
+                                      is_moe=_uniform_moe(cfg)))
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: chunk k*n+s -> +1
 
     # Scan carries must be varying over every axis their updates vary over:
@@ -221,7 +251,7 @@ def pipeline_loss(
     zero_x = _varying(jnp.zeros((mb, s, x_all.shape[-1]), x_all.dtype))
 
     def tick(carry, t):
-        prev_out, ce_acc, n_acc = carry
+        prev_out, ce_acc, n_acc, aux_acc = carry
         # Activation arriving from the previous device's chunk (one ring
         # hop per tick); device 0's first chunk takes the fresh microbatch
         # embedding instead.
@@ -237,7 +267,9 @@ def pipeline_loss(
         m_in = jnp.clip(m, 0, m_micro - 1)
         fresh = lax.dynamic_index_in_dim(x_all, m_in, 0, keepdims=False)
         x_in = jnp.where((me == 0) & (k == 0), fresh, recv)
-        out = chunk_fn(chunk_layers, x_in)
+        out, aux = chunk_fn(chunk_layers, x_in)
+        # every (chunk, microbatch) pair contributes its layers' aux once
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         # Last logical chunk (device n-1, slot v-1) finishes microbatch m:
         # unembed + masked CE.
         finish = (me == n - 1) & (k == v - 1) & valid
@@ -247,10 +279,11 @@ def pipeline_loss(
         ce, cnt = masked_ce(logits, tgt)
         ce_acc = ce_acc + jnp.where(finish, ce, 0.0)
         n_acc = n_acc + jnp.where(finish, cnt, 0)
-        return (out, ce_acc, n_acc), None
+        return (out, ce_acc, n_acc, aux_acc), None
 
     ce0 = _varying(jnp.zeros(()))
     n0 = _varying(jnp.zeros((), jnp.int32))
+    aux0 = _varying(jnp.zeros(()))
 
     # -- 1F1B-grade activation memory: block-remat over the tick scan ------
     # A flat scan of T ticks saves one (mb, S, D) carry per tick for the
@@ -266,9 +299,9 @@ def pipeline_loss(
     # None = flat scan (the O(T) layout, kept for A/B memory tests).
     ticks = num_ticks(m_micro, n, v)
     if remat_block_ticks is None:
-        (_, ce_sum, n_sum), _ = lax.scan(
-            tick, (zero_x, ce0, n0), jnp.arange(ticks))
-        return ce_sum, n_sum
+        (_, ce_sum, n_sum, aux_sum), _ = lax.scan(
+            tick, (zero_x, ce0, n0, aux0), jnp.arange(ticks))
+        return ce_sum, n_sum, aux_sum
     block = remat_block_ticks or n
     # Padded tail ticks still run a full (masked-out) chunk forward — they
     # are no-ops for the loss, not for compute.  The auto block (n) wastes
@@ -280,7 +313,7 @@ def pipeline_loss(
         carry, _ = lax.scan(tick, carry, ts)
         return carry, None
 
-    (_, ce_sum, n_sum), _ = lax.scan(
-        tick_block, (zero_x, ce0, n0),
+    (_, ce_sum, n_sum, aux_sum), _ = lax.scan(
+        tick_block, (zero_x, ce0, n0, aux0),
         jnp.arange(t_pad).reshape(t_pad // block, block))
-    return ce_sum, n_sum
+    return ce_sum, n_sum, aux_sum
