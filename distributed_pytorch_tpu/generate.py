@@ -46,6 +46,20 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
     }
 
 
+def pad_cache_len(n: int) -> int:
+    """Round a cache length up to whole 512-slot blocks (the decode
+    kernel's MXU-friendly tile granule; the zero-filled tail is never read
+    thanks to the pos bound)."""
+    return -(-n // 512) * 512
+
+
+def default_decode_kernel(flag: bool | None) -> bool:
+    """Resolve a decode_kernel tri-state: None = kernel on TPU, XLA path
+    elsewhere (the kernel runs in interpret mode off-TPU but is slower
+    than XLA there)."""
+    return jax.default_backend() == "tpu" if flag is None else flag
+
+
 def _warn_if_expert_choice(cfg: tfm.TransformerConfig) -> None:
     """Expert-choice routing has no autoregressive decode equivalent.
 
@@ -102,6 +116,7 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
                     cfg: tfm.TransformerConfig, dtype=None,
                     tp_axis: str | None = None,
                     unembed_last_only: bool = False,
+                    unembed_at=None,
                     k_len: int | None = None,
                     use_decode_kernel: bool = False):
     """Cache-backed forward over a (B, S) token block at positions ``pos``
@@ -109,6 +124,11 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     Returns ((B, S, vocab) logits, cache).  The one implementation behind
     both prefill (S = prompt length, write_at = 0) and per-token decode
     (S = 1, write_at = pos).
+
+    RAGGED batches (continuous batching): ``pos`` may be (B, S) — each
+    sequence at its own depth — with ``write_at`` a (B,) vector of
+    per-sequence cache offsets; S = 1 in practice.  Attention bounds,
+    rotary phases, and cache writes are then all per-sequence.
 
     Causality comes from the cache-validity bias: query row j attends cache
     slots <= pos[j] (earlier positions plus itself), never the zero-filled
@@ -128,11 +148,16 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     # anyway) tail of the buffer.
     k_len = k_len or next(iter(cache.values()))["k"].shape[2]
     s = tokens.shape[1]
+    ragged = pos.ndim == 2  # (B, S) per-sequence positions
     kernel_path = use_decode_kernel and s == 1
     if not kernel_path:
         # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
         slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
-        bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
+        if ragged:  # (B, 1, S, k_len)
+            bias = jnp.where(slot[None] <= pos[:, :, None], 0.0,
+                             NEG_INF)[:, None]
+        else:
+            bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
 
     for i in range(cfg.n_layers):
         lp = params[f"layer{i}"]
@@ -143,16 +168,25 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
         q = tfm.rotary(q, pos, cfg.rope_theta)
         k = tfm.rotary(k, pos, cfg.rope_theta)
-        ck = lax.dynamic_update_slice(
-            c["k"], k.astype(c["k"].dtype), (0, 0, write_at, 0))
-        cv = lax.dynamic_update_slice(
-            c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
+        if ragged:
+            # per-sequence write offsets (vmapped update -> scatter)
+            upd = jax.vmap(lambda c, u, w: lax.dynamic_update_slice(
+                c, u, (0, w, 0)))
+            ck = upd(c["k"], k.astype(c["k"].dtype), write_at)
+            cv = upd(c["v"], v.astype(c["v"].dtype), write_at)
+        else:
+            ck = lax.dynamic_update_slice(
+                c["k"], k.astype(c["k"].dtype), (0, 0, write_at, 0))
+            cv = lax.dynamic_update_slice(
+                c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
         if kernel_path:
             # Pallas decode kernel: exact pos+1 cache-read bound (dead
             # blocks neither fetched nor computed), GQA head groups folded
             # into MXU rows — no repeated cache reads, no k_len segmenting.
-            o = decode_attention(q, ck, cv, pos[0])
+            # Ragged: pos[:, 0] gives each sequence its own bound.
+            o = decode_attention(q, ck, cv,
+                                 pos[:, 0] if ragged else pos[0])
         else:
             ka = ck[:, :, :k_len].astype(q.dtype)
             va = cv[:, :, :k_len].astype(q.dtype)
@@ -180,6 +214,10 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     x = tfm.rms_norm(x, params["final_norm"], cfg.norm_eps)
     if unembed_last_only:
         x = x[:, -1:]  # prefill needs one row, not (B, S, vocab) logits
+    elif unembed_at is not None:
+        # dynamic single-row unembed (bucketed prefill: the last VALID row
+        # of a padded prompt) — slice before the d_model x vocab matmul
+        x = lax.dynamic_slice_in_dim(x, unembed_at, 1, axis=1)
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     return logits, cache
 
@@ -199,6 +237,19 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
         params, cache, token[:, None], jnp.atleast_1d(pos), pos,
         cfg=cfg, dtype=dtype, tp_axis=tp_axis, k_len=k_len,
         use_decode_kernel=use_decode_kernel)
+    return logits[:, 0], cache
+
+
+def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
+                       pos: jax.Array, *, cfg: tfm.TransformerConfig,
+                       dtype=None, use_decode_kernel: bool = False):
+    """One token per sequence at PER-SEQUENCE positions: (B,) ids at (B,)
+    positions -> ((B, vocab) logits, cache).  Every sequence reads exactly
+    its own ``pos+1`` cache prefix and writes its K/V at its own offset —
+    the step primitive of continuous batching (serve.py)."""
+    logits, cache = _forward_cached(
+        params, cache, token[:, None], pos[:, None], pos,
+        cfg=cfg, dtype=dtype, use_decode_kernel=use_decode_kernel)
     return logits[:, 0], cache
 
 
@@ -230,20 +281,15 @@ def _generate_impl(
     b, s0 = prompt.shape
     # Pallas decode kernel by default on TPU: exact dynamic pos+1 cache-read
     # bounds make the static segment bounds below redundant (one compiled
-    # scan body instead of decode_segments of them).  Off-TPU the XLA
-    # segmented path remains the default (the kernel works in interpret
-    # mode but is slower than XLA on CPU).
-    use_kernel = (jax.default_backend() == "tpu"
-                  if decode_kernel is None else decode_kernel)
+    # scan body instead of decode_segments of them).
+    use_kernel = default_decode_kernel(decode_kernel)
     # Under TP the params are head shards — cache this shard's kv heads
     # only.  The cache lives in the compute dtype: decode at long cache is
     # HBM-bandwidth-bound on cache reads, so a bf16 cache is ~2x faster
     # than f32 (measured; final logits stay f32 for sampling).
     max_len = s0 + max_new
     if use_kernel:
-        # MXU-friendly cache tiling: round the buffer up to whole 512-slot
-        # blocks (the tail is zero-filled and never read — pos bound).
-        max_len = -(-max_len // 512) * 512
+        max_len = pad_cache_len(max_len)
     cache = init_cache(cfg, b, max_len,
                        dtype=dtype or jnp.float32,
                        kv_heads=params["layer0"]["wk"].shape[1])

@@ -167,11 +167,15 @@ def rms_norm(x: Array, scale: Array, eps: float) -> Array:
 
 def rotary(x: Array, pos: Array, theta: float) -> Array:
     """Rotary position embedding over (B, H, S, D); ``pos`` is (S,) absolute
-    positions (a sequence-parallel shard passes its global offsets)."""
+    positions (a sequence-parallel shard passes its global offsets), or
+    (B, S) per-sequence positions (ragged decode — every sequence sits at
+    its own depth)."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # (D/2,)
-    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]      # (S, D/2)
+    angles = pos[..., None].astype(jnp.float32) * freqs  # (S|B,S, D/2)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if pos.ndim == 2:  # (B, S, D/2) -> broadcast over heads
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = x[..., ::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x1 * sin + x2 * cos

@@ -428,7 +428,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
     """
     j = pl.program_id(1)
     nk = pl.num_programs(1)
-    pos = pos_ref[0]
+    # per-sequence position: pos_ref is (B,) — ragged batches decode with
+    # exact per-sequence bounds (broadcast a scalar to (B,) for the
+    # uniform case)
+    pos = pos_ref[pl.program_id(0)]
 
     @pl.when(j == 0)
     def _init():
@@ -484,8 +487,10 @@ def decode_attention(
 
     ``q``: (B, H, 1, D) this step's queries; ``k_cache``/``v_cache``:
     (B, Hkv, S, D) full cache buffers (zero-filled beyond ``pos``); ``pos``:
-    scalar int32 — every sequence attends cache slots ``[0, pos]``.
-    Returns (B, H, 1, D).
+    scalar int32, or (B,) int32 for RAGGED batches — sequence ``b`` attends
+    cache slots ``[0, pos[b]]`` exactly (per-sequence read bounds: a short
+    sequence in the batch reads only its own prefix, the continuous-
+    batching primitive).  Returns (B, H, 1, D).
 
     TPU-first design (the fix for the segmented-decode workaround the
     round-1 ROADMAP documented): decode at long cache is HBM-bound on cache
@@ -517,11 +522,11 @@ def decode_attention(
 
     # (B, H, D) queries with each kv-head group's g queries contiguous rows
     qf = q.reshape(b, h, d)
-    pos_arr = jnp.atleast_1d(pos).astype(jnp.int32)
+    pos_arr = jnp.broadcast_to(jnp.atleast_1d(pos), (b,)).astype(jnp.int32)
     vma = _vma(q, k_cache, v_cache)
 
-    def live_block(j, pos_ref):
-        return jnp.minimum(j, pos_ref[0] // block_k)
+    def live_block(bb, j, pos_ref):
+        return jnp.minimum(j, pos_ref[bb] // block_k)
 
     o = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale,
@@ -533,11 +538,13 @@ def decode_attention(
                 pl.BlockSpec((1, h, d), lambda bb, j, pos_ref: (bb, 0, 0)),
                 pl.BlockSpec(
                     (1, hkv, block_k, d),
-                    lambda bb, j, pos_ref: (bb, 0, live_block(j, pos_ref),
+                    lambda bb, j, pos_ref: (bb, 0,
+                                            live_block(bb, j, pos_ref),
                                             0)),
                 pl.BlockSpec(
                     (1, hkv, block_k, d),
-                    lambda bb, j, pos_ref: (bb, 0, live_block(j, pos_ref),
+                    lambda bb, j, pos_ref: (bb, 0,
+                                            live_block(bb, j, pos_ref),
                                             0)),
             ],
             out_specs=pl.BlockSpec((1, h, d),

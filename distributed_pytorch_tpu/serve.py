@@ -1,0 +1,293 @@
+"""Continuous batching: slot-based autoregressive serving.
+
+The reference has no inference stack at all; ``generate.py`` adds static
+batch decoding, and this module adds the serving-shaped missing piece:
+**continuous batching** — a fixed pool of cache slots where sequences
+enter (prefill into a free slot), decode in lockstep (ONE compiled ragged
+step per token for every active slot), and retire independently (EOS or
+length budget), their slot immediately refilled from the queue.  Unlike
+static batching, a short request never waits for the batch's longest one.
+
+TPU-first design constraints drive the shape:
+
+- static shapes everywhere: the slot pool is a fixed (slots, Hkv, max_len,
+  D) KV cache per layer; prompts pad to bucketed lengths (one compiled
+  prefill per bucket) and the decode step is one compiled program
+  regardless of which slots are live;
+- per-sequence exactness comes from the ragged decode path
+  (generate.decode_step_ragged): every sequence reads exactly its own
+  ``pos+1`` cache prefix (the Pallas decode kernel's per-sequence
+  scalar-prefetch bounds on TPU) and writes its K/V at its own offset;
+- slot recycling needs no cache zeroing: a slot's stale K/V beyond the new
+  occupant's write frontier is never read (reads are bounded by the
+  occupant's own ``pos``), and each decode step overwrites its slot before
+  the bound reaches it;
+- the host side is a plain queue + bookkeeping: submission order is FIFO,
+  retirement is per-sequence, and the device never waits on the host
+  between steps beyond the sampled-token fetch that drives EOS detection;
+- **multi-token scheduling** (``steps_per_sync``): the device decodes K
+  tokens per dispatch as one ``lax.scan`` and the host processes the K x
+  slots block at once — through a tunneled TPU a host round-trip costs
+  tens of ms, so per-token syncing would dominate (measured 37 ms/token at
+  K=1 vs ~2 ms/token at K=32 on the same workload).  Retirement lands at
+  block granularity: a sequence that hits EOS/budget mid-block wastes its
+  remaining in-flight slot-steps (the slot refills at the next sync).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import transformer as tfm
+from . import generate as gen
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray            # (L,) int32
+    max_new: int
+    emitted: list = field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Fixed-slot continuous batching over one model.
+
+    Usage::
+
+        cb = ContinuousBatcher(params, cfg, slots=4, max_len=512,
+                               eos_id=0, temperature=0.8, top_k=50)
+        rid = cb.submit(prompt_tokens, max_new=128)   # queue (any number)
+        while cb.pending():
+            for rid, tok in cb.step():               # one token per active
+                ...                                   # slot, as they land
+        out = cb.result(rid)                          # (L + emitted,) int32
+
+    ``run(prompts, max_new)`` drives submit/step to completion.
+    """
+
+    def __init__(self, params, cfg: tfm.TransformerConfig, *,
+                 slots: int = 4, max_len: int = 1024,
+                 temperature: float = 1.0, top_k: int | None = None,
+                 eos_id: int | None = None, dtype=None,
+                 prompt_buckets: tuple[int, ...] = (32, 128, 512),
+                 seed: int = 0, decode_kernel: bool | None = None,
+                 steps_per_sync: int = 8):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        # whole 512-slot blocks keep the decode kernel's tiles MXU-friendly
+        self.max_len = gen.pad_cache_len(max_len)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.dtype = dtype
+        self.buckets = tuple(sorted(b for b in prompt_buckets
+                                    if b <= self.max_len))
+        if not self.buckets:
+            raise ValueError(f"no prompt bucket fits max_len {max_len}")
+        self.use_kernel = gen.default_decode_kernel(decode_kernel)
+        if steps_per_sync < 1:
+            raise ValueError(f"steps_per_sync must be >= 1, got "
+                             f"{steps_per_sync}")
+        self.steps_per_sync = steps_per_sync
+        kv_heads = params["layer0"]["wk"].shape[1]
+        self.cache = gen.init_cache(cfg, slots, self.max_len,
+                                    dtype=dtype or jnp.float32,
+                                    kv_heads=kv_heads)
+        self.key = jax.random.key(seed)
+        # host-side slot state
+        self.pos = np.zeros(slots, np.int32)        # last written position
+        self.occupant: list[_Request | None] = [None] * slots
+        self.last_tok = np.zeros(slots, np.int32)   # next input token
+        self.queue: deque[_Request] = deque()
+        self.requests: dict[int, _Request] = {}
+        self._next_rid = 0
+        self._prefill_fns: dict[int, object] = {}
+        self._decode_fn = None
+        self._insert_fn = None
+
+    # -- submission / results --------------------------------------------
+    def submit(self, prompt, max_new: int = 128) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the largest "
+                f"bucket {self.buckets[-1]}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"max_len {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, prompt, max_new)
+        self.requests[rid] = req
+        self.queue.append(req)
+        return rid
+
+    def pending(self) -> bool:
+        return bool(self.queue) or any(o is not None for o in self.occupant)
+
+    def result(self, rid: int) -> np.ndarray:
+        req = self.requests[rid]
+        return np.concatenate([req.prompt,
+                               np.asarray(req.emitted, np.int32)])
+
+    # -- compiled pieces --------------------------------------------------
+    def _prefill(self, bucket: int):
+        """(params, padded (1, bucket) prompt, true_len) ->
+        ((vocab,) last valid logits, per-layer (1, hkv, bucket, d) slabs)."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            cfg, dtype = self.cfg, self.dtype
+
+            @jax.jit
+            def prefill(params, prompt, true_len):
+                kv_heads = params["layer0"]["wk"].shape[1]
+                cache = gen.init_cache(cfg, 1, bucket,
+                                       dtype=dtype or jnp.float32,
+                                       kv_heads=kv_heads)
+                # single-row unembed at the last VALID prompt position —
+                # no (bucket, vocab) logits buffer for padded rows
+                logits, cache = gen._forward_cached(
+                    params, cache, prompt, jnp.arange(bucket), 0,
+                    cfg=cfg, dtype=dtype, k_len=bucket,
+                    unembed_at=true_len - 1)
+                return logits[0, 0], cache
+
+            fn = prefill
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode(self):
+        """(params, cache, tokens (slots,), pos (slots,), key) ->
+        ((K, slots) sampled tokens, cache) — ONE program decodes
+        ``steps_per_sync`` tokens for the whole pool per dispatch (each
+        step's sample feeds the next; host syncs once per block)."""
+        if self._decode_fn is None:
+            cfg, dtype = self.cfg, self.dtype
+            temperature, top_k = self.temperature, self.top_k
+            use_kernel = self.use_kernel
+            k_steps, max_len = self.steps_per_sync, self.max_len
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def block(params, cache, tokens, pos, key):
+                def body(carry, _):
+                    cache, tokens, pos, key = carry
+                    logits, cache = gen.decode_step_ragged(
+                        params, cache, tokens, pos, cfg=cfg, dtype=dtype,
+                        use_decode_kernel=use_kernel)
+                    key, sub = jax.random.split(key)
+                    toks = gen._sample(sub, logits, temperature, top_k)
+                    # overshooting sequences (retired mid-block on the
+                    # host) clamp at the last slot; their output is
+                    # discarded and the garbage write stays above every
+                    # live read bound
+                    pos = jnp.minimum(pos + 1, max_len - 1)
+                    return (cache, toks, pos, key), toks
+
+                (cache, _, _, _), toks = jax.lax.scan(
+                    body, (cache, tokens, pos, key), None, length=k_steps)
+                return toks, cache
+
+            self._decode_fn = block
+        return self._decode_fn
+
+    def _insert(self, slabs, slot: int) -> None:
+        """Write a prefill's (1, hkv, bucket, d) slabs into the pool slot
+        (jitted with the pool donated — an in-place slab write, not a
+        whole-pool copy per admission)."""
+        if self._insert_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def insert(cache, slabs, slot):
+                return jax.tree.map(
+                    lambda big, small: jax.lax.dynamic_update_slice(
+                        big, small.astype(big.dtype), (slot, 0, 0, 0)),
+                    cache, slabs)
+
+            self._insert_fn = insert
+        self.cache = self._insert_fn(self.cache, slabs,
+                                     jnp.int32(slot))
+
+    # -- scheduling -------------------------------------------------------
+    def _fill_free_slots(self) -> list[tuple[int, int]]:
+        """Prefill queued requests into free slots; returns (rid, first
+        sampled token) for each admitted request."""
+        out = []
+        for slot in range(self.slots):
+            if self.occupant[slot] is not None or not self.queue:
+                continue
+            req = self.queue.popleft()
+            L = len(req.prompt)
+            bucket = next(b for b in self.buckets if b >= L)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :L] = req.prompt
+            last_logits, slabs = self._prefill(bucket)(
+                self.params, jnp.asarray(padded), L)
+            self._insert(slabs, slot)
+            self.key, sub = jax.random.split(self.key)
+            tok = int(gen._sample(sub, last_logits[None],
+                                  self.temperature, self.top_k)[0])
+            self.occupant[slot] = req
+            self.pos[slot] = L - 1
+            self._emit(slot, tok, out)
+        return out
+
+    def _emit(self, slot: int, tok: int, out: list) -> None:
+        req = self.occupant[slot]
+        req.emitted.append(tok)
+        out.append((req.rid, tok))
+        if ((self.eos_id is not None and tok == self.eos_id)
+                or len(req.emitted) >= req.max_new):
+            req.done = True
+            self.occupant[slot] = None  # slot free; stale K/V never read
+        else:
+            self.last_tok[slot] = tok
+
+    def step(self) -> list[tuple[int, int]]:
+        """Admit queued work, then decode ``steps_per_sync`` tokens for
+        every active slot in one device dispatch.
+
+        Returns (rid, token) pairs emitted this call, in per-slot sampling
+        order (admissions emit their first sampled token here too).  A
+        sequence finishing mid-block stops emitting there; its slot refills
+        on the next call.
+        """
+        out = self._fill_free_slots()
+        live = [s for s in range(self.slots) if self.occupant[s] is not None]
+        if not live:
+            return out
+        # advance every live slot's write position to the new token's slot
+        pos = self.pos.copy()
+        pos[live] = np.minimum(pos[live] + 1, self.max_len - 1)
+        self.key, sub = jax.random.split(self.key)
+        toks, self.cache = self._decode()(
+            self.params, self.cache, jnp.asarray(self.last_tok),
+            jnp.asarray(pos), sub)
+        toks = np.asarray(toks)  # (K, slots)
+        k_steps = toks.shape[0]
+        for s in live:
+            self.pos[s] = min(int(pos[s]) + k_steps - 1, self.max_len - 1)
+            for i in range(k_steps):
+                if self.occupant[s] is None:
+                    break  # retired mid-block: discard the tail
+                self._emit(s, int(toks[i, s]), out)
+        return out
+
+    def run(self, prompts, max_new: int = 128) -> dict[int, np.ndarray]:
+        """Submit every prompt, drive to completion, return rid -> tokens."""
+        rids = [self.submit(p, max_new) for p in prompts]
+        while self.pending():
+            self.step()
+        return {rid: self.result(rid) for rid in rids}

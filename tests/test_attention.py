@@ -211,3 +211,25 @@ def test_decode_attention_validates_shapes():
     q3 = jnp.zeros((1, 3, 1, 64))  # 3 q heads over 4 kv heads
     with pytest.raises(ValueError, match="group"):
         attn.decode_attention(q3, kc, vc, jnp.int32(0))
+
+
+def test_decode_attention_per_sequence_positions():
+    """Ragged batches: pos as a (B,) vector gives each sequence its own
+    exact read bound (the continuous-batching primitive)."""
+    key = jax.random.key(9)
+    b, h, hkv, s, d = 3, 4, 2, 256, 64
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, h, 1, d))
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (b, hkv, s, d))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (b, hkv, s, d))
+    pos = jnp.array([7, 130, 255], jnp.int32)
+    out = attn.decode_attention(q, kc, vc, pos, block_k=64)
+    for i in range(b):
+        ref = _decode_oracle(q[i:i + 1], kc[i:i + 1], vc[i:i + 1],
+                             int(pos[i]))
+        np.testing.assert_allclose(np.asarray(out[i:i + 1]),
+                                   np.asarray(ref), atol=2e-5, rtol=2e-5)
+    # garbage beyond each sequence's own bound must not leak
+    kc_dirty = kc.at[0, :, 8:].set(1e4)
+    out_dirty = attn.decode_attention(q, kc_dirty, vc, pos, block_k=64)
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.asarray(out_dirty[0]))
