@@ -402,9 +402,8 @@ def test_pp_trained_params_merge_and_decode():
     # own next-step loss (computed from the same pre-update params; the
     # dense model has no experts, so the aux term is zero) — a scrambled
     # layer order would fail this, not just produce in-range tokens.
-    from distributed_pytorch_tpu.models import transformer as tfm2
-    logits = tfm2.apply(dense, jnp.asarray(tokens), cfg=model,
-                        attn_impl="reference")
+    logits = tfm.apply(dense, jnp.asarray(tokens), cfg=model,
+                       attn_impl="reference")
     ce, n = masked_ce(logits, jnp.asarray(targets))
     dense_loss = float(ce) / int(n)
     pp_loss = float(tr.train_step(tokens, targets))
