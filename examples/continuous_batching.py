@@ -1,0 +1,49 @@
+"""Continuous-batching demo: ragged requests through a fixed slot pool.
+
+Eight requests with different prompt lengths and generation budgets share
+four KV-cache slots: short requests finish and hand their slot to queued
+ones mid-stream, so no request waits for the batch's longest.  On TPU the
+decode runs the Pallas kernel with per-sequence exact cache-read bounds;
+on CPU the XLA ragged path runs (same results).
+
+Run:  PYTHONPATH=. python examples/continuous_batching.py
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.data import lm_corpus
+from distributed_pytorch_tpu.models import transformer as tfm
+from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+cfg = tfm.TransformerConfig(vocab_size=256, d_model=256, n_layers=2,
+                            n_heads=2, head_dim=128)
+params = tfm.init(jax.random.key(0), cfg)
+
+# ragged prompts from the deterministic synthetic corpus
+text = lm_corpus.synthetic_corpus(1 << 14, seed=3)
+rng = np.random.default_rng(0)
+prompts = []
+for i in range(8):
+    length = int(rng.integers(8, 100))
+    start = int(rng.integers(0, len(text) - length))
+    prompts.append(lm_corpus.encode(text[start:start + length]))
+
+cb = ContinuousBatcher(
+    params, cfg, slots=4, max_len=512, temperature=0.8, top_k=50,
+    dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else None,
+    prompt_buckets=(32, 128), steps_per_sync=16, seed=7)
+
+rids = [cb.submit(p, max_new=int(rng.integers(16, 80))) for p in prompts]
+steps = 0
+while cb.pending():
+    emitted = cb.step()
+    steps += 1
+    print(f"sync {steps}: {len(emitted)} tokens "
+          f"({sum(1 for s in cb.occupant if s is not None)} slots live, "
+          f"{len(cb.queue)} queued)")
+
+for rid, prompt in zip(rids, prompts):
+    out = cb.result(rid)
+    print(f"req {rid}: prompt {len(prompt)} -> +{len(out) - len(prompt)} "
+          f"tokens | ...{lm_corpus.decode(out[-48:])!r}")
