@@ -242,14 +242,18 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
 
 def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
                        pos: jax.Array, *, cfg: tfm.TransformerConfig,
-                       dtype=None, use_decode_kernel: bool = False):
+                       dtype=None, tp_axis: str | None = None,
+                       use_decode_kernel: bool = False):
     """One token per sequence at PER-SEQUENCE positions: (B,) ids at (B,)
     positions -> ((B, vocab) logits, cache).  Every sequence reads exactly
     its own ``pos+1`` cache prefix and writes its K/V at its own offset —
-    the step primitive of continuous batching (serve.py)."""
+    the step primitive of continuous batching (serve.py).  With ``tp_axis``
+    (inside shard_map) the params are Megatron shards and the cache holds
+    this shard's kv heads, exactly as in ``generate_tp``."""
     logits, cache = _forward_cached(
         params, cache, token[:, None], pos[:, None], pos,
-        cfg=cfg, dtype=dtype, use_decode_kernel=use_decode_kernel)
+        cfg=cfg, dtype=dtype, tp_axis=tp_axis,
+        use_decode_kernel=use_decode_kernel)
     return logits[:, 0], cache
 
 

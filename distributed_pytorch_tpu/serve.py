@@ -79,7 +79,8 @@ class ContinuousBatcher:
                  eos_id: int | None = None, dtype=None,
                  prompt_buckets: tuple[int, ...] = (32, 128, 512),
                  seed: int = 0, decode_kernel: bool | None = None,
-                 steps_per_sync: int = 8):
+                 steps_per_sync: int = 8,
+                 mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -98,10 +99,36 @@ class ContinuousBatcher:
             raise ValueError(f"steps_per_sync must be >= 1, got "
                              f"{steps_per_sync}")
         self.steps_per_sync = steps_per_sync
+        # Tensor-parallel serving: with ``mesh``, params stay in their
+        # Megatron tfm.shard_specs sharding, the slot pool's kv heads
+        # shard over ``tp_axis``, and prefill/decode run inside shard_map
+        # (two psums per layer), exactly like generate_tp.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            ntp = mesh.shape[tp_axis]
+            if cfg.n_heads % ntp or cfg.kv_heads % ntp:
+                raise ValueError(
+                    f"heads ({cfg.n_heads} q / {cfg.kv_heads} kv) must "
+                    f"divide over the {ntp}-way '{tp_axis}' axis")
+            if cfg.n_experts and cfg.n_experts % ntp:
+                raise ValueError(f"{cfg.n_experts} experts do not shard "
+                                 f"over {ntp} devices")
+        # sharded jax arrays report their GLOBAL shape, so this is
+        # cfg.kv_heads in the TP case too
         kv_heads = params["layer0"]["wk"].shape[1]
         self.cache = gen.init_cache(cfg, slots, self.max_len,
                                     dtype=dtype or jnp.float32,
                                     kv_heads=kv_heads)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._cache_spec = jax.tree.map(lambda _: P(None, tp_axis),
+                                            self.cache)
+            self.cache = jax.device_put(
+                self.cache,
+                jax.tree.map(lambda sp: NamedSharding(mesh, sp),
+                             self._cache_spec))
+            self._param_specs = tfm.shard_specs(cfg, tp_axis=tp_axis)
         self.key = jax.random.key(seed)
         # host-side slot state
         self.pos = np.zeros(slots, np.int32)        # last written position
@@ -151,9 +178,9 @@ class ContinuousBatcher:
         fn = self._prefill_fns.get(bucket)
         if fn is None:
             cfg, dtype = self.cfg, self.dtype
+            tp = self.tp_axis if self.mesh is not None else None
 
-            @jax.jit
-            def prefill(params, prompt, true_len):
+            def prefill_body(params, prompt, true_len):
                 kv_heads = params["layer0"]["wk"].shape[1]
                 cache = gen.init_cache(cfg, 1, bucket,
                                        dtype=dtype or jnp.float32,
@@ -162,11 +189,21 @@ class ContinuousBatcher:
                 # no (bucket, vocab) logits buffer for padded rows
                 logits, cache = gen._forward_cached(
                     params, cache, prompt, jnp.arange(bucket), 0,
-                    cfg=cfg, dtype=dtype, k_len=bucket,
+                    cfg=cfg, dtype=dtype, k_len=bucket, tp_axis=tp,
                     unembed_at=true_len - 1)
                 return logits[0, 0], cache
 
-            fn = prefill
+            if self.mesh is None:
+                fn = jax.jit(prefill_body)
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                # spec trees carry no shapes: the pool's spec tree fits
+                # the (1, hkv, bucket, d) prefill slabs too
+                fn = jax.jit(shard_map(
+                    prefill_body, mesh=self.mesh,
+                    in_specs=(self._param_specs, P(), P()),
+                    out_specs=(P(), self._cache_spec)))
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -181,13 +218,14 @@ class ContinuousBatcher:
             use_kernel = self.use_kernel
             k_steps, max_len = self.steps_per_sync, self.max_len
 
-            @partial(jax.jit, donate_argnums=(1,))
-            def block(params, cache, tokens, pos, key):
+            tp = self.tp_axis if self.mesh is not None else None
+
+            def block_body(params, cache, tokens, pos, key):
                 def body(carry, _):
                     cache, tokens, pos, key = carry
                     logits, cache = gen.decode_step_ragged(
                         params, cache, tokens, pos, cfg=cfg, dtype=dtype,
-                        use_decode_kernel=use_kernel)
+                        tp_axis=tp, use_decode_kernel=use_kernel)
                     key, sub = jax.random.split(key)
                     toks = gen._sample(sub, logits, temperature, top_k)
                     # overshooting sequences (retired mid-block on the
@@ -201,7 +239,17 @@ class ContinuousBatcher:
                     body, (cache, tokens, pos, key), None, length=k_steps)
                 return toks, cache
 
-            self._decode_fn = block
+            if self.mesh is None:
+                self._decode_fn = jax.jit(block_body, donate_argnums=(1,))
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                self._decode_fn = jax.jit(shard_map(
+                    block_body, mesh=self.mesh,
+                    in_specs=(self._param_specs, self._cache_spec,
+                              P(), P(), P()),
+                    out_specs=(P(), self._cache_spec)),
+                    donate_argnums=(1,))
         return self._decode_fn
 
     def _insert(self, slabs, slot: int) -> None:
