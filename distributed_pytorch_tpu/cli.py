@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     # Training hyper-parameters; defaults are the reference's exact values.
     p.add_argument("--strategy", default="ddp",
                    choices=_strat.available())
+    p.add_argument("--dcn-size", type=int, default=2,
+                   help="number of slices for --strategy hierarchical: the "
+                        "data axis factors into Mesh(('dcn','ici')) and "
+                        "cross-slice traffic drops to payload/ici")
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106
@@ -161,12 +165,18 @@ def main(argv: list[str] | None = None) -> int:
         weight_decay=args.weight_decay, batch_size=args.batch_size,
         strategy=args.strategy, sync_bn=args.sync_bn,
         compute_dtype=args.compute_dtype, augment=not args.no_augment,
-        seed=args.seed,
+        seed=args.seed, dcn_size=args.dcn_size,
     )
     mesh = None
-    if args.strategy != "none":
+    factored = getattr(_strat.get(args.strategy), "axes", None) is not None
+    if args.strategy != "none" and not factored:
         mesh = make_mesh(args.num_devices)
-    trainer = Trainer(cfg, mesh=mesh)
+    # factored data axes (hierarchical): mesh=None lets the Trainer build
+    # the ('dcn', 'ici') mesh from cfg.dcn_size — one recipe, one check.
+    try:
+        trainer = Trainer(cfg, mesh=mesh, num_devices=args.num_devices)
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
     n_replicas = trainer.n_replicas
     local = max(1, n_replicas // max(jax.process_count(), 1))
     replica_offset = jax.process_index() * local

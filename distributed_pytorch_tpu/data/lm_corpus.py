@@ -215,6 +215,10 @@ class LMDataLoader:
             if math.gcd(a, n) == 1:
                 break
         b = int(rng.integers(0, max(n, 1)))
+        if n < 2 or (n - 1) * (n - 1) + (n - 1) <= np.iinfo(np.int64).max:
+            # common case: a*x + b <= (n-1)^2 + (n-1) fits int64 — vectorize
+            return lambda x: (a * np.atleast_1d(np.asarray(x, np.int64))
+                              + b) % n
         return lambda x: np.array([(a * int(v) + b) % n for v in np.atleast_1d(x)],
                                   dtype=np.int64)
 

@@ -110,19 +110,21 @@ def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from .parallel.mesh import DATA_AXIS
+    # The data axis may be factored (hierarchical: ('dcn', 'ici')) — shard
+    # the batch and reduce over ALL mesh axes, whatever their names.
+    axes = tuple(mesh.axis_names)
 
     def shard_fn(params, state, images, labels, mask):
         ce_sum, correct, n_real = _batch_metrics(
             params, state, images, labels, mask, model_name=model_name,
             dtype=dtype, folded=folded)
-        return (jax.lax.psum(ce_sum, DATA_AXIS),
-                jax.lax.psum(correct, DATA_AXIS),
-                jax.lax.psum(n_real, DATA_AXIS))
+        return (jax.lax.psum(ce_sum, axes),
+                jax.lax.psum(correct, axes),
+                jax.lax.psum(n_real, axes))
 
     return shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(), P(), P("data"), P("data"), P("data")),
+        in_specs=(P(), P(), P(axes), P(axes), P(axes)),
         out_specs=(P(), P(), P()))(params, state, images, labels, mask)
 
 
@@ -157,7 +159,7 @@ def evaluate_sharded(params: PyTree, state: PyTree, dataset, mesh, *,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    data_shd = NamedSharding(mesh, P("data"))
+    data_shd = NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
     def stage(arr):
         arr = np.asarray(arr)

@@ -232,7 +232,13 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
     attend to the first cache slots — segmented decode passes its
     segment's bound so early tokens do not read the whole buffer.  With
     ``use_decode_kernel`` the Pallas decode kernel replaces both tricks:
-    the read bound is the exact, dynamic ``pos+1``."""
+    the read bound is the exact, dynamic ``pos+1`` — a caller-supplied
+    ``k_len`` would be silently ignored on that path, so combining the
+    two is rejected."""
+    if use_decode_kernel and k_len is not None:
+        raise ValueError(
+            "k_len is ignored when use_decode_kernel=True (the kernel's "
+            "read bound is the exact dynamic pos+1); pass one or the other")
     logits, cache = _forward_cached(
         params, cache, token[:, None], jnp.atleast_1d(pos), pos,
         cfg=cfg, dtype=dtype, tp_axis=tp_axis, k_len=k_len,

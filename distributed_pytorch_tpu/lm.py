@@ -373,6 +373,60 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
     return eval_step
 
 
+def make_lm_pp_eval_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Forward-only masked-CE through the pipeline (no grad, no merge):
+    (params, tokens, targets) -> (ce_sum, count), globally reduced.
+
+    The reference evaluates after every training epoch
+    (/root/reference/main.py:51-66, called at main.py:108); a pp-trained
+    model must run that eval loop without leaving the pipeline layout, so
+    this drives the same wave schedule as the pp train step, skipping
+    autodiff (and its remat blocks — ``remat_block_ticks=None`` keeps the
+    cheap flat scan, since there is no backward to hold activations for).
+    """
+    from .parallel import pipeline as pp
+
+    dtype = cfg.dtype
+    n_micro = cfg.microbatches or 2 * cfg.pp
+    tp_axis = MODEL if cfg.tp > 1 else None
+    seq_axis = SEQ if cfg.sp > 1 else None
+
+    def local_eval(stage_params, shared, tokens, targets):
+        b_local = tokens.shape[0]
+        if b_local % n_micro:
+            raise ValueError(
+                f"eval batch (local {b_local}) not divisible into "
+                f"{n_micro} microbatches")
+        mb = b_local // n_micro
+        tokens = tokens.reshape(n_micro, mb, -1)
+        targets = targets.reshape(n_micro, mb, -1)
+        pos = _shard_positions(cfg, tokens.shape[-1])
+        ce_sum, n, _aux = pp.pipeline_loss(
+            stage_params, shared, tokens, targets,
+            cfg=cfg.model, axis=PIPE, dtype=dtype,
+            tp_axis=tp_axis, seq_axis=seq_axis,
+            seq_layout=cfg.seq_layout, pos=pos,
+            interleave=cfg.interleave,
+            remat_block_ticks=None)
+        return (jax.lax.psum(ce_sum, (DATA, PIPE, SEQ)),
+                jax.lax.psum(n, (DATA, PIPE, SEQ)))
+
+    stage_specs = pp_stage_specs(cfg)
+    shared_specs = {"embed": P(), "final_norm": P()}
+    sharded_eval = shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(stage_specs, shared_specs, P(DATA, SEQ), P(DATA, SEQ)),
+        out_specs=(P(), P()))
+
+    @jax.jit
+    def eval_step(params, tokens, targets):
+        return sharded_eval(params["stages"], params["shared"],
+                            _zigzag_global(cfg, tokens),
+                            _zigzag_global(cfg, targets))
+
+    return eval_step
+
+
 class LMTrainer:
     """Owns (params, opt_state) laid out over the (data, seq, model) mesh —
     or the (data, pipe, seq, model) mesh when cfg.pp > 1."""
@@ -429,12 +483,15 @@ class LMTrainer:
         self.restored_meta: dict = {}
 
     def evaluate(self, batches) -> dict[str, float]:
-        """Held-out loss/perplexity over an iterable of (tokens, targets)."""
-        if self.cfg.pp > 1:
-            raise NotImplementedError("evaluate() with pp>1: use the "
-                                      "(data, seq, model) layout for eval")
+        """Held-out loss/perplexity over an iterable of (tokens, targets).
+
+        pp > 1 evaluates through the pipeline forward (the wave schedule,
+        no grad) — the train→eval loop of the reference (main.py:108)
+        never leaves the pipeline layout."""
         if self._eval_fn is None:
-            self._eval_fn = make_lm_eval_step(self.cfg, self.mesh)
+            self._eval_fn = (make_lm_pp_eval_step(self.cfg, self.mesh)
+                             if self.cfg.pp > 1
+                             else make_lm_eval_step(self.cfg, self.mesh))
         shd = NamedSharding(self.mesh, P(DATA, SEQ))
         total, count = 0.0, 0
         for tokens, targets in batches:

@@ -36,7 +36,7 @@ from .data import augment as aug, pipeline
 from .models import vgg
 from .ops import nn as ops
 from .parallel import strategies as strat
-from .parallel.mesh import DATA_AXIS, data_sharding, make_mesh, replicated
+from .parallel.mesh import DATA_AXIS, make_mesh, replicated
 from .utils import debug as dbg, tracing
 from .utils.metrics import IterTimeMeter, LossMeter
 
@@ -53,6 +53,11 @@ class TrainConfig:
     weight_decay: float = 1e-4    # main.py:104
     batch_size: int = 256         # per replica (main.py:18)
     strategy: str = "ddp"
+    # Number of slices for the 'hierarchical' strategy: the data axis
+    # factors into Mesh(('dcn', 'ici')) with dcn_size slices (cross-slice
+    # DCN traffic drops to payload/ici — see strategies.Hierarchical).
+    # Ignored by single-axis strategies.
+    dcn_size: int = 2
     steps_per_loop: int = 1       # K optimizer steps per device dispatch
     sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
     # torch DDP's broadcast_buffers=True: BN running stats follow rank 0
@@ -77,14 +82,17 @@ class TrainConfig:
         return self.strategy in ("ddp", "bucketed")
 
 
-def _as_varying(tree: PyTree, axis: str) -> PyTree:
-    """Pcast leaves to device-varying over ``axis``; leaves that are already
-    varying (e.g. a scan carry whose vma was unified with varying neighbors)
-    pass through unchanged."""
+def _as_varying(tree: PyTree, axis) -> PyTree:
+    """Pcast leaves to device-varying over ``axis`` (a name or tuple of
+    names); leaves already varying (e.g. a scan carry whose vma was unified
+    with varying neighbors) pass through unchanged."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+
     def cast(x):
-        if axis in jax.typeof(x).vma:
+        missing = tuple(a for a in names if a not in jax.typeof(x).vma)
+        if not missing:
             return x
-        return jax.lax.pcast(x, axis, to="varying")
+        return jax.lax.pcast(x, missing, to="varying")
     return jax.tree.map(cast, tree)
 
 
@@ -115,11 +123,12 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
     """Build the compiled single train step — ``make_multi_step`` with K=1
     (one implementation of the optimizer-step semantics, not two).
 
-    Signature: ``step(params, state, opt_state, key, step0, images, labels)
-    -> (params, state, opt_state, loss)``; the per-step RNG is
-    ``fold_in(key, step0)``.  Under a mesh, ``state`` leaves carry a leading
-    device axis (per-replica BN stats) and ``loss`` is the cross-replica
-    mean of the per-shard losses.
+    Signature: ``step(params, state, opt_state, sync_state, key, step0,
+    images, labels) -> (params, state, opt_state, sync_state, loss)``; the
+    per-step RNG is ``fold_in(key, step0)``.  Under a mesh, ``state`` (and
+    ``sync_state`` — a stateful strategy's per-device residual; a dummy
+    otherwise) leaves carry a leading device axis, and ``loss`` is the
+    cross-replica mean of the per-shard losses.
 
     The three training-state arguments are DONATED: the step updates them in
     place on device and the caller must use the returned pytrees (passing a
@@ -127,11 +136,12 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
     """
     multi = make_multi_step(cfg, strategy, mesh)
 
-    def step(params, state, opt_state, key, step0, images, labels):
-        params, state, opt_state, losses = multi(
-            params, state, opt_state, key, step0,
+    def step(params, state, opt_state, sync_state, key, step0, images,
+             labels):
+        params, state, opt_state, sync_state, losses = multi(
+            params, state, opt_state, sync_state, key, step0,
             images[None], labels[None])
-        return params, state, opt_state, losses[0]
+        return params, state, opt_state, sync_state, losses[0]
 
     return step
 
@@ -154,15 +164,20 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     exactly regardless of steps_per_loop.
     """
     tx = make_optimizer(cfg)
-    bn_axis = DATA_AXIS if (cfg.sync_bn and mesh is not None) else None
+    # The data axis may be factored: hierarchical runs over ('dcn', 'ici').
+    data_axes = getattr(strategy, "axes", None) or DATA_AXIS
+    bn_axis = data_axes if (cfg.sync_bn and mesh is not None) else None
     bcast_buffers = cfg.broadcast_buffers_resolved and mesh is not None
+    # Stateful strategies (error-feedback ring) carry a per-device residual
+    # through the scan, alongside BN state; stateless ones thread a dummy.
+    stateful = getattr(strategy, "stateful", False)
     grad_fn = jax.value_and_grad(
         partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
 
-    def scan_steps(params, state, opt_state, key, step0, images, labels,
-                   *, axis: str | None):
+    def scan_steps(params, state, opt_state, sync_state, key, step0,
+                   images, labels, *, axis: str | None):
         def body(carry, batch):
-            params, state, opt_state, step = carry
+            params, state, opt_state, sync_state, step = carry
             imgs, lbls = batch
             k = jax.random.fold_in(key, step)
             if axis is not None:
@@ -189,45 +204,54 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                             jnp.where(idx == 0, s, jnp.zeros_like(s)), axis),
                         axis),
                     state)
-            grads = strategy(grads, axis)
+            if stateful:
+                grads, sync_state = strategy(grads, axis, sync_state)
+            else:
+                grads = strategy(grads, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, state, opt_state, step + 1), loss
+            return (params, state, opt_state, sync_state, step + 1), loss
 
-        (params, state, opt_state, _), losses = jax.lax.scan(
-            body, (params, state, opt_state, step0), (images, labels))
-        return params, state, opt_state, losses
+        (params, state, opt_state, sync_state, _), losses = jax.lax.scan(
+            body, (params, state, opt_state, sync_state, step0),
+            (images, labels))
+        return params, state, opt_state, sync_state, losses
 
     if mesh is None:
         if strategy.needs_mesh:
             raise ValueError(f"strategy {strategy.name!r} requires a mesh")
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def multi_step(params, state, opt_state, key, step0, images, labels):
-            return scan_steps(params, state, opt_state, key, step0,
-                              images, labels, axis=None)
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def multi_step(params, state, opt_state, sync_state, key, step0,
+                       images, labels):
+            return scan_steps(params, state, opt_state, sync_state, key,
+                              step0, images, labels, axis=None)
 
         return multi_step
 
-    def shard_multi_step(params, state, opt_state, key, step0, images, labels):
+    def shard_multi_step(params, state, opt_state, sync_state, key, step0,
+                         images, labels):
         local_state = jax.tree.map(lambda s: s[0], state)
-        params, new_state, opt_state, losses = scan_steps(
-            params, local_state, opt_state, key, step0, images, labels,
-            axis=DATA_AXIS)
+        local_sync = jax.tree.map(lambda s: s[0], sync_state)
+        params, new_state, opt_state, new_sync, losses = scan_steps(
+            params, local_state, opt_state, local_sync, key, step0,
+            images, labels, axis=data_axes)
         new_state = jax.tree.map(lambda s: s[None], new_state)
-        return params, new_state, opt_state, jax.lax.pmean(losses, DATA_AXIS)
+        new_sync = jax.tree.map(lambda s: s[None], new_sync)
+        return (params, new_state, opt_state, new_sync,
+                jax.lax.pmean(losses, data_axes))
 
     return jax.jit(shard_map(
         shard_multi_step,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(), P(), P(),
-                  P(None, DATA_AXIS), P(None, DATA_AXIS)),
-        out_specs=(P(), P(DATA_AXIS), P(), P()),
+        in_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(),
+                  P(None, data_axes), P(None, data_axes)),
+        out_specs=(P(), P(data_axes), P(), P(data_axes), P()),
         # Ring-collective strategies assemble their result from ppermute
         # hops: bitwise replicated by construction, but not provably so to
         # the vma checker (no sanctioned varying->invariant downcast).
         check_vma=not getattr(strategy, "vma_opaque", False),
-    ), donate_argnums=(0, 1, 2))
+    ), donate_argnums=(0, 1, 2, 3))
 
 
 def replicate_state(state: PyTree, n: int) -> PyTree:
@@ -266,11 +290,29 @@ class Trainer:
     main_all_reduce.py:84-135).
     """
 
-    def __init__(self, cfg: TrainConfig, mesh: Mesh | None = None):
+    def __init__(self, cfg: TrainConfig, mesh: Mesh | None = None,
+                 num_devices: int | None = None):
         self.cfg = cfg
         self.strategy = strat.get(cfg.strategy)
+        self.data_axes = getattr(self.strategy, "axes", None) or DATA_AXIS
         if self.strategy.needs_mesh and mesh is None:
-            mesh = make_mesh()
+            if isinstance(self.data_axes, tuple):
+                n = num_devices or len(jax.devices())
+                if n % cfg.dcn_size:
+                    raise ValueError(
+                        f"dcn_size {cfg.dcn_size} must divide the "
+                        f"{n}-device fleet for strategy "
+                        f"{self.strategy.name!r}")
+                mesh = make_mesh(n, axis_names=self.data_axes,
+                                 axis_shape=(cfg.dcn_size,
+                                             n // cfg.dcn_size))
+            else:
+                mesh = make_mesh(num_devices)
+        if (self.strategy.needs_mesh and isinstance(self.data_axes, tuple)
+                and tuple(mesh.axis_names) != self.data_axes):
+            raise ValueError(
+                f"strategy {self.strategy.name!r} needs a mesh with axes "
+                f"{self.data_axes}, got {mesh.axis_names}")
         self.mesh = mesh if self.strategy.needs_mesh else None
         self.n_replicas = self.mesh.devices.size if self.mesh else 1
 
@@ -280,14 +322,26 @@ class Trainer:
         tx = make_optimizer(cfg)
         opt_state = tx.init(params)
 
+        # Stateful strategies (error-feedback ring) carry a per-device
+        # residual between steps, stacked like BN state; stateless ones
+        # thread a zero-size dummy through the same slot.
+        if getattr(self.strategy, "stateful", False):
+            sync_state = self.strategy.init_state(params, self.n_replicas)
+        else:
+            sync_state = jnp.zeros((0,), jnp.float32)
+        sync_state = jnp.broadcast_to(
+            sync_state[None], (self.n_replicas,) + sync_state.shape)
+
         if self.mesh is not None:
             rep = replicated(self.mesh)
-            shd = data_sharding(self.mesh)
+            shd = NamedSharding(self.mesh, P(self.data_axes))
             params = jax.device_put(params, rep)
             opt_state = jax.device_put(opt_state, rep)
             state = jax.device_put(
                 replicate_state(state, self.n_replicas), shd)
+            sync_state = jax.device_put(sync_state, shd)
         self.params, self.state, self.opt_state = params, state, opt_state
+        self.sync_state = sync_state
         self._multi_fn = None   # jitted K-step program, built lazily
         self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
@@ -316,7 +370,7 @@ class Trainer:
         make_array_from_process_local_data would fail."""
         if self.mesh is None:
             return images, labels
-        shd = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        shd = NamedSharding(self.mesh, P(None, self.data_axes))
         if isinstance(images, jax.Array) and images.sharding == shd:
             return images, labels
         if jax.process_count() > 1:
@@ -328,7 +382,7 @@ class Trainer:
         if images.shape[1] % self.n_replicas != 0:
             raise ValueError(
                 f"global batch {images.shape[1]} not divisible by the "
-                f"{self.n_replicas}-device '{DATA_AXIS}' mesh axis; "
+                f"{self.n_replicas}-device {self.data_axes!r} mesh axis; "
                 f"pass per-replica batches of equal size (the sampler "
                 f"pads the epoch for exactly this reason)")
         return jax.device_put(images, shd), jax.device_put(labels, shd)
@@ -352,8 +406,8 @@ class Trainer:
 
     def _args(self, images, labels):
         step0 = jnp.asarray(self._step, jnp.int32)
-        return (self.params, self.state, self.opt_state, self.data_key,
-                step0, images, labels)
+        return (self.params, self.state, self.opt_state, self.sync_state,
+                self.data_key, step0, images, labels)
 
     def precompile_steps(self, images: np.ndarray, labels: np.ndarray) -> None:
         """Ensure the program for these (K, batch, ...) shapes is compiled
@@ -369,8 +423,8 @@ class Trainer:
         k = images.shape[0]
         images, labels = self._stage(images, labels)
         args = self._args(images, labels)
-        self.params, self.state, self.opt_state, losses = (
-            self._executable(args)(*args))
+        (self.params, self.state, self.opt_state, self.sync_state,
+         losses) = self._executable(args)(*args)
         self._step += k
         if self._verify_replication:
             self._verify_replication = False

@@ -32,7 +32,7 @@ import threading
 import jax
 import numpy as np
 
-from ..parallel.mesh import data_sharding, replicated
+from ..parallel.mesh import replicated
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 
@@ -244,8 +244,10 @@ class Checkpointer:
         state = _unflatten_like(trainer.state, flat, "state")
         opt_state = _unflatten_like(trainer.opt_state, flat, "opt")
         if trainer.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
             rep = replicated(trainer.mesh)
-            shd = data_sharding(trainer.mesh)
+            # the data axis may be factored (hierarchical: ('dcn', 'ici'))
+            shd = NamedSharding(trainer.mesh, P(trainer.data_axes))
             params = jax.device_put(params, rep)
             opt_state = jax.device_put(opt_state, rep)
             state = jax.device_put(state, shd)

@@ -52,10 +52,13 @@ WINDOW = int(os.environ.get("BENCH_WINDOW", "20"))
 def bench_strategy(name: str) -> float:
     """Mean seconds/step over WINDOW iterations, compile + warm-up excluded
     (the reference's iter-0-excluded window, main.py:43-48)."""
-    mesh = make_mesh(N_DEV) if name != "none" else None
+    # Factored-axis strategies (hierarchical): mesh=None lets the Trainer
+    # build the right ('dcn', 'ici') mesh from cfg.dcn_size — one recipe.
+    factored = getattr(strat.get(name), "axes", None) is not None
+    mesh = make_mesh(N_DEV) if (name != "none" and not factored) else None
     cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False)
     tr = Trainer(cfg, mesh=mesh)
-    n = N_DEV if mesh is not None else 1
+    n = tr.n_replicas
     rng = np.random.default_rng(0)
     images = rng.integers(
         0, 256, (PER_DEV_BATCH * n, 32, 32, 3)).astype(np.uint8)
@@ -72,9 +75,9 @@ def bench_strategy(name: str) -> float:
 
 
 def main() -> None:
-    names = ["none", "ddp", "bucketed", "all_reduce",
+    names = ["none", "ddp", "bucketed", "hierarchical", "all_reduce",
              "gather_scatter_symmetric", "gather_scatter",
-             "quantized", "quantized_ring"]
+             "quantized", "quantized_ring", "quantized_ring_ef"]
     results: dict[str, float] = {}
     for name in names:
         t = bench_strategy(name)

@@ -299,3 +299,23 @@ def test_pytree_checkpointer_cross_mesh_size(tmp_path):
     np.testing.assert_array_equal(np.asarray(trees["t"]["x"]), x)
     assert trees["t"]["x"].sharding.is_equivalent_to(
         like8.sharding, trees["t"]["x"].ndim)
+
+
+def test_save_restore_hierarchical_factored_mesh(tmp_path):
+    """Resume on the ('dcn','ici') factored mesh: restore must shard BN
+    state with the trainer's factored data axes, not a literal 'data'
+    (round-3 review finding)."""
+    cfg = TrainConfig(strategy="hierarchical", batch_size=2, model="TINY",
+                      augment=False, dcn_size=2)
+    t1 = Trainer(cfg)
+    images, labels = _batch(2 * t1.n_replicas)
+    t1.train_step(images, labels)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(t1, epoch=1)
+
+    t2 = Trainer(cfg)
+    assert ck.maybe_restore(t2) == 1
+    assert _tree_equal(t1.params, t2.params)
+    la = float(t1.train_step(images, labels))
+    lb = float(t2.train_step(images, labels))
+    np.testing.assert_allclose(lb, la, rtol=1e-6)

@@ -413,3 +413,45 @@ def test_pp_trained_params_merge_and_decode():
                        jax.random.key(0), cfg=model, max_new=8,
                        temperature=0.0, decode_kernel=False)
     assert out.shape == (1, 16)
+
+
+def test_pp_evaluate_matches_dense_oracle():
+    """evaluate() with pp>1 (VERDICT round-2 #2): held-out eval runs through
+    the pipeline forward and must match the dense single-device oracle, and
+    keep matching after a pp training step moves the params."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=4,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    tokens, targets = _data(b=8, s=64, vocab=256)
+
+    dense = LMTrainer(LMTrainConfig(model=model, compute_dtype=None))
+    pp2 = LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
+                                  dp=2, pp=2))
+    m_dense = dense.evaluate([(tokens, targets)])
+    m_pp = pp2.evaluate([(tokens, targets)])
+    assert m_pp["tokens"] == m_dense["tokens"] == 8 * 63
+    np.testing.assert_allclose(m_pp["loss"], m_dense["loss"], rtol=1e-5)
+
+    # after a training step the params differ from init; trajectories are
+    # identical (test_pipeline_parallel_matches_dense), so eval must be too
+    dense.train_step(tokens, targets)
+    pp2.train_step(tokens, targets)
+    np.testing.assert_allclose(pp2.evaluate([(tokens, targets)])["loss"],
+                               dense.evaluate([(tokens, targets)])["loss"],
+                               rtol=1e-5)
+
+
+def test_pp_sp_evaluate_matches_dense_oracle():
+    """pp x sp eval: the zigzag ring inside pipeline stages, forward-only."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
+                                  n_heads=2, head_dim=32, d_ff=128)
+    tokens, targets = _data(b=4, s=128, vocab=128)
+    dense = LMTrainer(LMTrainConfig(model=model, compute_dtype=None))
+    ppsp = LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
+                                   pp=2, sp=2, microbatches=2))
+    np.testing.assert_allclose(ppsp.evaluate([(tokens, targets)])["loss"],
+                               dense.evaluate([(tokens, targets)])["loss"],
+                               rtol=1e-5)

@@ -311,3 +311,33 @@ def test_affine_shuffle_mode_is_sharded_bijection():
 
     with pytest.raises(ValueError, match="shuffle_mode"):
         lm_corpus.LMDataLoader(corpus, 2, 64, shuffle_mode="bogus")
+
+
+def test_affine_bijection_vectorized_matches_scalar_loop():
+    """The int64 fast path (advisor round-2: vectorize when (n-1)^2 fits)
+    must agree elementwise with arbitrary-precision Python-int math."""
+    corpus = lm_corpus.LMCorpus(np.arange(64 * 65, dtype=np.int32))
+    dl = lm_corpus.LMDataLoader(corpus, batch_size=2, seq_len=64, seed=5,
+                                shuffle_mode="affine")
+    bij = dl._epoch_bijection()
+    n = dl.n_windows
+    xs = np.arange(n)
+    got = bij(xs)
+    assert got.dtype == np.int64
+    # exact elementwise agreement with big-int math, and a bijection
+    slow = np.array([int(bij(np.array([x]))[0]) for x in range(n)])
+    np.testing.assert_array_equal(got, slow)
+    assert len(set(got.tolist())) == n
+
+
+def test_decode_step_rejects_k_len_with_kernel():
+    """advisor round-2: a caller-supplied k_len would be silently dropped
+    on the kernel path — decode_step must reject the combination."""
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                n_heads=2, head_dim=16, d_ff=64)
+    params = tfm.init(jax.random.key(0), cfg)
+    cache = gen.init_cache(cfg, batch=1, max_len=16)
+    tok = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(ValueError, match="k_len is ignored"):
+        gen.decode_step(params, cache, tok, jnp.int32(0), cfg=cfg,
+                        k_len=8, use_decode_kernel=True)

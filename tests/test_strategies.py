@@ -189,8 +189,8 @@ class TestStrategyUnits:
     def test_registry(self):
         assert strat.available() == [
             "all_reduce", "bucketed", "ddp", "gather_scatter",
-            "gather_scatter_symmetric", "none", "quantized",
-            "quantized_ring"]
+            "gather_scatter_symmetric", "hierarchical", "none",
+            "quantized", "quantized_ring", "quantized_ring_ef"]
         with pytest.raises(ValueError, match="unknown strategy"):
             strat.get("nope")
 
@@ -365,3 +365,258 @@ def test_quantized_ring_trains_and_matches_ddp_curve():
     # noise relatively larger than on VGG-11; 1% still pins curve-following.
     np.testing.assert_allclose(losses["quantized_ring"], losses["ddp"],
                                rtol=1e-2, atol=1e-2)
+
+
+class TestHierarchical:
+    """Two-level (dcn x ici) gradient sync — VERDICT round-2 item #1.
+
+    The multi-slice regime: 'dcn' is the slow cross-slice link, 'ici' the
+    fast within-slice one; the strategy must (a) compute the exact global
+    mean, (b) move only shard-sized payloads over 'dcn', and (c) be provably
+    replicated (no check_vma escape hatch)."""
+
+    def _mesh2x4(self):
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("dcn", "ici"))
+
+    def test_exact_global_mean(self):
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.default_rng(3)
+        grads = {"w": rng.standard_normal((8, 33, 7)).astype(np.float32),
+                 "b": rng.standard_normal((8, 5)).astype(np.float32)}
+        h = strat.get("hierarchical")
+        # out_specs=P() with check_vma on: the result must be PROVABLY
+        # replicated over both axes (all_gather_invariant, no escape hatch).
+        f = jax.jit(shard_map(
+            partial(h, axis=("dcn", "ici")), mesh=self._mesh2x4(),
+            in_specs=(P(("dcn", "ici")),),
+            out_specs=P()))
+        out = f(grads)
+        for k in grads:
+            np.testing.assert_allclose(
+                np.asarray(out[k])[0], np.mean(grads[k], axis=0),
+                rtol=1e-5, atol=1e-6)
+
+    def test_matches_ddp_trajectory(self):
+        """4 training steps on the factored 2x4 mesh == ddp on the flat
+        8-device mesh (same data, same RNG stream: axis_index linearizes
+        identically)."""
+        rng = np.random.default_rng(11)
+        images = rng.integers(0, 256, (4, 16, 32, 32, 3)).astype(np.uint8)
+        labels = rng.integers(0, 10, (4, 16)).astype(np.int32)
+
+        hier = Trainer(_cfg("hierarchical", seed=5, dcn_size=2))
+        assert hier.mesh.axis_names == ("dcn", "ici")
+        assert hier.mesh.devices.shape == (2, 4)
+        ddp = Trainer(_cfg("ddp", seed=5), make_mesh(8))
+        for i in range(4):
+            lh = float(hier.train_step(images[i], labels[i]))
+            ld = float(ddp.train_step(images[i], labels[i]))
+            np.testing.assert_allclose(lh, ld, rtol=1e-5, atol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            hier.params, ddp.params)
+        hier.check_consistency()
+
+    def test_dcn_payload_is_shard_sized(self):
+        """Wire-cost pinning: the cross-slice ('dcn') reduction moves a
+        1/ici-sized shard, not the full gradient — the point of the
+        two-level algorithm (flat psum would move all 1024 floats)."""
+        import re
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        grads = {"w": jnp.ones((8, 64, 16))}  # 1024 f32 per replica
+        h = strat.get("hierarchical")
+        jaxpr = str(jax.make_jaxpr(shard_map(
+            partial(h, axis=("dcn", "ici")), mesh=self._mesh2x4(),
+            in_specs=(P(("dcn", "ici")),), out_specs=P()))(grads))
+        dcn_ops = [ln for ln in jaxpr.splitlines()
+                   if "psum" in ln and "axes=('dcn',)" in ln]
+        assert dcn_ops, jaxpr[:800]
+        for ln in dcn_ops:
+            shapes = re.findall(r"f32\[(\d+)\]", ln)
+            assert shapes and all(int(s) == 1024 // 4 for s in shapes), ln
+
+    def test_dcn_size_must_divide(self):
+        with pytest.raises(ValueError, match="dcn_size"):
+            Trainer(_cfg("hierarchical", dcn_size=3))
+
+    def test_mesh_axes_validated(self):
+        with pytest.raises(ValueError, match="axes"):
+            Trainer(_cfg("hierarchical"), make_mesh(8))
+
+
+class TestQuantizedRingEF:
+    """Error-feedback ring (VERDICT round-2 #3): nothing is lost, only
+    delayed one step."""
+
+    def test_residual_bookkeeping_is_exact(self):
+        """n*mean + psum(residuals) == exact gradient sum, to f32 noise:
+        the residuals hold PRECISELY what the int8 wire dropped."""
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n = 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+        rng = np.random.default_rng(1)
+        grads = {"w": rng.standard_normal((n, 300, 7)).astype(np.float32),
+                 "b": rng.standard_normal((n, 13)).astype(np.float32)}
+        ef = strat.get("quantized_ring_ef")
+        res0 = np.zeros((n,) + ef.init_state(
+            jax.tree.map(lambda g: g[0], grads), n).shape, np.float32)
+
+        def run(grads, res):
+            out, new_res = ef(grads, "data", res)
+            return out, new_res, jax.lax.psum(new_res, "data")
+
+        f = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(P("data"), P("data")),
+            out_specs=(P("data"), P("data"), P()),
+            check_vma=False))
+        out, new_res, res_sum = f(grads, jnp.asarray(res0))
+
+        # flatten in jax.tree order (sorted keys) to match residual layout
+        exact_sum = np.concatenate(
+            [np.sum(leaf, axis=0).ravel() for leaf in jax.tree.leaves(grads)])
+        got_sum = n * np.concatenate(
+            [np.asarray(leaf)[0].ravel()
+             for leaf in jax.tree.leaves(out)])
+        recovered = got_sum + np.asarray(res_sum)[:exact_sum.size]
+        scale = np.abs(exact_sum).max()
+        np.testing.assert_allclose(recovered, exact_sum,
+                                   atol=1e-5 * max(scale, 1.0))
+        # and the residuals are genuinely nonzero (the wire does drop bits)
+        assert np.abs(new_res).max() > 0
+
+    def test_cumulative_bias_telescopes(self):
+        """The convergence mechanism, deterministically: over K rounds on
+        constant per-device gradients, EF's summed output telescopes to the
+        exact sum (error bounded by ONE step's quantization, released at
+        round K), while the plain ring's bias accumulates ~linearly.  At
+        K=50 the plain ring's cumulative error is ~50x EF's — this is why
+        EF converges like exact sync."""
+        from jax import lax, shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n, K = 8, 50
+        mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+        rng = np.random.default_rng(0)
+        g = rng.standard_normal((n, 600)).astype(np.float32) * 0.01
+        ef = strat.get("quantized_ring_ef")
+        ring = strat.get("quantized_ring")
+        res0 = np.zeros((n,) + ef.init_state({"w": g[0]}, n).shape,
+                        np.float32)
+
+        def ef_sum(g, r):
+            g, r = g[0], r[0]
+
+            def body(carry, _):
+                r, acc = carry
+                out, r = ef({"w": g}, "data", r)
+                return (r, acc + out["w"]), None
+            (_, acc), _ = lax.scan(body, (r, jnp.zeros_like(g)), None,
+                                   length=K)
+            return acc[None]
+
+        def ring_sum(g):
+            def body(acc, _):
+                return acc + ring({"w": g[0]}, "data")["w"], None
+            acc, _ = lax.scan(body, jnp.zeros_like(g[0]), None, length=K)
+            return acc[None]
+
+        fe = jax.jit(shard_map(ef_sum, mesh=mesh,
+                               in_specs=(P("data"), P("data")),
+                               out_specs=P("data"), check_vma=False))
+        fr = jax.jit(shard_map(ring_sum, mesh=mesh, in_specs=(P("data"),),
+                               out_specs=P("data"), check_vma=False))
+        exact = K * np.mean(g, axis=0)
+        e_ef = np.abs(np.asarray(fe(g, jnp.asarray(res0)))[0] - exact).max()
+        e_pl = np.abs(np.asarray(fr(g))[0] - exact).max()
+        assert e_ef * 10 < e_pl, (e_ef, e_pl)  # measured: ~50x
+        # EF's cumulative error stays at the one-step quantization scale
+        assert e_ef < 5e-4, e_ef
+
+    def test_converges_like_exact_on_convex_problem(self):
+        """Distributed least squares, plain SGD, 300 steps at n=8: exact
+        sync reaches w*; the plain int8 ring stalls at its noise floor; EF
+        lands >10x closer than plain (measured ~24x, within ~7x of exact).
+        This is the 'converges like exact sync' claim on an objective where
+        convergence distance is well-defined (VGG trajectories are chaotic
+        amplifiers — any inexact sync diverges in trajectory there)."""
+        from jax import lax, shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n = 8
+        mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((n, 32, 16)).astype(np.float32)
+        b = rng.standard_normal((n, 32)).astype(np.float32)
+        wstar, *_ = np.linalg.lstsq(np.concatenate(A, 0),
+                                    np.concatenate(b, 0), rcond=None)
+
+        def final_w(name):
+            s = strat.get(name)
+            stateful = getattr(s, "stateful", False)
+            r0 = (np.zeros((n,) + s.init_state(
+                {"w": np.zeros(16, np.float32)}, n).shape, np.float32)
+                if stateful else np.zeros((n, 1), np.float32))
+
+            def run(A, b, r):
+                A, b, r = A[0], b[0], r[0]
+
+                def body(carry, _):
+                    w, r = carry
+                    g = A.T @ (A @ w - b) / A.shape[0]
+                    if stateful:
+                        out, r = s({"w": g}, "data", r)
+                    else:
+                        out = s({"w": g}, "data")
+                    return (w - 0.05 * out["w"], r), None
+                (w, _), _ = lax.scan(body, (jnp.zeros((16,)), r), None,
+                                     length=300)
+                return w[None]
+
+            f = jax.jit(shard_map(
+                run, mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data")),
+                out_specs=P("data"), check_vma=False))
+            return np.asarray(f(A, b, jnp.asarray(r0)))[0]
+
+        d_plain = np.linalg.norm(final_w("quantized_ring") - wstar)
+        d_ef = np.linalg.norm(final_w("quantized_ring_ef") - wstar)
+        assert d_ef * 10 < d_plain, (d_ef, d_plain)
+
+    def test_trains_on_vgg_trainer_at_n8(self):
+        """End-to-end wiring through the Trainer (stateful carry, donated
+        buffers, AOT cache): trains, stays replicated, and follows ddp's
+        curve within the plain ring's tolerance at DOUBLE its ring size
+        (per-hop noise is O(sqrt(n)), so holding the same bound at n=8 that
+        the plain ring holds at n=4 is the end-to-end EF win)."""
+        from distributed_pytorch_tpu.parallel.mesh import make_mesh
+        from distributed_pytorch_tpu.train import Trainer
+
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (4, 16, 32, 32, 3)).astype(np.uint8)
+        labels = rng.integers(0, 10, (4, 16)).astype(np.int32)
+        losses = {}
+        for name in ("ddp", "quantized_ring_ef"):
+            tr = Trainer(_cfg(name, seed=7), make_mesh(8))
+            losses[name] = [float(tr.train_step(images[i], labels[i]))
+                            for i in range(4)]
+            if name == "quantized_ring_ef":
+                tr.check_consistency()
+                # residual state is live and per-device
+                assert tr.sync_state.shape[0] == 8
+                assert float(np.abs(np.asarray(tr.sync_state)).max()) > 0
+        np.testing.assert_allclose(losses["quantized_ring_ef"],
+                                   losses["ddp"], rtol=1e-2, atol=1e-2)
