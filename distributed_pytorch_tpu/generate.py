@@ -273,6 +273,36 @@ def _sample(key, logits, temperature: float, top_k: int | None):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def sample_per_seq(key, logits, temperature, top_k, top_p):
+    """Sampling with PER-ROW parameters (continuous batching: every slot
+    serves a different request with its own settings, in one compiled
+    step).  ``logits`` (B, V); ``temperature`` (B,) f32 — <= 0 means
+    greedy; ``top_k`` (B,) int32 — 0 disables; ``top_p`` (B,) f32 — >= 1
+    disables (nucleus sampling, computed on the temperature-scaled
+    distribution).  Threshold ties keep all tied tokens, matching
+    ``_sample``.  One (B, V) sort serves both filters; V is the LM head
+    width, so this is noise next to the decode matmuls."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_desc = jnp.sort(scaled, -1)[:, ::-1]
+    # top-k: mask strictly below the k-th largest value (k=0: keep all)
+    k = jnp.clip(top_k, 0, v)
+    kidx = jnp.where(k > 0, k - 1, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=1)
+    masked = jnp.where((k[:, None] > 0) & (scaled < kth), NEG_INF, scaled)
+    # top-p: smallest prefix of the sorted distribution with mass >= p
+    probs = jax.nn.softmax(sorted_desc, -1)
+    exclusive_cum = jnp.cumsum(probs, -1) - probs
+    nkeep = jnp.sum(exclusive_cum < top_p[:, None], -1)  # >= 1 always
+    pidx = jnp.clip(nkeep - 1, 0, v - 1)
+    pth = jnp.take_along_axis(sorted_desc, pidx[:, None], axis=1)
+    masked = jnp.where((top_p[:, None] < 1.0) & (scaled < pth),
+                       NEG_INF, masked)
+    sampled = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
 def _generate_impl(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32

@@ -31,7 +31,17 @@ TPU-first design constraints drive the shape:
   tens of ms, so per-token syncing would dominate (measured 37 ms/token at
   K=1 vs ~2 ms/token at K=32 on the same workload).  Retirement lands at
   block granularity: a sequence that hits EOS/budget mid-block wastes its
-  remaining in-flight slot-steps (the slot refills at the next sync).
+  remaining in-flight slot-steps (the slot refills at the next sync);
+  ``stats`` accounts for every dispatched slot-step (emitted vs wasted);
+- **per-request sampling**: temperature/top_k/top_p/eos_id are
+  ``submit()`` parameters — the compiled decode step samples every slot
+  with its own settings (gen.sample_per_seq), so a greedy request and a
+  hot nucleus-sampled one share a dispatch;
+- **chunked prefill** (``prefill_chunk``): admissions prefill a fixed
+  chunk of prompt per ``step()`` into a scratch cache (attending causally
+  to earlier chunks), interleaved with the pool's decode dispatches — a
+  long prompt never stalls running slots for more than one chunk-sized
+  dispatch.
 """
 
 from __future__ import annotations
@@ -53,8 +63,26 @@ class _Request:
     rid: int
     prompt: np.ndarray            # (L,) int32
     max_new: int
+    # per-request sampling (resolved against the batcher defaults at
+    # submit): every slot can serve a different temperature/top_k/top_p/
+    # eos in the same compiled decode step (gen.sample_per_seq)
+    temperature: float = 1.0
+    top_k: int = 0                # 0 = disabled
+    top_p: float = 1.0            # >= 1 = disabled
+    eos_id: int | None = None
     emitted: list = field(default_factory=list)
     done: bool = False
+
+
+@dataclass
+class _Admission:
+    """A request mid-prefill (chunked): its reserved slot's scratch cache
+    fills one prompt chunk per ``step()`` call, so live slots keep
+    decoding between chunks instead of stalling for the whole prompt."""
+    req: _Request
+    cache: object                 # (1, hkv, bucket, d) scratch slabs
+    bucket: int
+    off: int = 0                  # tokens prefilled so far
 
 
 class ContinuousBatcher:
@@ -76,10 +104,12 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: tfm.TransformerConfig, *,
                  slots: int = 4, max_len: int = 1024,
                  temperature: float = 1.0, top_k: int | None = None,
+                 top_p: float | None = None,
                  eos_id: int | None = None, dtype=None,
                  prompt_buckets: tuple[int, ...] = (32, 128, 512),
                  seed: int = 0, decode_kernel: bool | None = None,
                  steps_per_sync: int = 8,
+                 prefill_chunk: int | None = None,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
@@ -88,6 +118,7 @@ class ContinuousBatcher:
         self.max_len = gen.pad_cache_len(max_len)
         self.temperature = temperature
         self.top_k = top_k
+        self.top_p = top_p
         self.eos_id = eos_id
         self.dtype = dtype
         self.buckets = tuple(sorted(b for b in prompt_buckets
@@ -99,6 +130,20 @@ class ContinuousBatcher:
             raise ValueError(f"steps_per_sync must be >= 1, got "
                              f"{steps_per_sync}")
         self.steps_per_sync = steps_per_sync
+        # Chunked prefill: admissions prefill ``prefill_chunk`` prompt
+        # tokens per step() call, interleaved with the pool's decode
+        # dispatches — a long prompt never stalls running slots for more
+        # than one chunk.  None = whole-bucket single-dispatch prefill.
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(f"prefill_chunk must be >= 1, got "
+                                 f"{prefill_chunk}")
+            bad = [b for b in self.buckets if b % prefill_chunk]
+            if bad:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must divide every "
+                    f"prompt bucket (violates {bad})")
+        self.prefill_chunk = prefill_chunk
         # Tensor-parallel serving: with ``mesh``, params stay in their
         # Megatron tfm.shard_specs sharding, the slot pool's kv heads
         # shard over ``tp_axis``, and prefill/decode run inside shard_map
@@ -116,10 +161,10 @@ class ContinuousBatcher:
                                  f"over {ntp} devices")
         # sharded jax arrays report their GLOBAL shape, so this is
         # cfg.kv_heads in the TP case too
-        kv_heads = params["layer0"]["wk"].shape[1]
+        self.kv_heads = params["layer0"]["wk"].shape[1]
         self.cache = gen.init_cache(cfg, slots, self.max_len,
                                     dtype=dtype or jnp.float32,
-                                    kv_heads=kv_heads)
+                                    kv_heads=self.kv_heads)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._cache_spec = jax.tree.map(lambda _: P(None, tp_axis),
@@ -134,15 +179,33 @@ class ContinuousBatcher:
         self.pos = np.zeros(slots, np.int32)        # last written position
         self.occupant: list[_Request | None] = [None] * slots
         self.last_tok = np.zeros(slots, np.int32)   # next input token
+        # per-slot sampling params, mirrored from each slot's occupant
+        self.slot_temp = np.ones(slots, np.float32)
+        self.slot_topk = np.zeros(slots, np.int32)
+        self.slot_topp = np.ones(slots, np.float32)
+        self.admitting: dict[int, _Admission] = {}  # slot -> in-progress
         self.queue: deque[_Request] = deque()
         self.requests: dict[int, _Request] = {}
         self._next_rid = 0
         self._prefill_fns: dict[int, object] = {}
+        self._chunk_fns: dict[int, object] = {}
         self._decode_fn = None
         self._insert_fn = None
+        # accounting (BASELINE.md serving roofline): slot-steps dispatched
+        # vs tokens actually delivered — the block-granularity waste
+        self.stats = {"decode_dispatches": 0, "slot_steps": 0,
+                      "emitted_tokens": 0, "wasted_slot_steps": 0,
+                      "prefill_dispatches": 0}
 
     # -- submission / results --------------------------------------------
-    def submit(self, prompt, max_new: int = 128) -> int:
+    def submit(self, prompt, max_new: int = 128, *,
+               temperature: float | None = None,
+               top_k: int | None = None,
+               top_p: float | None = None,
+               eos_id: int | None = None) -> int:
+        """Queue a request.  Sampling parameters default to the batcher's;
+        each request's settings apply to its slot only (the compiled decode
+        step samples every slot with its own temperature/top_k/top_p)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -158,13 +221,22 @@ class ContinuousBatcher:
                 f"max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, prompt, max_new)
+        top_k = self.top_k if top_k is None else top_k
+        top_p = self.top_p if top_p is None else top_p
+        req = _Request(
+            rid, prompt, max_new,
+            temperature=(self.temperature if temperature is None
+                         else temperature),
+            top_k=0 if top_k is None else top_k,
+            top_p=1.0 if top_p is None else top_p,  # 0.0 stays: -> greedy
+            eos_id=self.eos_id if eos_id is None else eos_id)
         self.requests[rid] = req
         self.queue.append(req)
         return rid
 
     def pending(self) -> bool:
-        return bool(self.queue) or any(o is not None for o in self.occupant)
+        return (bool(self.queue) or bool(self.admitting)
+                or any(o is not None for o in self.occupant))
 
     def result(self, rid: int) -> np.ndarray:
         req = self.requests[rid]
@@ -181,10 +253,12 @@ class ContinuousBatcher:
             tp = self.tp_axis if self.mesh is not None else None
 
             def prefill_body(params, prompt, true_len):
-                kv_heads = params["layer0"]["wk"].shape[1]
+                # inside shard_map params are LOCAL shards: this is the
+                # PER-DEVICE kv-head count (self.kv_heads is the global)
                 cache = gen.init_cache(cfg, 1, bucket,
                                        dtype=dtype or jnp.float32,
-                                       kv_heads=kv_heads)
+                                       kv_heads=params["layer0"]
+                                       ["wk"].shape[1])
                 # single-row unembed at the last VALID prompt position —
                 # no (bucket, vocab) logits buffer for padded rows
                 logits, cache = gen._forward_cached(
@@ -208,26 +282,29 @@ class ContinuousBatcher:
         return fn
 
     def _decode(self):
-        """(params, cache, tokens (slots,), pos (slots,), key) ->
-        ((K, slots) sampled tokens, cache) — ONE program decodes
-        ``steps_per_sync`` tokens for the whole pool per dispatch (each
-        step's sample feeds the next; host syncs once per block)."""
+        """(params, cache, tokens (slots,), pos (slots,), temp, top_k,
+        top_p, key) -> ((K, slots) sampled tokens, cache) — ONE program
+        decodes ``steps_per_sync`` tokens for the whole pool per dispatch
+        (each step's sample feeds the next; host syncs once per block).
+        Sampling parameters are per-slot vectors (gen.sample_per_seq), so
+        requests with different settings share the dispatch."""
         if self._decode_fn is None:
             cfg, dtype = self.cfg, self.dtype
-            temperature, top_k = self.temperature, self.top_k
             use_kernel = self.use_kernel
             k_steps, max_len = self.steps_per_sync, self.max_len
 
             tp = self.tp_axis if self.mesh is not None else None
 
-            def block_body(params, cache, tokens, pos, key):
+            def block_body(params, cache, tokens, pos, temp, top_k, top_p,
+                           key):
                 def body(carry, _):
                     cache, tokens, pos, key = carry
                     logits, cache = gen.decode_step_ragged(
                         params, cache, tokens, pos, cfg=cfg, dtype=dtype,
                         tp_axis=tp, use_decode_kernel=use_kernel)
                     key, sub = jax.random.split(key)
-                    toks = gen._sample(sub, logits, temperature, top_k)
+                    toks = gen.sample_per_seq(sub, logits, temp, top_k,
+                                              top_p)
                     # overshooting sequences (retired mid-block on the
                     # host) clamp at the last slot; their output is
                     # discarded and the garbage write stays above every
@@ -247,10 +324,61 @@ class ContinuousBatcher:
                 self._decode_fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P()),
+                              P(), P(), P(), P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
                     donate_argnums=(1,))
         return self._decode_fn
+
+    def _prefill_chunk_fn(self, bucket: int, first: bool):
+        """One prompt chunk written at cache offset ``off``, attending
+        causally to everything already prefilled (k_len=bucket; rows read
+        slots <= their own position).  Returns ((vocab,) logits at
+        ``unembed_idx``, cache); the final chunk's ``unembed_idx`` is the
+        last true prompt position relative to the chunk, earlier chunks'
+        logits are discarded.  The ``first`` variant creates the zeroed
+        scratch cache INSIDE the jit (like _prefill) — no host-side
+        allocation dispatches on the admission path."""
+        fn = self._chunk_fns.get((bucket, first))
+        if fn is None:
+            cfg, dtype = self.cfg, self.dtype
+            c = self.prefill_chunk
+            tp = self.tp_axis if self.mesh is not None else None
+
+            def run_chunk(params, cache, chunk, off, unembed_idx):
+                logits, cache = gen._forward_cached(
+                    params, cache, chunk, off + jnp.arange(c), off,
+                    cfg=cfg, dtype=dtype, k_len=bucket, tp_axis=tp,
+                    unembed_at=unembed_idx)
+                return logits[0, 0], cache
+
+            if first:
+                def chunk_body(params, chunk, unembed_idx):
+                    # local (per-shard) kv-head count, as in prefill_body
+                    cache = gen.init_cache(cfg, 1, bucket,
+                                           dtype=dtype or jnp.float32,
+                                           kv_heads=params["layer0"]
+                                           ["wk"].shape[1])
+                    return run_chunk(params, cache, chunk, jnp.int32(0),
+                                     unembed_idx)
+                donate = ()
+            else:
+                chunk_body = run_chunk
+                donate = (1,)
+            if self.mesh is None:
+                fn = jax.jit(chunk_body, donate_argnums=donate)
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                in_specs = ((self._param_specs, P(), P()) if first else
+                            (self._param_specs, self._cache_spec,
+                             P(), P(), P()))
+                fn = jax.jit(shard_map(
+                    chunk_body, mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=(P(), self._cache_spec)),
+                    donate_argnums=donate)
+            self._chunk_fns[(bucket, first)] = fn
+        return fn
 
     def _insert(self, slabs, slot: int) -> None:
         """Write a prefill's (1, hkv, bucket, d) slabs into the pool slot
@@ -269,9 +397,29 @@ class ContinuousBatcher:
                                      jnp.int32(slot))
 
     # -- scheduling -------------------------------------------------------
+    def _sample_first(self, req: _Request, last_logits) -> int:
+        """Sample a freshly-admitted request's first token with ITS
+        sampling parameters."""
+        self.key, sub = jax.random.split(self.key)
+        return int(gen.sample_per_seq(
+            sub, last_logits[None],
+            jnp.full((1,), req.temperature, jnp.float32),
+            jnp.full((1,), req.top_k, jnp.int32),
+            jnp.full((1,), req.top_p, jnp.float32))[0])
+
+    def _occupy(self, slot: int, req: _Request, first_tok: int,
+                out: list) -> None:
+        """Install an admitted request into its slot and emit token 0."""
+        self.occupant[slot] = req
+        self.pos[slot] = len(req.prompt) - 1
+        self.slot_temp[slot] = req.temperature
+        self.slot_topk[slot] = req.top_k
+        self.slot_topp[slot] = req.top_p
+        self._emit(slot, first_tok, out)
+
     def _fill_free_slots(self) -> list[tuple[int, int]]:
-        """Prefill queued requests into free slots; returns (rid, first
-        sampled token) for each admitted request."""
+        """Unchunked admission: prefill queued requests into free slots in
+        one whole-bucket dispatch each; returns (rid, first token) pairs."""
         out = []
         for slot in range(self.slots):
             if self.occupant[slot] is not None or not self.queue:
@@ -283,20 +431,60 @@ class ContinuousBatcher:
             padded[0, :L] = req.prompt
             last_logits, slabs = self._prefill(bucket)(
                 self.params, jnp.asarray(padded), L)
+            self.stats["prefill_dispatches"] += 1
             self._insert(slabs, slot)
-            self.key, sub = jax.random.split(self.key)
-            tok = int(gen._sample(sub, last_logits[None],
-                                  self.temperature, self.top_k)[0])
-            self.occupant[slot] = req
-            self.pos[slot] = L - 1
-            self._emit(slot, tok, out)
+            self._occupy(slot, req, self._sample_first(req, last_logits),
+                         out)
+        return out
+
+    def _advance_admissions(self) -> list[tuple[int, int]]:
+        """Chunked admission: reserve free slots for queued requests, then
+        push ONE prompt chunk per admitting slot (each a short dispatch —
+        live slots decode between calls instead of waiting out a whole
+        prompt).  Finishing admissions install into their slot and emit
+        their first token."""
+        c = self.prefill_chunk
+        for slot in range(self.slots):
+            if (self.occupant[slot] is None and slot not in self.admitting
+                    and self.queue):
+                req = self.queue.popleft()
+                bucket = next(b for b in self.buckets
+                              if b >= len(req.prompt))
+                # scratch cache is created inside the first chunk's jit
+                self.admitting[slot] = _Admission(req, None, bucket)
+
+        out = []
+        for slot, adm in list(self.admitting.items()):
+            req, L = adm.req, len(adm.req.prompt)
+            chunk = np.zeros((1, c), np.int32)
+            take = min(c, L - adm.off)
+            chunk[0, :take] = req.prompt[adm.off:adm.off + take]
+            final = adm.off + c >= L
+            unembed_idx = jnp.int32((L - 1 - adm.off) if final else 0)
+            if adm.off == 0:
+                last_logits, adm.cache = self._prefill_chunk_fn(
+                    adm.bucket, first=True)(
+                    self.params, jnp.asarray(chunk), unembed_idx)
+            else:
+                last_logits, adm.cache = self._prefill_chunk_fn(
+                    adm.bucket, first=False)(
+                    self.params, adm.cache, jnp.asarray(chunk),
+                    jnp.int32(adm.off), unembed_idx)
+            self.stats["prefill_dispatches"] += 1
+            adm.off += c
+            if final:
+                self._insert(adm.cache, slot)
+                del self.admitting[slot]
+                self._occupy(slot, req,
+                             self._sample_first(req, last_logits), out)
         return out
 
     def _emit(self, slot: int, tok: int, out: list) -> None:
         req = self.occupant[slot]
         req.emitted.append(tok)
         out.append((req.rid, tok))
-        if ((self.eos_id is not None and tok == self.eos_id)
+        self.stats["emitted_tokens"] += 1
+        if ((req.eos_id is not None and tok == req.eos_id)
                 or len(req.emitted) >= req.max_new):
             req.done = True
             self.occupant[slot] = None  # slot free; stale K/V never read
@@ -304,15 +492,19 @@ class ContinuousBatcher:
             self.last_tok[slot] = tok
 
     def step(self) -> list[tuple[int, int]]:
-        """Admit queued work, then decode ``steps_per_sync`` tokens for
-        every active slot in one device dispatch.
+        """Admit queued work (whole-bucket, or one chunk per admission with
+        ``prefill_chunk``), then decode ``steps_per_sync`` tokens for every
+        active slot in one device dispatch.
 
         Returns (rid, token) pairs emitted this call, in per-slot sampling
         order (admissions emit their first sampled token here too).  A
         sequence finishing mid-block stops emitting there; its slot refills
         on the next call.
         """
-        out = self._fill_free_slots()
+        if self.prefill_chunk is None:
+            out = self._fill_free_slots()
+        else:
+            out = self._advance_admissions()
         live = [s for s in range(self.slots) if self.occupant[s] is not None]
         if not live:
             return out
@@ -322,15 +514,22 @@ class ContinuousBatcher:
         self.key, sub = jax.random.split(self.key)
         toks, self.cache = self._decode()(
             self.params, self.cache, jnp.asarray(self.last_tok),
-            jnp.asarray(pos), sub)
+            jnp.asarray(pos), jnp.asarray(self.slot_temp),
+            jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp), sub)
         toks = np.asarray(toks)  # (K, slots)
         k_steps = toks.shape[0]
+        self.stats["decode_dispatches"] += 1
+        self.stats["slot_steps"] += k_steps * self.slots
+        emitted_before = self.stats["emitted_tokens"]
         for s in live:
             self.pos[s] = min(int(pos[s]) + k_steps - 1, self.max_len - 1)
             for i in range(k_steps):
                 if self.occupant[s] is None:
                     break  # retired mid-block: discard the tail
                 self._emit(s, int(toks[i, s]), out)
+        self.stats["wasted_slot_steps"] += (
+            k_steps * self.slots
+            - (self.stats["emitted_tokens"] - emitted_before))
         return out
 
     def run(self, prompts, max_new: int = 128) -> dict[int, np.ndarray]:
