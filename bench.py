@@ -28,8 +28,42 @@ def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_tpu(batch_per_replica: int, warmup: int, iters: int) -> float:
-    """Samples/sec/chip of the compiled train step on real devices."""
+def vgg11_train_flops_per_sample() -> float:
+    """Analytic training FLOPs/sample for VGG-11 on 32x32 (reference
+    model.py:3-8 cfg): conv MACs = H*W*Cin*Cout*9 at each stage's
+    resolution, x2 FLOPs/MAC, x3 for fwd + input-grad + weight-grad
+    (the standard training estimate; BN/ReLU/pool are O(activations),
+    <1% of conv FLOPs, excluded — this slightly UNDERSTATES work, so the
+    MFU it yields is conservative)."""
+    cfg = [(32, 3, 64), (16, 64, 128), (8, 128, 256), (8, 256, 256),
+           (4, 256, 512), (4, 512, 512), (2, 512, 512), (2, 512, 512)]
+    macs = sum(h * h * cin * cout * 9 for h, cin, cout in cfg)
+    macs += 512 * 10  # fc head
+    return 2 * 3 * macs
+
+
+# bf16 peak TFLOP/s per chip by device kind (MXU systolic array).
+_PEAK_BF16_TFLOPS = {
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,   # v6e
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for name, tf in _PEAK_BF16_TFLOPS.items():
+        if kind.startswith(name):
+            return tf * 1e12
+    return None
+
+
+def bench_tpu(batch_per_replica: int, warmup: int,
+              iters: int) -> tuple[float, float | None]:
+    """(samples/sec/chip, MFU or None) of the compiled train step on real
+    devices; MFU is None when the device kind has no peak-FLOPs entry."""
     import jax
 
     from distributed_pytorch_tpu.parallel.mesh import make_mesh
@@ -76,7 +110,17 @@ def bench_tpu(batch_per_replica: int, warmup: int, iters: int) -> float:
     sps_total = global_batch * iters / dt
     _log(f"[bench] {iters} steps in {dt:.3f}s -> {sps_total:.1f} samples/s "
          f"total, {sps_total / n_dev:.1f}/chip, loss={final_loss:.3f}")
-    return sps_total / n_dev
+    sps_chip = sps_total / n_dev
+    # MFU: analytic model FLOPs vs the chip's bf16 peak — the regression-
+    # visible efficiency number (samples/s alone hides chip generation and
+    # session drift; MFU does not).
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (sps_chip * vgg11_train_flops_per_sample() / peak
+           if peak else None)
+    _log(f"[bench] {global_batch / n_dev / sps_chip * 1000:.3f} ms/step/chip"
+         + (f", MFU {mfu:.1%} of {peak / 1e12:.0f} TF bf16 peak" if mfu
+            else " (no peak table entry for this device)"))
+    return sps_chip, mfu
 
 
 # Reference-semantics torch-CPU throughput: fallback constant for when torch
@@ -147,7 +191,7 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "100"))
     iters = int(os.environ.get("BENCH_ITERS", "100"))
 
-    sps_chip = bench_tpu(batch, warmup, iters)
+    sps_chip, mfu = bench_tpu(batch, warmup, iters)
 
     if os.environ.get("BENCH_SKIP_TORCH"):
         baseline = FALLBACK_BASELINE_SPS
@@ -165,6 +209,7 @@ def main() -> None:
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / baseline, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }), flush=True)
 
 

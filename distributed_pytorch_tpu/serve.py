@@ -58,6 +58,11 @@ from .models import transformer as tfm
 from . import generate as gen
 
 
+# submit() sentinel: "inherit the batcher default" — distinct from None,
+# which explicitly DISABLES eos for that request
+_INHERIT = object()
+
+
 @dataclass
 class _Request:
     rid: int
@@ -188,7 +193,7 @@ class ContinuousBatcher:
         self.requests: dict[int, _Request] = {}
         self._next_rid = 0
         self._prefill_fns: dict[int, object] = {}
-        self._chunk_fns: dict[int, object] = {}
+        self._chunk_fns: dict[tuple[int, bool], object] = {}
         self._decode_fn = None
         self._insert_fn = None
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
@@ -202,10 +207,12 @@ class ContinuousBatcher:
                temperature: float | None = None,
                top_k: int | None = None,
                top_p: float | None = None,
-               eos_id: int | None = None) -> int:
+               eos_id=_INHERIT) -> int:
         """Queue a request.  Sampling parameters default to the batcher's;
         each request's settings apply to its slot only (the compiled decode
-        step samples every slot with its own temperature/top_k/top_p)."""
+        step samples every slot with its own temperature/top_k/top_p).
+        ``eos_id=None`` explicitly disables eos for this request even when
+        the batcher has a default (omit the argument to inherit)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -229,7 +236,7 @@ class ContinuousBatcher:
                          else temperature),
             top_k=0 if top_k is None else top_k,
             top_p=1.0 if top_p is None else top_p,  # 0.0 stays: -> greedy
-            eos_id=self.eos_id if eos_id is None else eos_id)
+            eos_id=self.eos_id if eos_id is _INHERIT else eos_id)
         self.requests[rid] = req
         self.queue.append(req)
         return rid

@@ -268,3 +268,20 @@ def test_tensor_parallel_chunked_prefill(params):
     for rid, p in enumerate(prompts):
         np.testing.assert_array_equal(results[rid],
                                       _greedy_oracle(params, p, 8))
+
+
+def test_eos_none_disables_inherited_default(params):
+    """submit(eos_id=None) opts OUT of the batcher's default eos; omitting
+    the argument inherits it."""
+    rng = np.random.default_rng(11)
+    p1 = rng.integers(0, 256, (8,)).astype(np.int32)
+    first = int(_greedy_oracle(params, p1, 1)[-1])
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, eos_id=first,
+                           prompt_buckets=(32,))
+    r_inherit = cb.submit(p1, max_new=5)
+    r_nostop = cb.submit(p1, max_new=5, eos_id=None)
+    while cb.pending():
+        cb.step()
+    assert len(cb.result(r_inherit)) == len(p1) + 1  # stopped at default eos
+    assert len(cb.result(r_nostop)) == len(p1) + 5   # eos disabled
