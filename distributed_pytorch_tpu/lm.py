@@ -1,8 +1,10 @@
-"""LM trainer: 3-D-parallel (data x sequence x tensor) language-model training.
+"""LM trainer: multi-axis (data x expert x sequence x tensor) LM training.
 
 The VGG trainer (train.py) reproduces the reference's DP-only world; this
 trainer is the framework's scale-out path for transformer LMs, composing the
-three parallelism axes over one ``Mesh(('data', 'seq', 'model'))``:
+parallelism axes over one ``Mesh(('data', 'expert', 'seq', 'model'))``
+(the 'expert' axis is size 1 unless ``ep > 1``; batches shard over
+``(data+expert, seq)``):
 
 - **data**: batch sharded; gradient sync is the automatic cotangent ``psum``
   shard_map inserts for axis-invariant params (the 'ddp' strategy fused into
@@ -40,7 +42,7 @@ from .parallel.mesh import make_mesh
 
 PyTree = Any
 
-DATA, SEQ, MODEL, PIPE = "data", "seq", "model", "pipe"
+DATA, SEQ, MODEL, PIPE, EXPERT = "data", "seq", "model", "pipe", "expert"
 IGNORE = IGNORE_INDEX  # target id excluded from the loss (padding)
 
 
@@ -59,11 +61,17 @@ class LMTrainConfig:
     aux_coef: float = 0.01  # MoE load-balance loss weight (Switch default)
     compute_dtype: str | None = "bfloat16"
     seed: int = 1
-    # parallel degrees; dp * sp * tp * pp must equal the mesh size
+    # parallel degrees; dp * ep * sp * tp * pp must equal the mesh size
     dp: int = 1
     sp: int = 1
     tp: int = 1
     pp: int = 1          # pipeline stages; composes with dp, sp, and tp
+    # Dedicated expert-parallel degree (EP x TP): MoE experts shard over
+    # their own 'expert' mesh axis (E/ep experts per rank, each expert's
+    # FFN tp-sharded) and the batch additionally splits over it for
+    # non-MoE layers (EP ranks own distinct tokens — no duplicated
+    # attention).  ep=1 keeps the round-2 experts-over-'model' layout.
+    ep: int = 1
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     # Virtual pipeline stages per device (Megatron interleaved placement):
     # the fill/drain bubble shrinks by this factor (parallel/pipeline.py
@@ -94,6 +102,16 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
         raise ValueError(
             "interleave (virtual pipeline stages) requires pp > 1; with "
             "pp=1 it would be silently ignored")
+    if cfg.ep > 1:
+        if cfg.pp > 1:
+            raise ValueError("the dedicated 'expert' axis does not compose "
+                             "with pp (experts shard over 'model' inside "
+                             "pipeline stages); use ep=1 with pp")
+        if not cfg.model.n_experts:
+            raise ValueError("ep > 1 requires an MoE model (n_experts > 0)")
+        if cfg.model.n_experts % cfg.ep:
+            raise ValueError(f"{cfg.model.n_experts} experts do not shard "
+                             f"over ep={cfg.ep}")
     if cfg.pp > 1:
         from .parallel.pipeline import _uniform_moe
         if cfg.model.n_experts and not _uniform_moe(cfg.model):
@@ -119,22 +137,28 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
                 f"n_kv_heads {cfg.model.kv_heads} must divide over "
                 f"tp={cfg.tp} (replicating kv heads across tensor ranks is "
                 f"not supported; lower tp or raise n_kv_heads)")
-    return make_mesh(cfg.dp * cfg.sp * cfg.tp,
-                     axis_names=(DATA, SEQ, MODEL),
-                     axis_shape=(cfg.dp, cfg.sp, cfg.tp),
+    # The 'expert' axis is always present (size ep, usually 1 — free):
+    # batch shards over (data, expert), expert weights over 'expert'.
+    return make_mesh(cfg.dp * cfg.ep * cfg.sp * cfg.tp,
+                     axis_names=(DATA, EXPERT, SEQ, MODEL),
+                     axis_shape=(cfg.dp, cfg.ep, cfg.sp, cfg.tp),
                      devices=devices)
 
 
 def param_specs(cfg: LMTrainConfig) -> PyTree:
     """Per-leaf PartitionSpecs for the transformer params.
 
-    Base: the Megatron tensor sharding (models/transformer.py shard_specs).
+    Base: the Megatron tensor sharding (models/transformer.py shard_specs),
+    with MoE experts on the dedicated 'expert' axis and their FFN width
+    tp-sharded (EP x TP; at ep=1 the expert axis is size 1, so experts are
+    simply replicated across tp with tp-sharded FFNs).
     With ``fsdp``, each leaf's first dp-divisible unsharded dim additionally
     shards over 'data' (ZeRO-3): parameters and optimizer state shrink by
     the dp degree per device; the train step all-gathers weights for use and
     autodiff's transpose reduce-scatters the gradients back.
     """
-    specs = tfm.shard_specs(cfg.model, tp_axis=MODEL)
+    specs = tfm.shard_specs(cfg.model, tp_axis=MODEL,
+                            ep_axis=EXPERT if cfg.ep > 1 else None)
     if not cfg.fsdp or cfg.dp == 1:
         return specs
     shapes = jax.eval_shape(lambda k: tfm.init(k, cfg.model),
@@ -245,19 +269,21 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                                 seq_axis=seq_axis, seq_layout=cfg.seq_layout,
                                 tp_axis=tp_axis, pos=pos,
+                                ep_axis=EXPERT if cfg.ep > 1 else None,
                                 return_aux=True)
         ce_sum, n = masked_ce(logits, targets)
-        # Global mean over every shard's tokens (loss is axis-invariant;
-        # 'model' shards compute identical values, no reduction needed there).
-        ce_sum = jax.lax.psum(ce_sum, (DATA, SEQ))
-        n = jax.lax.psum(n, (DATA, SEQ))
-        aux = jax.lax.pmean(aux, (DATA, SEQ))  # already pmean'd over MODEL
+        # Global mean over every shard's tokens; the batch shards over
+        # (data, expert), so 'expert' reduces like a data axis ('model'
+        # shards compute identical values, no reduction needed there).
+        ce_sum = jax.lax.psum(ce_sum, (DATA, EXPERT, SEQ))
+        n = jax.lax.psum(n, (DATA, EXPERT, SEQ))
+        aux = jax.lax.pmean(aux, (DATA, EXPERT, SEQ))  # pmean'd over MODEL
         return ce_sum / jnp.maximum(n, 1) + cfg.aux_coef * aux
 
     grad_step = shard_map(
         jax.value_and_grad(local_loss),
         mesh=mesh,
-        in_specs=(specs, P(DATA, SEQ), P(DATA, SEQ)),
+        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ)),
         out_specs=(P(), specs),
         # check_vma stays ON: the automatic psum of cotangents for
         # axis-invariant params (the fused DP/SP gradient sync) depends on it.
@@ -355,14 +381,15 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
         pos = _shard_positions(cfg, tokens.shape[1])
         logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                            seq_axis=SEQ if cfg.sp > 1 else None,
-                           seq_layout=cfg.seq_layout, tp_axis=MODEL, pos=pos)
+                           seq_layout=cfg.seq_layout, tp_axis=MODEL,
+                           ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos)
         ce, n = masked_ce(logits, targets)
-        return (jax.lax.psum(ce, (DATA, SEQ)),
-                jax.lax.psum(n, (DATA, SEQ)))
+        return (jax.lax.psum(ce, (DATA, EXPERT, SEQ)),
+                jax.lax.psum(n, (DATA, EXPERT, SEQ)))
 
     sharded_eval = shard_map(
         local_eval, mesh=mesh,
-        in_specs=(specs, P(DATA, SEQ), P(DATA, SEQ)),
+        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ)),
         out_specs=(P(), P()))
 
     @jax.jit
@@ -434,9 +461,13 @@ class LMTrainer:
     def __init__(self, cfg: LMTrainConfig, mesh: Mesh | None = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_lm_mesh(cfg)
-        want = cfg.dp * cfg.sp * cfg.tp * cfg.pp
+        want = cfg.dp * cfg.ep * cfg.sp * cfg.tp * cfg.pp
         assert self.mesh.devices.size == want, (
             f"mesh has {self.mesh.devices.size} devices, config wants {want}")
+        # batch sharding: (data, expert) jointly split the batch on the
+        # non-pp mesh; the pp mesh has no expert axis (ep=1 enforced)
+        self._batch_spec = (P(DATA, SEQ) if cfg.pp > 1
+                            else P((DATA, EXPERT), SEQ))
 
         if cfg.fsdp and cfg.pp > 1:
             raise ValueError("fsdp composes with the (data, seq, model) "
@@ -492,7 +523,7 @@ class LMTrainer:
             self._eval_fn = (make_lm_pp_eval_step(self.cfg, self.mesh)
                              if self.cfg.pp > 1
                              else make_lm_eval_step(self.cfg, self.mesh))
-        shd = NamedSharding(self.mesh, P(DATA, SEQ))
+        shd = NamedSharding(self.mesh, self._batch_spec)
         total, count = 0.0, 0
         for tokens, targets in batches:
             if jax.process_count() > 1:
@@ -571,7 +602,7 @@ class LMTrainer:
         return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
-        shd = NamedSharding(self.mesh, P(DATA, SEQ))
+        shd = NamedSharding(self.mesh, self._batch_spec)
         if jax.process_count() > 1:
             tokens = jax.make_array_from_process_local_data(shd, tokens)
             targets = jax.make_array_from_process_local_data(shd, targets)

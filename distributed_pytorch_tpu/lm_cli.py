@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1,
+                   help="dedicated expert-parallel degree (EP x TP): MoE "
+                        "experts shard over their own 'expert' mesh axis")
     p.add_argument("--interleave", type=int, default=1,
                    help="virtual pipeline stages per device (shrinks the "
                         "pipeline bubble by this factor)")
@@ -151,11 +154,11 @@ def main(argv: list[str] | None = None) -> int:
         compute_dtype=(None if args.compute_dtype == "float32"
                        else args.compute_dtype),
         warmup_steps=args.warmup_steps, decay_steps=args.decay_steps,
-        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
+        dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, ep=args.ep,
         interleave=args.interleave, fsdp=args.fsdp)
     trainer = LMTrainer(cfg)
-    log.info("model: %s | mesh: dp=%d sp=%d tp=%d pp=%d over %d devices",
-             cfg.model, args.dp, args.sp, args.tp, args.pp,
+    log.info("model: %s | mesh: dp=%d ep=%d sp=%d tp=%d pp=%d over %d devices",
+             cfg.model, args.dp, args.ep, args.sp, args.tp, args.pp,
              trainer.mesh.devices.size)
 
     start = 0
