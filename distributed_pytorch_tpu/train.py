@@ -346,14 +346,20 @@ class Trainer:
         self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
         # vma-opaque strategies (ppermute-assembled results) compile with
-        # check_vma=False — the static replication proof is off, so the
-        # first real step is followed by a one-time DYNAMIC verification
-        # that params/opt-state are still bitwise replicated (the failure
-        # mode the static checker would have caught is a missing/broken
-        # collective, which desyncs immediately, not gradually).
-        self._verify_replication = bool(
+        # check_vma=False — the static replication proof is off, so EVERY
+        # freshly compiled executable (first step, and any later
+        # shape-specialized recompile) has its first real step followed by
+        # a DYNAMIC verification that params/opt-state are still bitwise
+        # replicated (the failure mode the static checker would have
+        # caught is a missing/broken collective, which desyncs
+        # immediately, not gradually).  Tracked PER EXECUTABLE (shape
+        # key): _executable arms the key on cache miss, train_steps
+        # verifies after the first run of each armed key — so interleaved
+        # precompiles/shapes each get their own check.
+        self._vma_opaque = bool(
             getattr(self.strategy, "vma_opaque", False)
             and self.mesh is not None)
+        self._unverified_exes: set = set()
 
     # -- one optimizer step over a *global* batch -------------------------
     def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
@@ -402,6 +408,10 @@ class Trainer:
                                                  self.mesh)
             exe = self._multi_fn.lower(*args).compile()
             self._compiled[key] = exe
+            if self._vma_opaque:
+                # new executable, no static vma proof: re-verify
+                # replication after ITS first real step (see __init__)
+                self._unverified_exes.add(key)
         return exe
 
     def _args(self, images, labels):
@@ -423,11 +433,12 @@ class Trainer:
         k = images.shape[0]
         images, labels = self._stage(images, labels)
         args = self._args(images, labels)
+        key = (args[-2].shape, args[-1].shape)
         (self.params, self.state, self.opt_state, self.sync_state,
          losses) = self._executable(args)(*args)
         self._step += k
-        if self._verify_replication:
-            self._verify_replication = False
+        if key in self._unverified_exes:
+            self._unverified_exes.discard(key)
             self.check_consistency()
         return losses
 

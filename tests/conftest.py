@@ -30,3 +30,11 @@ jax.config.update("jax_threefry_partitionable", True)
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__), ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: sub-2-minute warm tier (data/model/debug/native/attention/"
+        "bench) — `pytest -m quick` for a fast sanity pass; the full suite "
+        "remains the CI gate")

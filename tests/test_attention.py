@@ -15,6 +15,8 @@ from distributed_pytorch_tpu.ops import attention as attn
 B, H, S, D = 2, 2, 256, 64
 
 
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
+
 def _qkv(dtype=jnp.float32, s=S):
     key = jax.random.key(0)
     return tuple(

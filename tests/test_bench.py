@@ -8,6 +8,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench
 
+import pytest
+
+
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
 
 def test_vgg11_flops_per_sample_matches_hand_count():
     """2 FLOPs/MAC x 3 passes x (conv MACs + fc): the 0.92 GFLOP/sample

@@ -319,3 +319,102 @@ def test_save_restore_hierarchical_factored_mesh(tmp_path):
     la = float(t1.train_step(images, labels))
     lb = float(t2.train_step(images, labels))
     np.testing.assert_allclose(lb, la, rtol=1e-6)
+
+
+class TestIncrementalCheckpointer:
+    """Content-hashed incremental checkpoints (VERDICT round-2 #10)."""
+
+    def _trees(self, scale=1.0):
+        rng = np.random.default_rng(0)
+        return {"params": {
+            "frozen_backbone": rng.standard_normal((256, 256)).astype(
+                np.float32),
+            "embed": (scale * rng.standard_normal((64, 32))).astype(
+                np.float32),
+            "head": {"w": (scale * rng.standard_normal((32, 8))).astype(
+                np.float32)},
+        }}
+
+    def test_roundtrip_and_delta_reuse(self, tmp_path):
+        from distributed_pytorch_tpu.utils.checkpoint import (
+            IncrementalCheckpointer)
+        import os
+
+        ck = IncrementalCheckpointer(str(tmp_path))
+        t1 = self._trees()
+        ck.save(t1, 1, meta={"note": "first"})
+        # second save: only embed/head changed — backbone not rewritten
+        t2 = self._trees()
+        t2["params"]["embed"] = t2["params"]["embed"] + 1.0
+        t2["params"]["head"]["w"] = t2["params"]["head"]["w"] * 2.0
+        ck.save(t2, 2)
+
+        with np.load(str(tmp_path / "inc_2.npz")) as z:
+            keys2 = set(z.files)
+        assert not any("frozen_backbone" in k for k in keys2), keys2
+        assert any("embed" in k for k in keys2)
+
+        got, meta = ck.restore(self._trees())
+        assert meta["step"] == 2
+        np.testing.assert_array_equal(got["params"]["embed"],
+                                      t2["params"]["embed"])
+        np.testing.assert_array_equal(got["params"]["frozen_backbone"],
+                                      t1["params"]["frozen_backbone"])
+        np.testing.assert_array_equal(got["params"]["head"]["w"],
+                                      t2["params"]["head"]["w"])
+
+        # the frozen leaf's bytes exist exactly once on disk
+        sizes = {f: os.path.getsize(tmp_path / f)
+                 for f in os.listdir(tmp_path) if f.endswith(".npz")}
+        assert sizes["inc_2.npz"] < sizes["inc_1.npz"] / 10, sizes
+
+    def test_gc_keeps_referenced_deltas(self, tmp_path):
+        from distributed_pytorch_tpu.utils.checkpoint import (
+            IncrementalCheckpointer)
+
+        ck = IncrementalCheckpointer(str(tmp_path), keep=2)
+        t = self._trees()
+        ck.save(t, 1)
+        for step in (2, 3, 4, 5):
+            t["params"]["embed"] = t["params"]["embed"] + 1.0
+            ck.save(t, step)
+        names = set(f.name for f in tmp_path.iterdir())
+        # manifests pruned to the last 2
+        assert {"manifest_4.json", "manifest_5.json"} <= names
+        assert "manifest_3.json" not in names
+        # inc_1 still holds the backbone referenced by manifests 4 and 5
+        assert "inc_1.npz" in names
+        # old unreferenced deltas are gone
+        assert "inc_2.npz" not in names and "inc_3.npz" not in names
+
+        got, meta = ck.restore(self._trees())
+        assert meta["step"] == 5
+        np.testing.assert_array_equal(got["params"]["embed"],
+                                      t["params"]["embed"])
+
+    def test_fresh_process_resumes_hash_state(self, tmp_path):
+        """A new checkpointer over an existing directory picks up the last
+        manifest's hashes — the next save stays incremental."""
+        from distributed_pytorch_tpu.utils.checkpoint import (
+            IncrementalCheckpointer)
+
+        t = self._trees()
+        IncrementalCheckpointer(str(tmp_path)).save(t, 1)
+        ck2 = IncrementalCheckpointer(str(tmp_path))
+        t["params"]["embed"] = t["params"]["embed"] + 1.0
+        ck2.save(t, 2)
+        with np.load(str(tmp_path / "inc_2.npz")) as z:
+            assert not any("frozen_backbone" in k for k in z.files)
+        got, _ = ck2.restore(self._trees())
+        np.testing.assert_array_equal(got["params"]["embed"],
+                                      t["params"]["embed"])
+
+    def test_async_write_publishes(self, tmp_path):
+        from distributed_pytorch_tpu.utils.checkpoint import (
+            IncrementalCheckpointer)
+
+        ck = IncrementalCheckpointer(str(tmp_path), async_write=True)
+        ck.save(self._trees(), 1)
+        ck.wait()
+        assert (tmp_path / "manifest_1.json").exists()
+        assert ck.restore(self._trees()) is not None

@@ -15,6 +15,8 @@ from distributed_pytorch_tpu.data import (
 )
 
 
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
+
 def _ds(n=100, seed=0):
     rng = np.random.default_rng(seed)
     return Dataset(

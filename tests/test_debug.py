@@ -18,6 +18,8 @@ from distributed_pytorch_tpu.utils import debug as dbg
 from distributed_pytorch_tpu.utils.tracing import StepTimer, trace
 
 
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
+
 def _replicated(mesh, value: np.ndarray) -> jax.Array:
     return jax.device_put(value, NamedSharding(mesh, P()))
 

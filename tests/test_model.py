@@ -14,6 +14,8 @@ from distributed_pytorch_tpu.models import vgg
 from distributed_pytorch_tpu.ops import nn as ops
 
 
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
+
 def test_vgg11_param_inventory():
     params, state = vgg.init(jax.random.key(1), "VGG11")
     # 8 convs (w+b) + 8 BNs (scale+bias) + fc (w+b) = 34 tensors.

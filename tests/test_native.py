@@ -6,6 +6,8 @@ import pytest
 from distributed_pytorch_tpu.native import build, loader
 
 
+pytestmark = pytest.mark.quick  # sub-2-min tier (tests/conftest.py)
+
 @pytest.fixture(scope="module")
 def lib():
     if build.build() is None:

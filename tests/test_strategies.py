@@ -620,3 +620,70 @@ class TestQuantizedRingEF:
                 assert float(np.abs(np.asarray(tr.sync_state)).max()) > 0
         np.testing.assert_allclose(losses["quantized_ring_ef"],
                                    losses["ddp"], rtol=1e-2, atol=1e-2)
+
+
+class TestVmaRecompileVerification:
+    """check_vma=False strategies re-verify replication after EVERY fresh
+    compile, not just the first step (VERDICT round-2 #7): a collective
+    broken by a later shape-specialized recompile must be caught."""
+
+    def test_broken_collective_after_shape_change_is_caught(self, mesh,
+                                                            monkeypatch):
+        rng = np.random.default_rng(0)
+
+        def batch(n):
+            return (rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8),
+                    rng.integers(0, 10, n).astype(np.int32))
+
+        tr = Trainer(_cfg("gather_scatter"), mesh)
+        tr.train_step(*batch(16))   # first shape: verified, passes
+
+        # Sabotage the strategy CLASS (dunder lookup is on the type): the
+        # NEXT trace — triggered by a new batch shape — compiles a program
+        # with NO gradient sync, so replicas desync on their shards.
+        monkeypatch.setattr(strat.GatherScatter, "__call__",
+                            lambda self, grads, axis: grads)
+        with pytest.raises(AssertionError, match="replica|sync|differs"):
+            tr.train_step(*batch(32))  # new shape -> recompile -> caught
+
+    def test_same_shape_does_not_retrigger(self, mesh):
+        """Cached executables skip re-verification (the proof already ran
+        for this program); only fresh compiles arm the check."""
+        tr = Trainer(_cfg("gather_scatter"), mesh)
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+        labels = rng.integers(0, 10, 16).astype(np.int32)
+        tr.train_step(images, labels)
+        assert not tr._unverified_exes
+        # sabotage now: same shape reuses the verified executable, so no
+        # new (broken) program is ever built and training proceeds
+        tr.strategy.__call__ = lambda grads, axis: grads
+        tr.train_step(images, labels)
+        assert not tr._unverified_exes
+
+
+    def test_interleaved_precompiles_each_get_verified(self, mesh,
+                                                       monkeypatch):
+        """Two shapes precompiled back-to-back: EACH executable is
+        verified after its own first step — a boolean flag would verify
+        only the first and let the second's broken program through."""
+        rng = np.random.default_rng(0)
+
+        def batch(n):
+            return (rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8),
+                    rng.integers(0, 10, n).astype(np.int32))
+
+        tr = Trainer(_cfg("gather_scatter"), mesh)
+        tr.train_step(*batch(16))  # shape A compiled + verified
+
+        # break the strategy, then precompile BOTH a broken new shape and
+        # re-request the old one before stepping
+        monkeypatch.setattr(strat.GatherScatter, "__call__",
+                            lambda self, grads, axis: grads)
+        ia, la = batch(16)
+        ib, lb = batch(32)
+        tr.precompile_steps(ib[None], lb[None])   # shape B: broken program
+        assert len(tr._unverified_exes) == 1
+        tr.train_step(ia, la)   # shape A: cached verified exe, no check
+        with pytest.raises(AssertionError, match="replica|sync|differs"):
+            tr.train_step(ib, lb)  # shape B's first run: caught
