@@ -194,7 +194,7 @@ class ContinuousBatcher:
         self._next_rid = 0
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[tuple[int, bool], object] = {}
-        self._decode_fn = None
+        self._decode_fns: dict[int, object] = {}
         self._insert_fn = None
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
         # vs tokens actually delivered — the block-granularity waste
@@ -288,17 +288,25 @@ class ContinuousBatcher:
             self._prefill_fns[bucket] = fn
         return fn
 
-    def _decode(self):
+    def _decode(self, k_steps: int | None = None):
         """(params, cache, tokens (slots,), pos (slots,), temp, top_k,
         top_p, key) -> ((K, slots) sampled tokens, cache) — ONE program
-        decodes ``steps_per_sync`` tokens for the whole pool per dispatch
-        (each step's sample feeds the next; host syncs once per block).
+        decodes ``k_steps`` tokens for the whole pool per dispatch (each
+        step's sample feeds the next; host syncs once per block).
         Sampling parameters are per-slot vectors (gen.sample_per_seq), so
-        requests with different settings share the dispatch."""
-        if self._decode_fn is None:
+        requests with different settings share the dispatch.
+
+        ``k_steps`` defaults to ``steps_per_sync``; the scheduler passes a
+        smaller power-of-two near the end of all budgets (adaptive block:
+        a request with 3 tokens left should not burn a 32-step dispatch).
+        One compiled program per distinct k, built lazily."""
+        if k_steps is None:
+            k_steps = self.steps_per_sync
+        fn = self._decode_fns.get(k_steps)
+        if fn is None:
             cfg, dtype = self.cfg, self.dtype
             use_kernel = self.use_kernel
-            k_steps, max_len = self.steps_per_sync, self.max_len
+            max_len = self.max_len
 
             tp = self.tp_axis if self.mesh is not None else None
 
@@ -324,17 +332,18 @@ class ContinuousBatcher:
                 return toks, cache
 
             if self.mesh is None:
-                self._decode_fn = jax.jit(block_body, donate_argnums=(1,))
+                fn = jax.jit(block_body, donate_argnums=(1,))
             else:
                 from jax import shard_map
                 from jax.sharding import PartitionSpec as P
-                self._decode_fn = jax.jit(shard_map(
+                fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P(), P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
                     donate_argnums=(1,))
-        return self._decode_fn
+            self._decode_fns[k_steps] = fn
+        return fn
 
     def _prefill_chunk_fn(self, bucket: int, first: bool):
         """One prompt chunk written at cache offset ``off``, attending
@@ -515,11 +524,23 @@ class ContinuousBatcher:
         live = [s for s in range(self.slots) if self.occupant[s] is not None]
         if not live:
             return out
+        # Adaptive block: when every live request's remaining BUDGET is
+        # below steps_per_sync and no queued work will refill the slots,
+        # clamp the dispatch to the next power of two that covers the
+        # longest remaining budget — a request with 3 tokens left should
+        # not burn a 32-step dispatch (eos stops stay unpredictable and
+        # waste at block granularity, as documented).
+        k = self.steps_per_sync
+        if not self.queue and not self.admitting:
+            rem = max(self.occupant[s].max_new - len(self.occupant[s].emitted)
+                      for s in live)
+            if rem < k:
+                k = min(k, 1 << (rem - 1).bit_length())
         # advance every live slot's write position to the new token's slot
         pos = self.pos.copy()
         pos[live] = np.minimum(pos[live] + 1, self.max_len - 1)
         self.key, sub = jax.random.split(self.key)
-        toks, self.cache = self._decode()(
+        toks, self.cache = self._decode(k)(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(pos), jnp.asarray(self.slot_temp),
             jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp), sub)

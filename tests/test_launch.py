@@ -325,3 +325,28 @@ def test_two_process_lm_training(tmp_path):
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert proc.stdout.count("OK") == 2, proc.stdout
     assert any(p.name.startswith("ckpt_") for p in ckpt_dir.iterdir())
+
+
+def test_two_process_hierarchical_training():
+    """Hierarchical (dcn x ici) gradient sync across a REAL process
+    boundary: 2 processes x 2 fake devices build Mesh(('dcn','ici')) =
+    (2, 2) where the 'dcn' axis lands exactly on the process boundary —
+    the multislice topology (ici within a host, dcn across) the strategy
+    exists for.  Cross-process shard-sized psum + consistency checks."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+         "--nproc-per-node", "2", "--master-port", "16761", "--",
+         "tests/workers/ddp_worker.py"],
+        cwd="/root/repo", capture_output=True, text=True, timeout=420,
+        env=dict(
+            {k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS",)},
+            PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""),
+            TEST_MODEL="TINY",
+            TEST_STRATEGY="hierarchical",
+        ),
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert proc.stdout.count("OK") == 2, proc.stdout

@@ -285,3 +285,21 @@ def test_eos_none_disables_inherited_default(params):
         cb.step()
     assert len(cb.result(r_inherit)) == len(p1) + 1  # stopped at default eos
     assert len(cb.result(r_nostop)) == len(p1) + 5   # eos disabled
+
+
+def test_adaptive_tail_block_cuts_waste(params):
+    """When every remaining budget is small and the queue is empty, the
+    dispatch clamps to a covering power of two instead of burning a full
+    steps_per_sync block — tail waste drops, tokens stay oracle-exact."""
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, 256, (9,)).astype(np.int32)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32,),
+                           steps_per_sync=32)
+    r = cb.submit(p, max_new=5)
+    while cb.pending():
+        cb.step()
+    np.testing.assert_array_equal(cb.result(r), _greedy_oracle(params, p, 5))
+    # 5 tokens: 1 at admission + one 4-step dispatch covers the rest.
+    # Without the clamp this costs 32 steps x 2 slots = 64 slot-steps.
+    assert cb.stats["slot_steps"] <= 8, cb.stats

@@ -14,6 +14,7 @@ import sys
 
 _DEV_PER_PROC = int(os.environ.get("TEST_DEVICES_PER_PROC", "2"))
 _MODEL = os.environ.get("TEST_MODEL", "VGG11")
+_STRATEGY = os.environ.get("TEST_STRATEGY", "ddp")
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
@@ -42,10 +43,16 @@ def main() -> int:
     want_dev = world * _DEV_PER_PROC
     assert n_dev == want_dev, f"expected {want_dev} global devices, {n_dev}"
 
-    mesh = make_mesh()
-    trainer = Trainer(TrainConfig(model=_MODEL, strategy="ddp",
-                                  batch_size=4, lr=1e-3),
-                      mesh=mesh)
+    cfg = TrainConfig(model=_MODEL, strategy=_STRATEGY, batch_size=4,
+                      lr=1e-3, dcn_size=2)
+    # factored-axis strategies (hierarchical) build their own
+    # Mesh(('dcn','ici')) — with 2 fake devices per process, the 'dcn'
+    # axis lands exactly on the process boundary (the real multislice
+    # topology: ici within a host, dcn across)
+    factored = _STRATEGY == "hierarchical"
+    trainer = Trainer(cfg, mesh=None if factored else make_mesh())
+    if factored:
+        assert trainer.mesh.axis_names == ("dcn", "ici")
     # per-host share of the global batch: local devices * per-replica batch
     rng = np.random.default_rng(rank)
     local = _DEV_PER_PROC * 4
