@@ -263,14 +263,32 @@ def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
     return logits[:, 0], cache
 
 
-def _sample(key, logits, temperature: float, top_k: int | None):
+def _sample(key, logits, temperature: float, top_k: int | None,
+            top_p: float | None = None):
+    """Static-parameter sampling; filter semantics IDENTICAL to
+    ``sample_per_seq`` (the serving path): both thresholds come from ONE
+    descending sort of the temperature-scaled distribution — top-p is the
+    smallest prefix with mass >= p computed on the FULL distribution (not
+    the top-k-renormalized one), and the masks intersect."""
     if temperature == 0.0:
         return jnp.argmax(logits, -1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None:
-        kth = jnp.sort(logits, -1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, NEG_INF, logits)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    scaled = logits / temperature
+    want_p = top_p is not None and top_p < 1.0
+    if top_k is not None or want_p:
+        sorted_desc = jnp.sort(scaled, -1)[:, ::-1]
+        masked = scaled
+        if top_k is not None:
+            kth = sorted_desc[:, top_k - 1][:, None]
+            masked = jnp.where(scaled < kth, NEG_INF, masked)
+        if want_p:
+            probs = jax.nn.softmax(sorted_desc, -1)
+            exclusive_cum = jnp.cumsum(probs, -1) - probs
+            nkeep = jnp.sum(exclusive_cum < top_p, -1)
+            pidx = jnp.clip(nkeep - 1, 0, scaled.shape[-1] - 1)
+            pth = jnp.take_along_axis(sorted_desc, pidx[:, None], axis=1)
+            masked = jnp.where(scaled < pth, NEG_INF, masked)
+        scaled = masked
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
 
 
 def sample_per_seq(key, logits, temperature, top_k, top_p):
@@ -312,6 +330,7 @@ def _generate_impl(
     max_new: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     dtype=None,
     eos_id: int | None = None,
     decode_segments: int = 8,
@@ -362,7 +381,8 @@ def _generate_impl(
         def sample_step(carry, t, step=step):
             cache, logits, key, done = carry
             key, sub = jax.random.split(key)
-            tok = _sample(sub, logits, temperature, top_k)
+            tok = _sample(sub, logits, temperature, top_k,
+                          top_p)
             if eos_id is not None:
                 # Sequences past their EOS emit eos_id forever (SPMD
                 # lockstep: compute still runs, the token is overridden).
@@ -379,8 +399,8 @@ def _generate_impl(
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k",
-                                   "dtype", "eos_id", "decode_segments",
-                                   "decode_kernel"))
+                                   "top_p", "dtype", "eos_id",
+                                   "decode_segments", "decode_kernel"))
 def generate(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
@@ -390,6 +410,7 @@ def generate(
     max_new: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     dtype=None,
     eos_id: int | None = None,
     decode_segments: int = 8,
@@ -408,8 +429,9 @@ def generate(
     # config, not per call.
     _warn_if_expert_choice(cfg)
     return _generate_impl(params, prompt, key, cfg=cfg, max_new=max_new,
-                          temperature=temperature, top_k=top_k, dtype=dtype,
-                          eos_id=eos_id, decode_segments=decode_segments,
+                          temperature=temperature, top_k=top_k, top_p=top_p,
+                          dtype=dtype, eos_id=eos_id,
+                          decode_segments=decode_segments,
                           decode_kernel=decode_kernel)
 
 
@@ -427,6 +449,7 @@ def generate_tp(
     max_new: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     dtype=None,
     eos_id: int | None = None,
     decode_segments: int = 8,
@@ -464,7 +487,7 @@ def generate_tp(
     if specs is None:
         specs = tfm.shard_specs(cfg, tp_axis=axis)
     spec_leaves, spec_def = jax.tree.flatten(specs)
-    cache_key = (cfg, mesh, axis, max_new, temperature, top_k,
+    cache_key = (cfg, mesh, axis, max_new, temperature, top_k, top_p,
                  jnp.dtype(dtype).name if dtype is not None else None,
                  eos_id, decode_segments, decode_kernel,
                  tuple(spec_leaves), spec_def)
@@ -482,7 +505,8 @@ def generate_tp(
             params = jax.tree.map(gather, params, specs)
             out = _generate_impl(params, prompt, key, cfg=cfg,
                                  max_new=max_new, temperature=temperature,
-                                 top_k=top_k, dtype=dtype, eos_id=eos_id,
+                                 top_k=top_k, top_p=top_p, dtype=dtype,
+                                 eos_id=eos_id,
                                  decode_segments=decode_segments,
                                  decode_kernel=decode_kernel,
                                  tp_axis=axis)

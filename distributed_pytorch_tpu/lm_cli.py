@@ -114,6 +114,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sample text from the trained model")
     p.add_argument("--max-new", type=int, default=128)
     p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=None,
+                   help="sample from the k most likely tokens only")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: smallest token set with "
+                        "cumulative probability >= p")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace (TensorBoard-loadable) "
                         "covering steps 2-11 (step 1 excluded: compile)")
@@ -293,8 +298,8 @@ def main(argv: list[str] | None = None) -> int:
                     trainer.params, prompt.astype(np.int32),
                     jax.random.key(args.seed), cfg=cfg.model,
                     mesh=trainer.mesh, max_new=args.max_new,
-                    temperature=args.temperature,
-                    dtype=cfg.dtype,
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p, dtype=cfg.dtype,
                     specs=param_specs(cfg) if cfg.fsdp else None)
             else:
                 from .utils.checkpoint import _fetch
@@ -304,7 +309,8 @@ def main(argv: list[str] | None = None) -> int:
                     params,
                     prompt.astype(np.int32), jax.random.key(args.seed),
                     cfg=cfg.model, max_new=args.max_new,
-                    temperature=args.temperature, dtype=cfg.dtype)
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p, dtype=cfg.dtype)
             text = lm_corpus.decode(np.asarray(out[0]))
             print(text)
 

@@ -341,3 +341,23 @@ def test_decode_step_rejects_k_len_with_kernel():
     with pytest.raises(ValueError, match="k_len is ignored"):
         gen.decode_step(params, cache, tok, jnp.int32(0), cfg=cfg,
                         k_len=8, use_decode_kernel=True)
+
+
+def test_generate_top_p_degenerates_to_greedy():
+    """top_p -> 0 keeps only the top token: generate() must match greedy
+    regardless of temperature (API symmetry with the serving path)."""
+    cfg = tfm.TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
+                                n_heads=2, head_dim=32, d_ff=128)
+    params = tfm.init(jax.random.key(0), cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 6)), jnp.int32)
+    greedy = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
+                          max_new=8, temperature=0.0)
+    nucleus = gen.generate(params, prompt, jax.random.key(2), cfg=cfg,
+                           max_new=8, temperature=1.3, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+    # and a plain nucleus run emits valid tokens of the right shape
+    out = gen.generate(params, prompt, jax.random.key(3), cfg=cfg,
+                       max_new=8, temperature=1.0, top_p=0.9)
+    assert out.shape == (2, 14)
+    assert int(jnp.max(out)) < 128

@@ -303,3 +303,19 @@ def test_adaptive_tail_block_cuts_waste(params):
     # 5 tokens: 1 at admission + one 4-step dispatch covers the rest.
     # Without the clamp this costs 32 steps x 2 slots = 64 slot-steps.
     assert cb.stats["slot_steps"] <= 8, cb.stats
+
+
+def test_scalar_and_per_seq_samplers_agree_on_combined_filters(params):
+    """top_k AND top_p combined: _sample (generate path) and
+    sample_per_seq (serving path) must keep the SAME token set — both
+    thresholds from one sort of the full scaled distribution."""
+    rng = np.random.default_rng(13)
+    logits = jnp.asarray(rng.standard_normal((4, 256)).astype(np.float32))
+    key = jax.random.key(3)
+    for temp, k, p in ((0.8, 50, 0.9), (1.3, 5, 0.5), (1.0, 200, 0.99)):
+        want = gen._sample(key, logits, temp, k, p)
+        got = gen.sample_per_seq(
+            key, logits, jnp.full((4,), temp, jnp.float32),
+            jnp.full((4,), k, jnp.int32), jnp.full((4,), p, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
+                                      err_msg=f"{temp},{k},{p}")
