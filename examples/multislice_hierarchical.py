@@ -1,0 +1,49 @@
+"""Multi-slice hierarchical gradient sync demo (round 3).
+
+Simulates a 2-slice x 4-chip topology on a virtual CPU mesh: the data
+axis factors into Mesh(('dcn', 'ici')) and the `hierarchical` strategy
+runs the two-level reduction — reduce-scatter within each slice over the
+fast link, a SHARD-SIZED psum across slices over the slow one,
+all-gather back.  The trajectory is bit-comparable to flat `ddp` (both
+compute the exact mean); the wire difference is what matters at pod
+scale: cross-slice traffic drops by the within-slice degree.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu PYTHONPATH=. \
+      python examples/multislice_hierarchical.py
+
+Note for the LM trainer: lm.py needs no explicit hierarchical strategy —
+its DP gradient sync is the automatic cotangent psum over 'data', which
+XLA's collective scheduler already lowers hierarchically on real
+multislice meshes (ICI reduce + DCN exchange).  The explicit strategy
+exists where the reference's pedagogy lives: the VGG trainer's pluggable
+sync-strategy axis, with the algorithm visible and pinned by tests.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+rng = np.random.default_rng(0)
+images = rng.integers(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+labels = rng.integers(0, 10, 16).astype(np.int32)
+
+hier = Trainer(TrainConfig(strategy="hierarchical", batch_size=2,
+                           dcn_size=2, augment=False, lr=0.01))
+print(f"mesh: {hier.mesh.axis_names} {hier.mesh.devices.shape} "
+      f"(2 slices x {hier.mesh.devices.shape[1]} chips)")
+ddp = Trainer(TrainConfig(strategy="ddp", batch_size=2, augment=False, lr=0.01),
+              make_mesh(8))
+
+for step in range(4):
+    lh = float(hier.train_step(images, labels))
+    ld = float(ddp.train_step(images, labels))
+    print(f"step {step}: hierarchical loss {lh:.6f} | flat ddp {ld:.6f} "
+          f"| delta {abs(lh - ld):.2e}")
+hier.check_consistency()
+print("replica consistency OK; cross-slice bytes/step: |grads|/ici "
+      "vs |grads| for flat ddp (see BASELINE.md)")
