@@ -29,9 +29,13 @@ TPU-first design constraints drive the shape:
   tokens per dispatch as one ``lax.scan`` and the host processes the K x
   slots block at once — through a tunneled TPU a host round-trip costs
   tens of ms, so per-token syncing would dominate (measured 37 ms/token at
-  K=1 vs ~2 ms/token at K=32 on the same workload).  Retirement lands at
-  block granularity: a sequence that hits EOS/budget mid-block wastes its
-  remaining in-flight slot-steps (the slot refills at the next sync);
+  K=1 vs ~2 ms/token at K=32 on the same workload).  The block is a
+  DEVICE-SIDE EARLY-EXIT ``while_loop``: it ends as soon as every slot's
+  request has sampled its eos or exhausted its budget (empty slots never
+  extend it), so a 32-step block with 3 tokens of work runs 3 iterations
+  — no host round-trip pays for the cut.  What remains at block
+  granularity: a sequence retiring mid-block while OTHERS run on wastes
+  its in-flight slot-steps, and its slot refills only at the next sync;
   ``stats`` accounts for every dispatched slot-step (emitted vs wasted);
 - **per-request sampling**: temperature/top_k/top_p/eos_id are
   ``submit()`` parameters — the compiled decode step samples every slot
@@ -188,13 +192,14 @@ class ContinuousBatcher:
         self.slot_temp = np.ones(slots, np.float32)
         self.slot_topk = np.zeros(slots, np.int32)
         self.slot_topp = np.ones(slots, np.float32)
+        self.slot_eos = np.full(slots, -1, np.int32)  # -1 = no eos
         self.admitting: dict[int, _Admission] = {}  # slot -> in-progress
         self.queue: deque[_Request] = deque()
         self.requests: dict[int, _Request] = {}
         self._next_rid = 0
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[tuple[int, bool], object] = {}
-        self._decode_fns: dict[int, object] = {}
+        self._decode_fn = None
         self._insert_fn = None
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
         # vs tokens actually delivered — the block-granularity waste
@@ -288,62 +293,75 @@ class ContinuousBatcher:
             self._prefill_fns[bucket] = fn
         return fn
 
-    def _decode(self, k_steps: int | None = None):
+    def _decode(self):
         """(params, cache, tokens (slots,), pos (slots,), temp, top_k,
-        top_p, key) -> ((K, slots) sampled tokens, cache) — ONE program
-        decodes ``k_steps`` tokens for the whole pool per dispatch (each
+        top_p, eos, budget, key) -> ((K, slots) sampled tokens,
+        steps_executed, cache) — ONE program decodes up to
+        ``steps_per_sync`` tokens for the whole pool per dispatch (each
         step's sample feeds the next; host syncs once per block).
         Sampling parameters are per-slot vectors (gen.sample_per_seq), so
         requests with different settings share the dispatch.
 
-        ``k_steps`` defaults to ``steps_per_sync``; the scheduler passes a
-        smaller power-of-two near the end of all budgets (adaptive block:
-        a request with 3 tokens left should not burn a 32-step dispatch).
-        One compiled program per distinct k, built lazily."""
-        if k_steps is None:
-            k_steps = self.steps_per_sync
-        fn = self._decode_fns.get(k_steps)
-        if fn is None:
+        DEVICE-SIDE EARLY EXIT: the block is a ``while_loop`` that stops
+        as soon as EVERY slot is done — its request sampled its eos
+        (``eos`` (slots,) int32, -1 = none) or exhausted its remaining
+        ``budget`` (empty slots pass budget 0 and are done immediately).
+        A 32-step block with one 3-token request left runs 3 iterations,
+        not 32; eos stops end the block at the eos, not at the sync
+        boundary — no host round-trip needed to cut the waste.  Token
+        rows beyond ``steps_executed`` are zeros and discarded."""
+        if self._decode_fn is None:
             cfg, dtype = self.cfg, self.dtype
             use_kernel = self.use_kernel
-            max_len = self.max_len
+            k_steps, max_len = self.steps_per_sync, self.max_len
+            n_slots = self.slots
 
             tp = self.tp_axis if self.mesh is not None else None
 
             def block_body(params, cache, tokens, pos, temp, top_k, top_p,
-                           key):
-                def body(carry, _):
-                    cache, tokens, pos, key = carry
+                           eos, budget, key):
+                buf0 = jnp.zeros((k_steps, n_slots), jnp.int32)
+                done0 = budget <= 0
+
+                def cond(carry):
+                    i, done = carry[0], carry[5]
+                    return (i < k_steps) & ~jnp.all(done)
+
+                def body(carry):
+                    i, cache, tokens, pos, key, done, buf = carry
                     logits, cache = gen.decode_step_ragged(
                         params, cache, tokens, pos, cfg=cfg, dtype=dtype,
                         tp_axis=tp, use_decode_kernel=use_kernel)
                     key, sub = jax.random.split(key)
                     toks = gen.sample_per_seq(sub, logits, temp, top_k,
                                               top_p)
-                    # overshooting sequences (retired mid-block on the
-                    # host) clamp at the last slot; their output is
-                    # discarded and the garbage write stays above every
-                    # live read bound
+                    buf = jax.lax.dynamic_update_index_in_dim(
+                        buf, toks, i, 0)
+                    done = done | ((toks == eos) & (eos >= 0)) \
+                        | (i + 1 >= budget)
+                    # done sequences keep computing in lockstep until the
+                    # block exits; their writes clamp at the last slot and
+                    # stay above every live read bound
                     pos = jnp.minimum(pos + 1, max_len - 1)
-                    return (cache, toks, pos, key), toks
+                    return (i + 1, cache, toks, pos, key, done, buf)
 
-                (cache, _, _, _), toks = jax.lax.scan(
-                    body, (cache, tokens, pos, key), None, length=k_steps)
-                return toks, cache
+                i, cache, _, _, _, _, buf = jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), cache, tokens, pos, key,
+                                 done0, buf0))
+                return buf, i, cache
 
             if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=(1,))
+                self._decode_fn = jax.jit(block_body, donate_argnums=(1,))
             else:
                 from jax import shard_map
                 from jax.sharding import PartitionSpec as P
-                fn = jax.jit(shard_map(
+                self._decode_fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P(), P(), P(), P()),
-                    out_specs=(P(), self._cache_spec)),
+                              P(), P(), P(), P(), P(), P(), P(), P()),
+                    out_specs=(P(), P(), self._cache_spec)),
                     donate_argnums=(1,))
-            self._decode_fns[k_steps] = fn
-        return fn
+        return self._decode_fn
 
     def _prefill_chunk_fn(self, bucket: int, first: bool):
         """One prompt chunk written at cache offset ``off``, attending
@@ -431,6 +449,7 @@ class ContinuousBatcher:
         self.slot_temp[slot] = req.temperature
         self.slot_topk[slot] = req.top_k
         self.slot_topp[slot] = req.top_p
+        self.slot_eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._emit(slot, first_tok, out)
 
     def _fill_free_slots(self) -> list[tuple[int, int]]:
@@ -524,28 +543,23 @@ class ContinuousBatcher:
         live = [s for s in range(self.slots) if self.occupant[s] is not None]
         if not live:
             return out
-        # Adaptive block: when every live request's remaining BUDGET is
-        # below steps_per_sync and no queued work will refill the slots,
-        # clamp the dispatch to the next power of two that covers the
-        # longest remaining budget — a request with 3 tokens left should
-        # not burn a 32-step dispatch (eos stops stay unpredictable and
-        # waste at block granularity, as documented).
-        k = self.steps_per_sync
-        if not self.queue and not self.admitting:
-            rem = max(self.occupant[s].max_new - len(self.occupant[s].emitted)
-                      for s in live)
-            if rem < k:
-                k = min(k, 1 << (rem - 1).bit_length())
+        # per-slot remaining budgets drive the device-side early exit
+        # (empty slots: 0 — they never extend the block)
+        budget = np.zeros(self.slots, np.int32)
+        for s in live:
+            budget[s] = (self.occupant[s].max_new
+                         - len(self.occupant[s].emitted))
         # advance every live slot's write position to the new token's slot
         pos = self.pos.copy()
         pos[live] = np.minimum(pos[live] + 1, self.max_len - 1)
         self.key, sub = jax.random.split(self.key)
-        toks, self.cache = self._decode(k)(
+        toks, steps_exec, self.cache = self._decode()(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(pos), jnp.asarray(self.slot_temp),
-            jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp), sub)
-        toks = np.asarray(toks)  # (K, slots)
-        k_steps = toks.shape[0]
+            jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp),
+            jnp.asarray(self.slot_eos), jnp.asarray(budget), sub)
+        toks = np.asarray(toks)  # (K, slots); rows >= steps_exec are zeros
+        k_steps = int(steps_exec)
         self.stats["decode_dispatches"] += 1
         self.stats["slot_steps"] += k_steps * self.slots
         emitted_before = self.stats["emitted_tokens"]

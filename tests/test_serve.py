@@ -288,9 +288,9 @@ def test_eos_none_disables_inherited_default(params):
 
 
 def test_adaptive_tail_block_cuts_waste(params):
-    """When every remaining budget is small and the queue is empty, the
-    dispatch clamps to a covering power of two instead of burning a full
-    steps_per_sync block — tail waste drops, tokens stay oracle-exact."""
+    """Short-tail waste: the device-side early exit ends the block once
+    every budget is exhausted — a 5-token request costs ~its own tokens,
+    not a full steps_per_sync block; tokens stay oracle-exact."""
     rng = np.random.default_rng(12)
     p = rng.integers(0, 256, (9,)).astype(np.int32)
     cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
@@ -319,3 +319,27 @@ def test_scalar_and_per_seq_samplers_agree_on_combined_filters(params):
             jnp.full((4,), k, jnp.int32), jnp.full((4,), p, jnp.float32))
         np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
                                       err_msg=f"{temp},{k},{p}")
+
+
+def test_early_exit_on_eos_cuts_block_short(params):
+    """Device-side early exit: a request that samples its eos ends the
+    decode block AT the eos (steps_executed == tokens needed), not at the
+    steps_per_sync boundary — without any host round-trip."""
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, 256, (8,)).astype(np.int32)
+    oracle = _greedy_oracle(params, p, 32)
+    # pick the 3rd generated token as "eos": the request should emit
+    # exactly 3 tokens and the block should stop right there
+    eos = int(oracle[len(p) + 2])
+    # ensure it doesn't appear earlier (else adjust expectations)
+    first_hit = next(i for i in range(32) if int(oracle[len(p) + i]) == eos)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32,),
+                           steps_per_sync=32)
+    r = cb.submit(p, max_new=32, eos_id=eos)
+    while cb.pending():
+        cb.step()
+    out = cb.result(r)
+    assert out[-1] == eos and len(out) == len(p) + first_hit + 1
+    # block ended at the eos: slot-steps ~= tokens needed, not 32 x slots
+    assert cb.stats["slot_steps"] <= 2 * (first_hit + 2), cb.stats
