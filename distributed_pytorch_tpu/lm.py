@@ -249,11 +249,9 @@ def make_optimizer(cfg: LMTrainConfig) -> optax.GradientTransformation:
     )
 
 
-def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
-    """Compiled step: (params, opt_state, tokens, targets) ->
-    (params, opt_state, loss).  tokens/targets are (global_batch, global_seq)
-    int32, sharded (data, seq)."""
-    tx = make_optimizer(cfg)
+def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
+    """The ONE shard_mapped loss-and-grad builder shared by the single-step
+    and K-step-scan train paths (their loss semantics must never drift)."""
     dtype = cfg.dtype
     # tp psums always run (free over a size-1 'model' axis) — they also carry
     # the vma bookkeeping that makes the loss provably replicated.  The ring
@@ -280,7 +278,7 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
         aux = jax.lax.pmean(aux, (DATA, EXPERT, SEQ))  # pmean'd over MODEL
         return ce_sum / jnp.maximum(n, 1) + cfg.aux_coef * aux
 
-    grad_step = shard_map(
+    return shard_map(
         jax.value_and_grad(local_loss),
         mesh=mesh,
         in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ)),
@@ -288,6 +286,14 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
         # check_vma stays ON: the automatic psum of cotangents for
         # axis-invariant params (the fused DP/SP gradient sync) depends on it.
     )
+
+
+def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Compiled step: (params, opt_state, tokens, targets) ->
+    (params, opt_state, loss).  tokens/targets are (global_batch, global_seq)
+    int32, sharded (data+expert, seq)."""
+    tx = make_optimizer(cfg)
+    grad_step = _make_grad_step(cfg, mesh)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
@@ -400,6 +406,35 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
     return eval_step
 
 
+def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Compiled K-step training loop for the (data, expert, seq, model)
+    layout: ``(params, opt_state, tokens, targets) -> (params, opt_state,
+    losses)`` with tokens/targets carrying a leading scan axis of length K
+    — ONE dispatch executes K optimizer steps.  Shares ``_make_grad_step``
+    with the single-step path, so loss semantics cannot drift; see
+    LMTrainer.train_steps for when the scan actually helps (measured)."""
+    tx = make_optimizer(cfg)
+    grad_step = _make_grad_step(cfg, mesh)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def steps(params, opt_state, tokens, targets):
+        tokens = jax.vmap(partial(_zigzag_global, cfg))(tokens)
+        targets = jax.vmap(partial(_zigzag_global, cfg))(targets)
+
+        def body(carry, batch):
+            params, opt_state = carry
+            loss, grads = grad_step(params, *batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (tokens, targets))
+        return params, opt_state, losses
+
+    return steps
+
+
 def make_lm_pp_eval_step(cfg: LMTrainConfig, mesh: Mesh):
     """Forward-only masked-CE through the pipeline (no grad, no merge):
     (params, tokens, targets) -> (ce_sum, count), globally reduced.
@@ -508,6 +543,7 @@ class LMTrainer:
             jax.jit(tx.init)(params))
         self.params = params
         self._eval_fn = None
+        self._multi_fn = None
         self._step = 0
         self._ckptr = None
         self._ckptr_key = None
@@ -613,3 +649,35 @@ class LMTrainer:
             self.params, self.opt_state, tokens, targets)
         self._step += 1
         return loss
+
+    def train_steps(self, tokens: np.ndarray, targets: np.ndarray):
+        """Run ``K = tokens.shape[0]`` steps over stacked (K, B, S) batches
+        as one compiled ``lax.scan`` dispatch; returns the K per-step
+        losses.  Identical trajectory to K ``train_step`` calls.
+
+        When it helps (measured, BASELINE.md): per-step jax dispatch is
+        ASYNC, so at ~30 ms/step the host already hides its enqueue cost
+        and this scan is ~16% SLOWER (carry double-buffering of
+        params/Adam state) — use ``train_step`` there.  The scan wins
+        when steps are short relative to host work per dispatch (tiny
+        models; multi-host ``make_array_from_process_local_data``
+        assembly per step; a host that also runs data loading).  Not
+        available with pp > 1 (its step carries pipeline-stacked
+        params)."""
+        if self.cfg.pp > 1:
+            raise ValueError("train_steps (K-step scan) supports the "
+                             "(data, expert, seq, model) layout; with pp "
+                             "use train_step")
+        if self._multi_fn is None:
+            self._multi_fn = make_lm_multi_step(self.cfg, self.mesh)
+        shd = NamedSharding(self.mesh, P(None, *self._batch_spec))
+        if jax.process_count() > 1:
+            tokens = jax.make_array_from_process_local_data(shd, tokens)
+            targets = jax.make_array_from_process_local_data(shd, targets)
+        else:
+            tokens = jax.device_put(tokens, shd)
+            targets = jax.device_put(targets, shd)
+        self.params, self.opt_state, losses = self._multi_fn(
+            self.params, self.opt_state, tokens, targets)
+        self._step += tokens.shape[0]
+        return losses

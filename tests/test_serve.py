@@ -287,7 +287,7 @@ def test_eos_none_disables_inherited_default(params):
     assert len(cb.result(r_nostop)) == len(p1) + 5   # eos disabled
 
 
-def test_adaptive_tail_block_cuts_waste(params):
+def test_early_exit_cuts_short_tail_waste(params):
     """Short-tail waste: the device-side early exit ends the block once
     every budget is exhausted — a 5-token request costs ~its own tokens,
     not a full steps_per_sync block; tokens stay oracle-exact."""
