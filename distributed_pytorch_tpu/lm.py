@@ -83,6 +83,15 @@ class LMTrainConfig:
     # tick count.
     pp_remat_block: int | None = 0
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
+    # Gradient accumulation: split each global batch into grad_accum
+    # microbatches, scan them accumulating gradients, apply ONE optimizer
+    # step.  The CE gradient is EXACT (grads normalize by the full batch's
+    # global token count, counted before the scan, so microbatch mask
+    # imbalance reweights nothing).  MoE aux is a per-routing-group
+    # statistic, and accumulation makes each microbatch its own group —
+    # the aux term therefore shifts slightly, exactly as it does for any
+    # other change of group size (dp/tp splits included).
+    grad_accum: int = 1
     @property
     def dtype(self) -> jnp.dtype | None:
         """compute_dtype resolved to a jnp dtype (None = float32 params)."""
@@ -102,6 +111,13 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
         raise ValueError(
             "interleave (virtual pipeline stages) requires pp > 1; with "
             "pp=1 it would be silently ignored")
+    if cfg.grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {cfg.grad_accum}")
+    if cfg.grad_accum > 1 and cfg.pp > 1:
+        raise ValueError(
+            "grad_accum does not compose with pp (the pipeline's "
+            "microbatch schedule already bounds activation memory; use "
+            "--microbatches)")
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -260,7 +276,7 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
     seq_axis = SEQ if cfg.sp > 1 else None
     specs = param_specs(cfg)
 
-    def local_loss(params, tokens, targets):
+    def local_loss(params, tokens, targets, n_total, aux_w):
         if cfg.fsdp:
             params = _fsdp_gather(params, specs)
         pos = _shard_positions(cfg, tokens.shape[1])
@@ -269,19 +285,23 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
                                 tp_axis=tp_axis, pos=pos,
                                 ep_axis=EXPERT if cfg.ep > 1 else None,
                                 return_aux=True)
-        ce_sum, n = masked_ce(logits, targets)
+        ce_sum, _ = masked_ce(logits, targets)
         # Global mean over every shard's tokens; the batch shards over
         # (data, expert), so 'expert' reduces like a data axis ('model'
         # shards compute identical values, no reduction needed there).
+        # ``n_total`` is the caller-counted GLOBAL valid-token count of the
+        # step's full batch — under gradient accumulation each microbatch
+        # contributes ce_sum_i/n_total with aux_w = coef/A, so the SUM of
+        # microbatch grads is exactly the unaccumulated step's gradient.
         ce_sum = jax.lax.psum(ce_sum, (DATA, EXPERT, SEQ))
-        n = jax.lax.psum(n, (DATA, EXPERT, SEQ))
         aux = jax.lax.pmean(aux, (DATA, EXPERT, SEQ))  # pmean'd over MODEL
-        return ce_sum / jnp.maximum(n, 1) + cfg.aux_coef * aux
+        return ce_sum / jnp.maximum(n_total, 1) + aux_w * aux
 
     return shard_map(
         jax.value_and_grad(local_loss),
         mesh=mesh,
-        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ)),
+        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ),
+                  P(), P()),
         out_specs=(P(), specs),
         # check_vma stays ON: the automatic psum of cotangents for
         # axis-invariant params (the fused DP/SP gradient sync) depends on it.
@@ -291,15 +311,53 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     """Compiled step: (params, opt_state, tokens, targets) ->
     (params, opt_state, loss).  tokens/targets are (global_batch, global_seq)
-    int32, sharded (data+expert, seq)."""
+    int32, sharded (data+expert, seq).  With ``cfg.grad_accum = A > 1``
+    the batch is split into A microbatches scanned with gradient
+    accumulation and ONE optimizer update — peak activation memory drops
+    by ~A at the cost of A sequential forward/backward passes.  The CE
+    gradient is EXACT (grads normalize by the full batch's token count, so
+    microbatch mask imbalance reweights nothing); the MoE aux term is a
+    per-routing-group statistic and shifts with the group split, as with
+    any dp/tp regrouping."""
     tx = make_optimizer(cfg)
     grad_step = _make_grad_step(cfg, mesh)
+    a = cfg.grad_accum
+    if a < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {a}")
+    coef = jnp.float32(cfg.aux_coef)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
         tokens = _zigzag_global(cfg, tokens)
         targets = _zigzag_global(cfg, targets)
-        loss, grads = grad_step(params, tokens, targets)
+        n_total = jnp.sum(targets != IGNORE).astype(jnp.float32)
+        if a == 1:
+            loss, grads = grad_step(params, tokens, targets, n_total, coef)
+        else:
+            b = tokens.shape[0]
+            if b % (a * cfg.dp * cfg.ep):
+                raise ValueError(
+                    f"global batch {b} not divisible into grad_accum={a} "
+                    f"microbatches of dp*ep={cfg.dp * cfg.ep}-divisible "
+                    f"size")
+            mb = b // a
+            # INTERLEAVED split (microbatch j = rows j, j+a, j+2a, ...):
+            # every device's contiguous (data, expert) block contributes
+            # equally to every microbatch, so the scan's shard_map slices
+            # are resharding-free (a contiguous split would all-to-all the
+            # batch every iteration)
+            micro_t = tokens.reshape(mb, a, -1).swapaxes(0, 1)
+            micro_y = targets.reshape(mb, a, -1).swapaxes(0, 1)
+
+            def body(carry, batch):
+                loss_acc, grads_acc = carry
+                loss_i, g_i = grad_step(params, *batch, n_total, coef / a)
+                return (loss_acc + loss_i,
+                        jax.tree.map(jnp.add, grads_acc, g_i)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss, grads), _ = jax.lax.scan(
+                body, (jnp.float32(0), zeros), (micro_t, micro_y))
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -423,7 +481,10 @@ def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
 
         def body(carry, batch):
             params, opt_state = carry
-            loss, grads = grad_step(params, *batch)
+            tk, tg = batch
+            n_total = jnp.sum(tg != IGNORE).astype(jnp.float32)
+            loss, grads = grad_step(params, tk, tg, n_total,
+                                    jnp.float32(cfg.aux_coef))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
@@ -668,6 +729,10 @@ class LMTrainer:
             raise ValueError("train_steps (K-step scan) supports the "
                              "(data, expert, seq, model) layout; with pp "
                              "use train_step")
+        if self.cfg.grad_accum > 1:
+            raise ValueError("train_steps does not implement gradient "
+                             "accumulation; use train_step with "
+                             "grad_accum, or stack more steps instead")
         if self._multi_fn is None:
             self._multi_fn = make_lm_multi_step(self.cfg, self.mesh)
         shd = NamedSharding(self.mesh, P(None, *self._batch_spec))
