@@ -28,7 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from .models import transformer as tfm
-from .ops.attention import NEG_INF, attention_reference, decode_attention
+from .ops.attention import (NEG_INF, attention_reference,
+                            decode_attention,
+                            decode_attention_paged)
 
 PyTree = Any
 
@@ -39,6 +41,21 @@ def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
     GQA models cache only the kv heads.  ``kv_heads`` overrides the config
     count (tensor-parallel decode caches only this shard's heads)."""
     shape = (batch, kv_heads or cfg.kv_heads, max_len, cfg.head_dim)
+    return {
+        f"layer{i}": {"k": jnp.zeros(shape, dtype),
+                      "v": jnp.zeros(shape, dtype)}
+        for i in range(cfg.n_layers)
+    }
+
+
+def init_paged_cache(cfg: tfm.TransformerConfig, n_pages: int,
+                     page: int = 512, dtype=jnp.float32,
+                     kv_heads: int | None = None) -> PyTree:
+    """Zeroed per-layer PAGED K/V pools, (n_pages, kv_heads, page,
+    head_dim): sequences own pages via a block table instead of a
+    contiguous per-sequence buffer (serve.py paged mode), so cache memory
+    scales with pages actually allocated, not slots x max_len."""
+    shape = (n_pages, kv_heads or cfg.kv_heads, page, cfg.head_dim)
     return {
         f"layer{i}": {"k": jnp.zeros(shape, dtype),
                       "v": jnp.zeros(shape, dtype)}
@@ -118,7 +135,8 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
                     unembed_last_only: bool = False,
                     unembed_at=None,
                     k_len: int | None = None,
-                    use_decode_kernel: bool = False):
+                    use_decode_kernel: bool = False,
+                    page_table: jax.Array | None = None):
     """Cache-backed forward over a (B, S) token block at positions ``pos``
     (S,), writing each layer's K/V into cache slots [write_at, write_at+S).
     Returns ((B, S, vocab) logits, cache).  The one implementation behind
@@ -150,6 +168,16 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     s = tokens.shape[1]
     ragged = pos.ndim == 2  # (B, S) per-sequence positions
     kernel_path = use_decode_kernel and s == 1
+    if page_table is not None:
+        # PAGED KV pool (serve.py paged mode): cache leaves are shared
+        # (P, hkv, page, D) pools; ``page_table`` (B, n_pages) maps each
+        # sequence's logical cache blocks to pool pages.  Decode-only,
+        # kernel-only (the page indirection lives in the Pallas index
+        # maps — measured free on TPU).
+        if not (kernel_path and ragged):
+            raise ValueError("page_table requires the single-token ragged "
+                             "decode kernel path (use_decode_kernel=True, "
+                             "per-sequence positions)")
     if not kernel_path:
         # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
         slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
@@ -168,7 +196,19 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
         q = tfm.rotary(q, pos, cfg.rope_theta)
         k = tfm.rotary(k, pos, cfg.rope_theta)
-        if ragged:
+        if page_table is not None:
+            # paged write: token at position p lands in pool page
+            # table[b, p // page] at row p % page
+            page = c["k"].shape[2]
+            p_now = pos[:, 0]
+            pids = jnp.take_along_axis(page_table,
+                                       (p_now // page)[:, None], 1)[:, 0]
+            offs = p_now % page
+            ck = c["k"].at[pids, :, offs].set(
+                k[:, :, 0].astype(c["k"].dtype))
+            cv = c["v"].at[pids, :, offs].set(
+                v[:, :, 0].astype(c["v"].dtype))
+        elif ragged:
             # per-sequence write offsets (vmapped update -> scatter)
             upd = jax.vmap(lambda c, u, w: lax.dynamic_update_slice(
                 c, u, (0, w, 0)))
@@ -180,7 +220,9 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
             cv = lax.dynamic_update_slice(
                 c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
-        if kernel_path:
+        if page_table is not None:
+            o = decode_attention_paged(q, ck, cv, page_table, pos[:, 0])
+        elif kernel_path:
             # Pallas decode kernel: exact pos+1 cache-read bound (dead
             # blocks neither fetched nor computed), GQA head groups folded
             # into MXU rows — no repeated cache reads, no k_len segmenting.
@@ -249,7 +291,8 @@ def decode_step(params: PyTree, cache: PyTree, token: jax.Array,
 def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
                        pos: jax.Array, *, cfg: tfm.TransformerConfig,
                        dtype=None, tp_axis: str | None = None,
-                       use_decode_kernel: bool = False):
+                       use_decode_kernel: bool = False,
+                       page_table: jax.Array | None = None):
     """One token per sequence at PER-SEQUENCE positions: (B,) ids at (B,)
     positions -> ((B, vocab) logits, cache).  Every sequence reads exactly
     its own ``pos+1`` cache prefix and writes its K/V at its own offset —
@@ -259,7 +302,7 @@ def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
     logits, cache = _forward_cached(
         params, cache, token[:, None], pos[:, None], pos,
         cfg=cfg, dtype=dtype, tp_axis=tp_axis,
-        use_decode_kernel=use_decode_kernel)
+        use_decode_kernel=use_decode_kernel, page_table=page_table)
     return logits[:, 0], cache
 
 

@@ -623,3 +623,94 @@ def flash_attention(
         return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
     o = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, interpret)
     return o.reshape(b, h, sq, d)
+
+
+def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, sm_scale: float,
+                         block_k: int, hkv: int, g: int):
+    """Paged twin of ``_decode_kernel``: identical math; the cache tiles
+    arrive via the block-table index map instead of a contiguous buffer,
+    and ``table_ref`` (the second scalar-prefetch operand) is consumed by
+    the BlockSpec index maps only."""
+    del table_ref
+    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, sm_scale=sm_scale, block_k=block_k, hkv=hkv, g=g)
+
+
+def decode_attention_paged(
+    q: Array, k_pool: Array, v_pool: Array, table: Array, pos: Array, *,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> Array:
+    """Single-token decode attention over a PAGED KV pool.
+
+    The vLLM-style memory layout, TPU-native: instead of one contiguous
+    (B, Hkv, S, D) buffer per sequence, K/V live in a shared pool of
+    fixed-size pages — ``k_pool``/``v_pool``: (P, Hkv, page, D) — and each
+    sequence owns the pages its ``table`` row lists: ``table``
+    (B, n_pages) int32, entry j = the pool page holding cache slots
+    [j*page, (j+1)*page).  ``pos``: (B,) int32 exact read bounds, as in
+    ``decode_attention``.
+
+    The page indirection costs NOTHING on the read path: the same
+    scalar-prefetch BlockSpec index maps that clamp dead blocks in the
+    dense kernel simply look the live block up in the table —
+    ``(table[b, min(j, pos[b]//page)], ...)`` — so each grid step DMAs
+    exactly one live page and dead pages' copies are elided (repeated
+    index).  Entries past a sequence's allocated pages may be garbage; the
+    clamp means they are never dereferenced.  Returns (B, H, 1, D).
+    """
+    b, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(f"decode_attention_paged takes single-token "
+                         f"queries, got sq={sq}")
+    p_blocks, hkv, page, _ = k_pool.shape
+    g = h // hkv
+    if h % hkv:
+        raise ValueError(f"{h} query heads do not group over {hkv} kv heads")
+    if page % 8 or (page < 128 and p_blocks > 1):
+        raise ValueError(f"page size {page} must be 8-aligned and >= 128")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    n_pages = table.shape[1]
+
+    qf = q.reshape(b, h, d)
+    pos_arr = jnp.broadcast_to(jnp.atleast_1d(pos), (b,)).astype(jnp.int32)
+    table = table.astype(jnp.int32)
+    vma = _vma(q, k_pool, v_pool)
+
+    def live_page(bb, j, pos_ref, table_ref):
+        return table_ref[bb, jnp.minimum(j, pos_ref[bb] // page)]
+
+    o = pl.pallas_call(
+        functools.partial(_decode_kernel_paged, sm_scale=sm_scale,
+                          block_k=page, hkv=hkv, g=g),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_pages),
+            in_specs=[
+                pl.BlockSpec((1, h, d),
+                             lambda bb, j, pos_ref, table_ref: (bb, 0, 0)),
+                pl.BlockSpec(
+                    (1, hkv, page, d),
+                    lambda bb, j, pos_ref, table_ref: (
+                        live_page(bb, j, pos_ref, table_ref), 0, 0, 0)),
+                pl.BlockSpec(
+                    (1, hkv, page, d),
+                    lambda bb, j, pos_ref, table_ref: (
+                        live_page(bb, j, pos_ref, table_ref), 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, h, d), lambda bb, j, pos_ref, table_ref: (bb, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, d), jnp.float32),      # acc
+                pltpu.VMEM((h, 128), jnp.float32),    # running max m
+                pltpu.VMEM((h, 128), jnp.float32),    # running sum l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype, vma=vma),
+        interpret=interpret,
+    )(pos_arr, table, qf, k_pool, v_pool)
+    return o.reshape(b, h, 1, d)

@@ -119,6 +119,7 @@ class ContinuousBatcher:
                  seed: int = 0, decode_kernel: bool | None = None,
                  steps_per_sync: int = 8,
                  prefill_chunk: int | None = None,
+                 paged: bool = False, pool_pages: int | None = None,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
@@ -171,9 +172,47 @@ class ContinuousBatcher:
         # sharded jax arrays report their GLOBAL shape, so this is
         # cfg.kv_heads in the TP case too
         self.kv_heads = params["layer0"]["wk"].shape[1]
-        self.cache = gen.init_cache(cfg, slots, self.max_len,
-                                    dtype=dtype or jnp.float32,
-                                    kv_heads=self.kv_heads)
+        # PAGED KV pool (vLLM-style, TPU-native): K/V live in a shared pool
+        # of 512-token pages owned via per-slot block tables instead of
+        # per-slot max_len buffers — cache memory scales with pages
+        # actually allocated.  The page indirection rides the decode
+        # kernel's scalar-prefetch index maps (measured free on TPU);
+        # paged therefore requires the kernel decode path.
+        self.paged = paged
+        self.page = 512
+        self.pages_per_slot = self.max_len // self.page
+        if paged:
+            if mesh is not None:
+                raise ValueError("paged serving does not yet compose with "
+                                 "tensor-parallel meshes; use the dense "
+                                 "slot cache with mesh=")
+            if not self.use_kernel and decode_kernel is not None:
+                raise ValueError("paged serving requires the decode-kernel "
+                                 "path (the page table lives in its index "
+                                 "maps); drop decode_kernel=False")
+            self.use_kernel = True  # interpret mode covers off-TPU runs
+            # page 0 is a RESERVED SCRATCH page, never allocated: empty
+            # and freed slots' table rows point at it, so their lockstep
+            # garbage writes (done slots keep computing until the block
+            # exits) land there instead of corrupting recycled pages.
+            self.pool_pages = (pool_pages if pool_pages is not None
+                               else slots * self.pages_per_slot + 1)
+            if self.pool_pages - 1 < self.pages_per_slot:
+                raise ValueError(
+                    f"pool_pages {self.pool_pages} cannot hold even one "
+                    f"max_len sequence ({self.pages_per_slot} pages + the "
+                    f"reserved scratch page)")
+            self.cache = gen.init_paged_cache(cfg, self.pool_pages,
+                                              self.page,
+                                              dtype=dtype or jnp.float32,
+                                              kv_heads=self.kv_heads)
+            self.table = np.zeros((slots, self.pages_per_slot), np.int32)
+            self.free_pages = deque(range(1, self.pool_pages))
+            self.slot_pages: list[list[int]] = [[] for _ in range(slots)]
+        else:
+            self.cache = gen.init_cache(cfg, slots, self.max_len,
+                                        dtype=dtype or jnp.float32,
+                                        kv_heads=self.kv_heads)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._cache_spec = jax.tree.map(lambda _: P(None, tp_axis),
@@ -201,6 +240,7 @@ class ContinuousBatcher:
         self._chunk_fns: dict[tuple[int, bool], object] = {}
         self._decode_fn = None
         self._insert_fn = None
+        self._insert_paged_fn = None
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
         # vs tokens actually delivered — the block-granularity waste
         self.stats = {"decode_dispatches": 0, "slot_steps": 0,
@@ -318,8 +358,10 @@ class ContinuousBatcher:
 
             tp = self.tp_axis if self.mesh is not None else None
 
+            paged = self.paged
+
             def block_body(params, cache, tokens, pos, temp, top_k, top_p,
-                           eos, budget, key):
+                           eos, budget, write_cap, table, key):
                 buf0 = jnp.zeros((k_steps, n_slots), jnp.int32)
                 done0 = budget <= 0
 
@@ -331,7 +373,8 @@ class ContinuousBatcher:
                     i, cache, tokens, pos, key, done, buf = carry
                     logits, cache = gen.decode_step_ragged(
                         params, cache, tokens, pos, cfg=cfg, dtype=dtype,
-                        tp_axis=tp, use_decode_kernel=use_kernel)
+                        tp_axis=tp, use_decode_kernel=use_kernel,
+                        page_table=table if paged else None)
                     key, sub = jax.random.split(key)
                     toks = gen.sample_per_seq(sub, logits, temp, top_k,
                                               top_p)
@@ -340,9 +383,11 @@ class ContinuousBatcher:
                     done = done | ((toks == eos) & (eos >= 0)) \
                         | (i + 1 >= budget)
                     # done sequences keep computing in lockstep until the
-                    # block exits; their writes clamp at the last slot and
-                    # stay above every live read bound
-                    pos = jnp.minimum(pos + 1, max_len - 1)
+                    # block exits; their writes clamp at their own
+                    # ALLOCATED frontier (per-slot write_cap) — under
+                    # paging, advancing past it would dereference table
+                    # entries the slot does not own
+                    pos = jnp.minimum(pos + 1, write_cap)
                     return (i + 1, cache, toks, pos, key, done, buf)
 
                 i, cache, _, _, _, _, buf = jax.lax.while_loop(
@@ -358,7 +403,8 @@ class ContinuousBatcher:
                 self._decode_fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P(), P(), P(), P(), P(), P()),
+                              P(), P(), P(), P(), P(), P(), P(), P(),
+                              P(), P()),
                     out_specs=(P(), P(), self._cache_spec)),
                     donate_argnums=(1,))
         return self._decode_fn
@@ -414,6 +460,68 @@ class ContinuousBatcher:
             self._chunk_fns[(bucket, first)] = fn
         return fn
 
+    # -- paged-pool bookkeeping (self.paged) ------------------------------
+    def _alloc_pages(self, slot: int, upto_pos: int) -> None:
+        """Ensure ``slot``'s block table covers positions [0, upto_pos]."""
+        need = min(upto_pos // self.page + 1, self.pages_per_slot)
+        pages = self.slot_pages[slot]
+        while len(pages) < need:
+            if not self.free_pages:
+                raise RuntimeError(
+                    f"KV page pool exhausted ({self.pool_pages} pages): "
+                    f"raise pool_pages or lower concurrency/max_new")
+            pid = self.free_pages.popleft()
+            self.table[slot, len(pages)] = pid
+            pages.append(pid)
+
+    def _release_pages(self, slot: int) -> None:
+        """Return a retired slot's pages and repoint its table row at the
+        scratch page 0 (resetting pos too): the slot keeps lockstep-
+        writing in later dispatches until re-admitted, and those writes
+        must never land in pages recycled to OTHER slots."""
+        self.free_pages.extend(self.slot_pages[slot])
+        self.slot_pages[slot] = []
+        self.table[slot, :] = 0
+        self.pos[slot] = 0
+
+    def _write_caps(self) -> np.ndarray:
+        """Per-slot last writable position: the allocated frontier under
+        paging (in-block writes must never dereference unowned table
+        entries), max_len-1 for the dense cache."""
+        if not self.paged:
+            return np.full(self.slots, self.max_len - 1, np.int32)
+        return np.asarray(
+            [max(len(p) * self.page - 1, 0) for p in self.slot_pages],
+            np.int32)
+
+    def _insert_paged(self, slabs, slot: int) -> None:
+        """Scatter a prefill's (1, hkv, bucket, d) slabs into this slot's
+        OWNED pages (the paged twin of ``_insert``): allocation is by
+        prompt length, so a padded bucket wider than the owned pages only
+        writes the chunks the slot owns — the padded tail is never read
+        (pos bound) and decode re-writes positions before reading them."""
+        bucket = jax.tree.leaves(slabs)[0].shape[2]
+        n = min(-(-bucket // self.page), len(self.slot_pages[slot]))
+        if self._insert_paged_fn is None:
+            page = self.page
+
+            @partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+            def insert(cache, slabs, pids, n):
+                def write(big, small):
+                    for c in range(n):
+                        chunk = jax.lax.dynamic_slice_in_dim(
+                            small, c * page,
+                            min(page, small.shape[2] - c * page), axis=2)
+                        big = jax.lax.dynamic_update_slice(
+                            big, chunk.astype(big.dtype),
+                            (pids[c], 0, 0, 0))
+                    return big
+                return jax.tree.map(write, cache, slabs)
+
+            self._insert_paged_fn = insert
+        pids = jnp.asarray(self.table[slot, :n])
+        self.cache = self._insert_paged_fn(self.cache, slabs, pids, n)
+
     def _insert(self, slabs, slot: int) -> None:
         """Write a prefill's (1, hkv, bucket, d) slabs into the pool slot
         (jitted with the pool donated — an in-place slab write, not a
@@ -467,7 +575,11 @@ class ContinuousBatcher:
             last_logits, slabs = self._prefill(bucket)(
                 self.params, jnp.asarray(padded), L)
             self.stats["prefill_dispatches"] += 1
-            self._insert(slabs, slot)
+            if self.paged:
+                self._alloc_pages(slot, L - 1)
+                self._insert_paged(slabs, slot)
+            else:
+                self._insert(slabs, slot)
             self._occupy(slot, req, self._sample_first(req, last_logits),
                          out)
         return out
@@ -508,7 +620,11 @@ class ContinuousBatcher:
             self.stats["prefill_dispatches"] += 1
             adm.off += c
             if final:
-                self._insert(adm.cache, slot)
+                if self.paged:
+                    self._alloc_pages(slot, L - 1)
+                    self._insert_paged(adm.cache, slot)
+                else:
+                    self._insert(adm.cache, slot)
                 del self.admitting[slot]
                 self._occupy(slot, req,
                              self._sample_first(req, last_logits), out)
@@ -523,6 +639,11 @@ class ContinuousBatcher:
                 or len(req.emitted) >= req.max_new):
             req.done = True
             self.occupant[slot] = None  # slot free; stale K/V never read
+            if self.paged:
+                # the block table row is rewritten at the next admission;
+                # in-flight lockstep writes this dispatch stay within the
+                # old frontier (write_cap), so reuse is race-free
+                self._release_pages(slot)
         else:
             self.last_tok[slot] = tok
 
@@ -549,15 +670,24 @@ class ContinuousBatcher:
         for s in live:
             budget[s] = (self.occupant[s].max_new
                          - len(self.occupant[s].emitted))
+        if self.paged:
+            # pre-allocate pages covering this dispatch's write frontier
+            for s_ in live:
+                self._alloc_pages(
+                    s_, min(int(self.pos[s_]) + self.steps_per_sync,
+                            self.max_len - 1))
         # advance every live slot's write position to the new token's slot
         pos = self.pos.copy()
         pos[live] = np.minimum(pos[live] + 1, self.max_len - 1)
         self.key, sub = jax.random.split(self.key)
+        table = jnp.asarray(self.table if self.paged
+                            else np.zeros((self.slots, 1), np.int32))
         toks, steps_exec, self.cache = self._decode()(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(pos), jnp.asarray(self.slot_temp),
             jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp),
-            jnp.asarray(self.slot_eos), jnp.asarray(budget), sub)
+            jnp.asarray(self.slot_eos), jnp.asarray(budget),
+            jnp.asarray(self._write_caps()), table, sub)
         toks = np.asarray(toks)  # (K, slots); rows >= steps_exec are zeros
         k_steps = int(steps_exec)
         self.stats["decode_dispatches"] += 1

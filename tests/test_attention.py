@@ -235,3 +235,43 @@ def test_decode_attention_per_sequence_positions():
     out_dirty = attn.decode_attention(q, kc_dirty, vc, pos, block_k=64)
     np.testing.assert_array_equal(np.asarray(out[0]),
                                   np.asarray(out_dirty[0]))
+
+
+def test_paged_decode_matches_dense():
+    """The paged decode kernel == the dense kernel when the dense cache's
+    blocks are scattered into a shuffled pool and the table maps them
+    back — per-sequence exact pos bounds included, garbage table tails
+    never dereferenced."""
+    rng = np.random.default_rng(0)
+    b, h, hkv, d, s, page = 3, 4, 2, 32, 1024, 512
+    n_pages = s // page
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    pos = jnp.asarray([37, 700, 1023], jnp.int32)
+
+    want = attn.decode_attention(q, k, v, pos, block_k=page)
+
+    # scatter dense blocks into a shuffled pool (plus spare garbage pages)
+    p_total = b * n_pages + 3
+    perm = rng.permutation(b * n_pages)
+    k_pool = jnp.asarray(rng.standard_normal((p_total, hkv, page, d)),
+                         jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((p_total, hkv, page, d)),
+                         jnp.float32)
+    table = np.full((b, n_pages), 999_999, np.int32)  # poison the tails
+    for bb in range(b):
+        for j in range(n_pages):
+            pid = int(perm[bb * n_pages + j]) + 3  # skip the garbage pages
+            k_pool = k_pool.at[pid].set(k[bb, :, j * page:(j + 1) * page])
+            v_pool = v_pool.at[pid].set(v[bb, :, j * page:(j + 1) * page])
+            table[bb, j] = pid
+    # poison entries past each sequence's live pages: must never be read
+    for bb in range(b):
+        live = int(pos[bb]) // page
+        table[bb, live + 1:] = 0  # points at garbage page 0
+
+    got = attn.decode_attention_paged(q, k_pool, v_pool,
+                                      jnp.asarray(table), pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

@@ -361,3 +361,65 @@ def test_generate_top_p_degenerates_to_greedy():
                        max_new=8, temperature=1.0, top_p=0.9)
     assert out.shape == (2, 14)
     assert int(jnp.max(out)) < 128
+
+
+def test_paged_decode_step_matches_dense_ragged():
+    """decode_step_ragged over a paged pool (shuffled pages, poisoned
+    table tails) == the dense ragged path, across page-boundary crossings
+    and per-sequence depths."""
+    cfg = tfm.TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
+                                n_heads=4, head_dim=16, n_kv_heads=2,
+                                d_ff=128)
+    params = tfm.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    b, max_len, page = 3, 1024, 512
+    n_pages = max_len // page
+    # dense reference state: prefill each sequence to its own depth
+    prompts = [rng.integers(0, 128, (L,)).astype(np.int32)
+               for L in (5, 500, 600)]
+    dense = gen.init_cache(cfg, b, max_len)
+    for i, p in enumerate(prompts):
+        c1 = gen.init_cache(cfg, 1, max_len)
+        _, c1 = gen._forward_cached(params, c1, jnp.asarray(p)[None],
+                                    jnp.arange(len(p)), 0, cfg=cfg,
+                                    k_len=max_len)
+        for l in dense:
+            for kv in ("k", "v"):
+                dense[l][kv] = dense[l][kv].at[i].set(c1[l][kv][0])
+    # paged state: scatter the same K/V into shuffled pool pages
+    p_total = b * n_pages + 2
+    pool = gen.init_paged_cache(cfg, p_total, page)
+    perm = rng.permutation(b * n_pages)
+    table = np.zeros((b, n_pages), np.int32)
+    for i in range(b):
+        for j in range(n_pages):
+            pid = int(perm[i * n_pages + j]) + 2
+            table[i, j] = pid
+            for l in pool:
+                for kv in ("k", "v"):
+                    pool[l][kv] = pool[l][kv].at[pid].set(
+                        dense[l][kv][i, :, j * page:(j + 1) * page])
+    pos = jnp.asarray([len(p) for p in prompts], jnp.int32)
+    tok = jnp.asarray([p[-1] for p in prompts], jnp.int32)
+
+    # decode several tokens (crossing 512 for the 500-deep sequence)
+    table_j = jnp.asarray(table)
+    d_cache, p_cache, d_pos = dense, pool, pos
+    for step in range(16):
+        ld, d_cache = gen.decode_step_ragged(params, d_cache, tok, d_pos,
+                                             cfg=cfg,
+                                             use_decode_kernel=True)
+        lp_, p_cache = gen.decode_step_ragged(params, p_cache, tok, d_pos,
+                                              cfg=cfg,
+                                              use_decode_kernel=True,
+                                              page_table=table_j)
+        np.testing.assert_allclose(np.asarray(lp_), np.asarray(ld),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"step {step}")
+        tok = jnp.argmax(ld, -1).astype(jnp.int32)
+        d_pos = d_pos + 1
+
+    with pytest.raises(ValueError, match="page_table requires"):
+        gen.decode_step_ragged(params, p_cache, tok, d_pos, cfg=cfg,
+                               use_decode_kernel=False,
+                               page_table=table_j)

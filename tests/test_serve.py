@@ -343,3 +343,117 @@ def test_early_exit_on_eos_cuts_block_short(params):
     assert out[-1] == eos and len(out) == len(p) + first_hit + 1
     # block ended at the eos: slot-steps ~= tokens needed, not 32 x slots
     assert cb.stats["slot_steps"] <= 2 * (first_hit + 2), cb.stats
+
+
+def test_paged_kv_pool_matches_oracle(params):
+    """Paged KV pool (vLLM-style block tables over the decode kernel's
+    scalar-prefetch index maps): ragged requests through a page pool with
+    recycling stay oracle-exact, and pages actually recycle."""
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (5, 17, 40, 9, 23)]
+
+    def oracle(p, n):
+        return np.asarray(gen.generate(
+            params, jnp.asarray(p)[None], jax.random.key(1), cfg=CFG,
+            max_new=n, temperature=0.0, decode_kernel=True))[0]
+
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           paged=True, decode_kernel=True)
+    assert cb.pool_pages == 2 * (1024 // 512) + 1  # + scratch
+    results = cb.run(prompts, max_new=10)
+    for rid, prompt in enumerate(prompts):
+        np.testing.assert_array_equal(results[rid],
+                                      oracle(prompt, 10))
+    # all usable pages returned to the free list after every request
+    # retired (page 0 is the reserved scratch page)
+    assert len(cb.free_pages) == cb.pool_pages - 1
+    assert all(not p for p in cb.slot_pages)
+
+
+def test_paged_pool_oversubscription(params):
+    """A pool SMALLER than slots x max_len serves fine while sequences
+    stay short (the memory win), and exhausts with a clear error when
+    they cannot fit."""
+    rng = np.random.default_rng(16)
+    p = rng.integers(0, 256, (8,)).astype(np.int32)
+    # 2 slots x 1024 max_len = 4 usable pages dense-equivalent; give the
+    # pool only 2 usable (+1 scratch)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(32,),
+                           paged=True, pool_pages=3, decode_kernel=True)
+    r1 = cb.submit(p, max_new=8)
+    r2 = cb.submit(p, max_new=8)
+    while cb.pending():
+        cb.step()
+    assert len(cb.result(r1)) == len(p) + 8
+    assert len(cb.result(r2)) == len(p) + 8
+
+    # two sequences that must BOTH cross page 0's boundary exhaust the
+    # 2-page pool: loud error, not silent corruption
+    cb2 = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                            temperature=0.0, prompt_buckets=(512,),
+                            paged=True, pool_pages=3, decode_kernel=True)
+    cb2.submit(rng.integers(0, 256, (500,)).astype(np.int32), max_new=80)
+    cb2.submit(rng.integers(0, 256, (500,)).astype(np.int32), max_new=80)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        while cb2.pending():
+            cb2.step()
+
+
+def test_paged_validation(params):
+    with pytest.raises(ValueError, match="decode-kernel"):
+        ContinuousBatcher(params, CFG, paged=True, decode_kernel=False)
+    with pytest.raises(ValueError, match="cannot hold"):
+        ContinuousBatcher(params, CFG, max_len=1024, paged=True,
+                          pool_pages=2, decode_kernel=True)
+
+
+def test_paged_freed_slot_writes_cannot_corrupt_recycled_pages(params):
+    """Corruption regression (round-3 review): a retired slot keeps
+    lockstep-writing until the block exits and across later dispatches —
+    its table row must repoint at the reserved scratch page when its
+    pages are recycled to another slot, or it would overwrite the new
+    owner's K/V.  Scenario: slot 0 retires; the pool is so tight that
+    slot 1's page-boundary crossing acquires slot 0's freed page; slot
+    1's continuation must stay oracle-exact."""
+    rng = np.random.default_rng(17)
+    p_short = rng.integers(0, 256, (6,)).astype(np.int32)
+    p_long = rng.integers(0, 256, (480,)).astype(np.int32)
+
+    def oracle(p, n):
+        return np.asarray(gen.generate(
+            params, jnp.asarray(p)[None], jax.random.key(1), cfg=CFG,
+            max_new=n, temperature=0.0, decode_kernel=True))[0]
+
+    # usable pages = 2 (+1 scratch): long takes page A; short takes page
+    # B and retires; long crosses 512 and must acquire B
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(32, 512),
+                           paged=True, pool_pages=3, decode_kernel=True,
+                           steps_per_sync=8)
+    r_long = cb.submit(p_long, max_new=80)   # crosses 512 mid-run
+    r_short = cb.submit(p_short, max_new=4)  # retires early, frees B
+    while cb.pending():
+        cb.step()
+    np.testing.assert_array_equal(cb.result(r_short),
+                                  oracle(p_short, 4))
+    np.testing.assert_array_equal(cb.result(r_long),
+                                  oracle(p_long, 80))
+    assert len(cb.free_pages) == 2  # both usable pages recycled
+
+
+def test_paged_allocates_by_prompt_length_not_bucket(params):
+    """A short prompt in a wide bucket holds only ceil(L/page) pages —
+    the padding tax must not erode oversubscription headroom."""
+    rng = np.random.default_rng(18)
+    cb = ContinuousBatcher(params, CFG, slots=1, max_len=1024,
+                           temperature=0.0, prompt_buckets=(1024,),
+                           paged=True, decode_kernel=True)
+    r = cb.submit(rng.integers(0, 256, (5,)).astype(np.int32), max_new=20)
+    cb.step()
+    assert len(cb.slot_pages[0]) == 1, cb.slot_pages  # not ceil(1024/512)
+    while cb.pending():
+        cb.step()
+    assert len(cb.result(r)) == 25
