@@ -182,10 +182,6 @@ class ContinuousBatcher:
         self.page = 512
         self.pages_per_slot = self.max_len // self.page
         if paged:
-            if mesh is not None:
-                raise ValueError("paged serving does not yet compose with "
-                                 "tensor-parallel meshes; use the dense "
-                                 "slot cache with mesh=")
             if not self.use_kernel and decode_kernel is not None:
                 raise ValueError("paged serving requires the decode-kernel "
                                  "path (the page table lives in its index "

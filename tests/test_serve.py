@@ -23,10 +23,10 @@ def params():
     return tfm.init(jax.random.key(0), CFG)
 
 
-def _greedy_oracle(params, prompt, max_new):
+def _greedy_oracle(params, prompt, max_new, decode_kernel=False):
     return np.asarray(gen.generate(
         params, jnp.asarray(prompt)[None], jax.random.key(1), cfg=CFG,
-        max_new=max_new, temperature=0.0, decode_kernel=False))[0]
+        max_new=max_new, temperature=0.0, decode_kernel=decode_kernel))[0]
 
 
 def test_matches_generate_oracle_with_slot_recycling(params):
@@ -353,11 +353,6 @@ def test_paged_kv_pool_matches_oracle(params):
     prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
                for L in (5, 17, 40, 9, 23)]
 
-    def oracle(p, n):
-        return np.asarray(gen.generate(
-            params, jnp.asarray(p)[None], jax.random.key(1), cfg=CFG,
-            max_new=n, temperature=0.0, decode_kernel=True))[0]
-
     cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
                            temperature=0.0, prompt_buckets=(32, 64),
                            paged=True, decode_kernel=True)
@@ -365,7 +360,7 @@ def test_paged_kv_pool_matches_oracle(params):
     results = cb.run(prompts, max_new=10)
     for rid, prompt in enumerate(prompts):
         np.testing.assert_array_equal(results[rid],
-                                      oracle(prompt, 10))
+                                      _greedy_oracle(params, prompt, 10, decode_kernel=True))
     # all usable pages returned to the free list after every request
     # retired (page 0 is the reserved scratch page)
     assert len(cb.free_pages) == cb.pool_pages - 1
@@ -422,11 +417,6 @@ def test_paged_freed_slot_writes_cannot_corrupt_recycled_pages(params):
     p_short = rng.integers(0, 256, (6,)).astype(np.int32)
     p_long = rng.integers(0, 256, (480,)).astype(np.int32)
 
-    def oracle(p, n):
-        return np.asarray(gen.generate(
-            params, jnp.asarray(p)[None], jax.random.key(1), cfg=CFG,
-            max_new=n, temperature=0.0, decode_kernel=True))[0]
-
     # usable pages = 2 (+1 scratch): long takes page A; short takes page
     # B and retires; long crosses 512 and must acquire B
     cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
@@ -438,9 +428,9 @@ def test_paged_freed_slot_writes_cannot_corrupt_recycled_pages(params):
     while cb.pending():
         cb.step()
     np.testing.assert_array_equal(cb.result(r_short),
-                                  oracle(p_short, 4))
+                                  _greedy_oracle(params, p_short, 4, decode_kernel=True))
     np.testing.assert_array_equal(cb.result(r_long),
-                                  oracle(p_long, 80))
+                                  _greedy_oracle(params, p_long, 80, decode_kernel=True))
     assert len(cb.free_pages) == 2  # both usable pages recycled
 
 
@@ -457,3 +447,26 @@ def test_paged_allocates_by_prompt_length_not_bucket(params):
     while cb.pending():
         cb.step()
     assert len(cb.result(r)) == 25
+
+
+def test_tensor_parallel_paged_serving(params):
+    """Paged pool x TP: the head-sharded page pool serves through
+    shard_map (paged decode kernel on local head shards) — oracle-exact,
+    pages recycle."""
+    from jax.sharding import Mesh, NamedSharding
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    specs = tfm.shard_specs(CFG, tp_axis="model")
+    sharded = jax.device_put(params, jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp), specs))
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (6, 45, 19)]
+
+    cb = ContinuousBatcher(sharded, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           paged=True, decode_kernel=True, mesh=mesh)
+    results = cb.run(prompts, max_new=8)
+    for rid, p in enumerate(prompts):
+        np.testing.assert_array_equal(results[rid], _greedy_oracle(params, p, 8, decode_kernel=True))
+    assert len(cb.free_pages) == cb.pool_pages - 1
