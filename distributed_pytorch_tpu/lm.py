@@ -104,7 +104,13 @@ class LMTrainConfig:
     seq_layout: str = "zigzag"
 
 
-def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
+def validate_lm_cfg(cfg: LMTrainConfig) -> None:
+    """Composition checks for (dp, ep, sp, tp, pp, interleave,
+    grad_accum).  Shared by ``make_lm_mesh`` and ``LMTrainer`` so a
+    caller-supplied mesh cannot skip them — e.g. ``LMTrainer(cfg(pp=2,
+    grad_accum=4), mesh=m)`` must raise exactly like the mesh-built path
+    (the pp step builder never reads grad_accum, so silently accepting it
+    would drop the setting)."""
     if cfg.interleave < 1:
         raise ValueError(f"interleave must be >= 1, got {cfg.interleave}")
     if cfg.interleave > 1 and cfg.pp == 1:
@@ -138,13 +144,7 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
         if cfg.tp > 1 and (cfg.model.n_heads % cfg.tp
                            or cfg.model.kv_heads % cfg.tp):
             raise ValueError(f"heads must divide over tp={cfg.tp}")
-        # pp composes with dp, sp (ring attention inside each stage's
-        # layer chunks) and tp — a 4-axis mesh; unused axes have size 1.
-        return make_mesh(cfg.dp * cfg.pp * cfg.sp * cfg.tp,
-                         axis_names=(DATA, PIPE, SEQ, MODEL),
-                         axis_shape=(cfg.dp, cfg.pp, cfg.sp, cfg.tp),
-                         devices=devices)
-    if cfg.tp > 1:
+    elif cfg.tp > 1:
         if cfg.model.n_heads % cfg.tp:
             raise ValueError(f"n_heads {cfg.model.n_heads} must divide over "
                              f"tp={cfg.tp}")
@@ -153,6 +153,17 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
                 f"n_kv_heads {cfg.model.kv_heads} must divide over "
                 f"tp={cfg.tp} (replicating kv heads across tensor ranks is "
                 f"not supported; lower tp or raise n_kv_heads)")
+
+
+def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
+    validate_lm_cfg(cfg)
+    if cfg.pp > 1:
+        # pp composes with dp, sp (ring attention inside each stage's
+        # layer chunks) and tp — a 4-axis mesh; unused axes have size 1.
+        return make_mesh(cfg.dp * cfg.pp * cfg.sp * cfg.tp,
+                         axis_names=(DATA, PIPE, SEQ, MODEL),
+                         axis_shape=(cfg.dp, cfg.pp, cfg.sp, cfg.tp),
+                         devices=devices)
     # The 'expert' axis is always present (size ep, usually 1 — free):
     # batch shards over (data, expert), expert weights over 'expert'.
     return make_mesh(cfg.dp * cfg.ep * cfg.sp * cfg.tp,
@@ -556,6 +567,10 @@ class LMTrainer:
 
     def __init__(self, cfg: LMTrainConfig, mesh: Mesh | None = None):
         self.cfg = cfg
+        # validate even with a caller-supplied mesh: an invalid axis
+        # composition (e.g. pp x grad_accum) must raise, not be silently
+        # ignored by whichever step builder does not read the setting
+        validate_lm_cfg(cfg)
         self.mesh = mesh if mesh is not None else make_lm_mesh(cfg)
         want = cfg.dp * cfg.ep * cfg.sp * cfg.tp * cfg.pp
         assert self.mesh.devices.size == want, (

@@ -669,7 +669,11 @@ def decode_attention_paged(
     if h % hkv:
         raise ValueError(f"{h} query heads do not group over {hkv} kv heads")
     if page % 8 or (page < 128 and p_blocks > 1):
-        raise ValueError(f"page size {page} must be 8-aligned and >= 128")
+        raise ValueError(
+            f"page size {page} must be 8-aligned, and >= 128 whenever the "
+            f"pool holds more than one page (got {p_blocks} pages; a "
+            f"single-page pool tolerates shorter pages since no block-table "
+            f"indirection happens)")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:

@@ -667,10 +667,16 @@ class ContinuousBatcher:
             budget[s] = (self.occupant[s].max_new
                          - len(self.occupant[s].emitted))
         if self.paged:
-            # pre-allocate pages covering this dispatch's write frontier
+            # pre-allocate pages covering this dispatch's write frontier:
+            # a slot with budget b < K retires after b steps and its
+            # remaining lockstep writes clamp at write_cap, so it needs
+            # pages only to pos + min(K, b) — allocating for the full K
+            # would demand pages it never touches and could exhaust an
+            # oversubscribed pool on a workload whose writes fit
             for s_ in live:
                 self._alloc_pages(
-                    s_, min(int(self.pos[s_]) + self.steps_per_sync,
+                    s_, min(int(self.pos[s_])
+                            + min(self.steps_per_sync, int(budget[s_])),
                             self.max_len - 1))
         # advance every live slot's write position to the new token's slot
         pos = self.pos.copy()

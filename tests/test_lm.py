@@ -584,6 +584,17 @@ def test_grad_accum_exact_trajectory():
     with pytest.raises(ValueError, match="does not compose with pp"):
         LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
                                 pp=2, grad_accum=2))
+    # ... including when the caller supplies the mesh (advisor regression,
+    # round 3: an explicit mesh must not skip cfg validation — the pp step
+    # builder never reads grad_accum, so accepting it would drop it)
+    from distributed_pytorch_tpu.lm import make_lm_mesh
+    dense = tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                  n_heads=2, head_dim=32, d_ff=128)
+    good = LMTrainConfig(model=dense, compute_dtype=None, pp=2)
+    with pytest.raises(ValueError, match="does not compose with pp"):
+        LMTrainer(LMTrainConfig(model=dense, compute_dtype=None,
+                                pp=2, grad_accum=2),
+                  mesh=make_lm_mesh(good))
     with pytest.raises(ValueError, match="does not implement gradient"):
         LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
                                 grad_accum=2)).train_steps(

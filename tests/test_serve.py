@@ -397,6 +397,32 @@ def test_paged_pool_oversubscription(params):
             cb2.step()
 
 
+def test_paged_prealloc_respects_budget(params):
+    """Advisor regression (round 3): pre-allocation must cover only
+    pos + min(steps_per_sync, budget) — the early exit never writes past
+    the budget (lockstep writes clamp at write_cap), so a short-budget
+    request on an oversubscribed pool must NOT demand pages for the full
+    K-step block it will never fill."""
+    rng = np.random.default_rng(20)
+    # two ~505-token prompts: 1 page each (pos 504 + budget 4 = 508 < 512)
+    # but pos + K = 536 would cross into a second page per slot — the old
+    # full-K pre-allocation needed 4 usable pages, the pool has 2
+    prompts = [rng.integers(0, 256, (505,)).astype(np.int32)
+               for _ in range(2)]
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(512,),
+                           paged=True, pool_pages=3, decode_kernel=True,
+                           steps_per_sync=32)
+    r1 = cb.submit(prompts[0], max_new=4)
+    r2 = cb.submit(prompts[1], max_new=4)
+    while cb.pending():
+        cb.step()
+    for r, p in ((r1, prompts[0]), (r2, prompts[1])):
+        np.testing.assert_array_equal(
+            cb.result(r), _greedy_oracle(params, p, 4, decode_kernel=True))
+    assert len(cb.free_pages) == 2
+
+
 def test_paged_validation(params):
     with pytest.raises(ValueError, match="decode-kernel"):
         ContinuousBatcher(params, CFG, paged=True, decode_kernel=False)
