@@ -45,7 +45,23 @@ TPU-first design constraints drive the shape:
   chunk of prompt per ``step()`` into a scratch cache (attending causally
   to earlier chunks), interleaved with the pool's decode dispatches — a
   long prompt never stalls running slots for more than one chunk-sized
-  dispatch.
+  dispatch;
+- **in-block slot refill** (``inblock_refill``, round 4): the decode
+  block dispatches K lockstep steps for the WHOLE pool whether or not a
+  slot has work — an empty or mid-block-retired slot costs exactly the
+  same device time computing garbage.  So instead of idling, such a slot
+  consumes its next queued request's prompt one token per step
+  (teacher-forced through the same ragged decode step, which writes the
+  prompt token's K/V and discards the logits) and starts emitting the
+  moment the prompt is exhausted — prefill and the retire→admit
+  transition ride steps that run anyway, INSIDE the compiled
+  ``while_loop``.  This closes the two block-granularity losses the
+  round-3 accounting quantified (BASELINE.md: ~25% of slot-steps wasted
+  to budget imbalance + admission idling): a retiring slot hands off to
+  the next request in the same dispatch, and admissions stop idling
+  through decode blocks.  Batched (bucketed/chunked) prefill still
+  serves an idle pool and prompts wider than the in-block prompt buffer
+  (the largest bucket).
 """
 
 from __future__ import annotations
@@ -120,6 +136,8 @@ class ContinuousBatcher:
                  steps_per_sync: int = 8,
                  prefill_chunk: int | None = None,
                  paged: bool = False, pool_pages: int | None = None,
+                 inblock_refill: bool = True,
+                 schedule: str = "fifo",
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
@@ -237,11 +255,49 @@ class ContinuousBatcher:
         self._decode_fn = None
         self._insert_fn = None
         self._insert_paged_fn = None
+        # in-block refill (see module docstring): per-slot prompt progress
+        # of the CURRENT occupant (poff >= len(prompt) = prefill complete),
+        # plus the staged next-in-line request per slot
+        self.inblock_refill = inblock_refill
+        self.refill_width = self.buckets[-1]  # in-block prompt buffer
+        # In-block ADMISSION (empty slot while the pool runs) teacher-
+        # forces at one token per lockstep step, so it is only dispatch-
+        # efficient for prompts on the order of a block (or a chunk, when
+        # chunked prefill would otherwise batch them); longer prompts
+        # keep the batched admission path.  The retire→refill HANDOFF is
+        # exempt (full buffer width): it activates inside a block that is
+        # running anyway, where the alternative is an idle slot.
+        self.inblock_admit_limit = min(
+            self.refill_width,
+            max(steps_per_sync, prefill_chunk or steps_per_sync))
+        # Queue discipline: "fifo" (arrival order), or "longest_first"
+        # (LPT: admit the largest remaining budgets first, so slots
+        # drain together and the end-of-stream tail — empty slots riding
+        # lockstep while the last long request finishes — collapses).
+        # LPT trades per-request fairness (short requests queue behind
+        # long ones) for pool utilization; batch/offline serving wants
+        # it, interactive serving keeps fifo.
+        if schedule not in ("fifo", "longest_first"):
+            raise ValueError(f"unknown schedule {schedule!r}: expected "
+                             f"'fifo' or 'longest_first'")
+        self.schedule = schedule
+        self._queue_dirty = False
+        self.slot_poff = np.zeros(slots, np.int32)
+        self.staged_refill: list[_Request | None] = [None] * slots
+        self._staged_order: list[int] = []
+        if paged:
+            self.refill_pages: list[list[int]] = [[] for _ in range(slots)]
+            self.r_table = np.zeros((slots, self.pages_per_slot), np.int32)
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
-        # vs tokens actually delivered — the block-granularity waste
+        # vs tokens actually delivered — the block-granularity waste.
+        # inblock_prefill_steps are dispatched slot-steps consumed
+        # teacher-forcing a prompt (useful work, counted separately from
+        # emitted sampled tokens); utilization = (emitted + inblock
+        # prefill) / slot_steps
         self.stats = {"decode_dispatches": 0, "slot_steps": 0,
                       "emitted_tokens": 0, "wasted_slot_steps": 0,
-                      "prefill_dispatches": 0}
+                      "prefill_dispatches": 0, "batch_admissions": 0,
+                      "inblock_prefill_steps": 0, "inblock_refills": 0}
 
     # -- submission / results --------------------------------------------
     def submit(self, prompt, max_new: int = 128, *,
@@ -280,6 +336,7 @@ class ContinuousBatcher:
             eos_id=self.eos_id if eos_id is _INHERIT else eos_id)
         self.requests[rid] = req
         self.queue.append(req)
+        self._queue_dirty = True
         return rid
 
     def pending(self) -> bool:
@@ -330,66 +387,137 @@ class ContinuousBatcher:
         return fn
 
     def _decode(self):
-        """(params, cache, tokens (slots,), pos (slots,), temp, top_k,
-        top_p, eos, budget, key) -> ((K, slots) sampled tokens,
-        steps_executed, cache) — ONE program decodes up to
-        ``steps_per_sync`` tokens for the whole pool per dispatch (each
-        step's sample feeds the next; host syncs once per block).
-        Sampling parameters are per-slot vectors (gen.sample_per_seq), so
-        requests with different settings share the dispatch.
+        """(params, cache, cur, ref, key) -> ((K, slots) sampled tokens,
+        (K, slots) emit mask, steps_executed, switch step, last write,
+        prompt offset, prefill-step count, cache) — ONE program runs up
+        to ``steps_per_sync`` lockstep steps for the whole pool per
+        dispatch.  Sampling parameters are per-slot vectors
+        (gen.sample_per_seq), so requests with different settings share
+        the dispatch.
 
-        DEVICE-SIDE EARLY EXIT: the block is a ``while_loop`` that stops
-        as soon as EVERY slot is done — its request sampled its eos
-        (``eos`` (slots,) int32, -1 = none) or exhausted its remaining
-        ``budget`` (empty slots pass budget 0 and are done immediately).
-        A 32-step block with one 3-token request left runs 3 iterations,
-        not 32; eos stops end the block at the eos, not at the sync
-        boundary — no host round-trip needed to cut the waste.  Token
-        rows beyond ``steps_executed`` are zeros and discarded."""
+        Each slot is a little state machine driven by ``cur`` (the
+        current request: input token, write position, prompt buffer +
+        offset for teacher-forced in-block prefill, sampling params,
+        remaining emit budget, write cap, page-table row) and ``ref``
+        (the staged NEXT request, same fields plus ``valid``):
+
+        - while ``poff < plen`` the slot is PREFILLING: its input is
+          ``prompt[poff]`` (the ragged decode step writes that token's
+          K/V exactly like a prefill pass would), the sampled token is
+          discarded — except at the last prompt position, whose sample
+          is the request's first emission;
+        - then it DECODES: each sampled token feeds the next step and
+          decrements ``rem``;
+        - on retirement (eos sampled, or ``rem`` exhausted) with a valid
+          ``ref`` staged, the slot SWITCHES in place: position resets to
+          0, the refill's prompt/params/budget take over, and prefill of
+          the next request begins on the very next lockstep step — the
+          retire→admit transition costs zero dispatches and zero wasted
+          slot-steps.
+
+        DEVICE-SIDE EARLY EXIT: the ``while_loop`` stops as soon as
+        every slot is done (retired with no refill staged; empty slots
+        pass ``rem=0`` and are done immediately).  Done slots keep
+        computing in lockstep; their writes clamp at their allocated
+        frontier (``cap``) so they cannot touch pages/rows they do not
+        own.  Token rows beyond ``steps_executed`` are discarded; the
+        emit mask distinguishes sampled emissions from prefill steps."""
         if self._decode_fn is None:
             cfg, dtype = self.cfg, self.dtype
             use_kernel = self.use_kernel
-            k_steps, max_len = self.steps_per_sync, self.max_len
+            k_steps = self.steps_per_sync
             n_slots = self.slots
+            width = self.refill_width
 
             tp = self.tp_axis if self.mesh is not None else None
 
             paged = self.paged
+            rows = np.arange(n_slots)
 
-            def block_body(params, cache, tokens, pos, temp, top_k, top_p,
-                           eos, budget, write_cap, table, key):
+            def block_body(params, cache, cur, ref, key):
                 buf0 = jnp.zeros((k_steps, n_slots), jnp.int32)
-                done0 = budget <= 0
+                mask0 = jnp.zeros((k_steps, n_slots), jnp.bool_)
+                done0 = cur["rem"] <= 0
+                c0 = dict(i=jnp.int32(0), cache=cache, tok=cur["tokens"],
+                          pos=cur["pos"], poff=cur["poff"],
+                          active=jnp.zeros((n_slots,), jnp.bool_),
+                          rem=cur["rem"], done=done0, key=key, buf=buf0,
+                          mask=mask0,
+                          sw=jnp.full((n_slots,), k_steps + 1, jnp.int32),
+                          lw=cur["pos"],
+                          pf=jnp.zeros((n_slots,), jnp.int32))
 
-                def cond(carry):
-                    i, done = carry[0], carry[5]
-                    return (i < k_steps) & ~jnp.all(done)
+                def cond(c):
+                    return (c["i"] < k_steps) & ~jnp.all(c["done"])
 
-                def body(carry):
-                    i, cache, tokens, pos, key, done, buf = carry
-                    logits, cache = gen.decode_step_ragged(
-                        params, cache, tokens, pos, cfg=cfg, dtype=dtype,
-                        tp_axis=tp, use_decode_kernel=use_kernel,
-                        page_table=table if paged else None)
-                    key, sub = jax.random.split(key)
-                    toks = gen.sample_per_seq(sub, logits, temp, top_k,
-                                              top_p)
+                def sel(a, b, active):
+                    return jnp.where(active, a, b)
+
+                def body(c):
+                    i, active = c["i"], c["active"]
+                    plen_eff = sel(ref["plen"], cur["plen"], active)
+                    in_pf = c["poff"] < plen_eff
+                    prow = jnp.where(active[:, None], ref["prompt"],
+                                     cur["prompt"])
+                    ptok = prow[rows, jnp.minimum(c["poff"], width - 1)]
+                    itok = jnp.where(in_pf, ptok, c["tok"])
+                    cap_eff = sel(ref["cap"], cur["cap"], active)
+                    table_eff = (jnp.where(active[:, None], ref["table"],
+                                           cur["table"])
+                                 if paged else None)
+                    logits, new_cache = gen.decode_step_ragged(
+                        params, c["cache"], itok, c["pos"], cfg=cfg,
+                        dtype=dtype, tp_axis=tp,
+                        use_decode_kernel=use_kernel,
+                        page_table=table_eff)
+                    key, sub = jax.random.split(c["key"])
+                    toks = gen.sample_per_seq(
+                        sub, logits,
+                        sel(ref["temp"], cur["temp"], active),
+                        sel(ref["top_k"], cur["top_k"], active),
+                        sel(ref["top_p"], cur["top_p"], active))
+                    # the last prompt position's sample is the first
+                    # emission; earlier prefill steps discard theirs
+                    last_pf = in_pf & (c["poff"] + 1 >= plen_eff)
+                    emit = ~c["done"] & (~in_pf | last_pf)
                     buf = jax.lax.dynamic_update_index_in_dim(
-                        buf, toks, i, 0)
-                    done = done | ((toks == eos) & (eos >= 0)) \
-                        | (i + 1 >= budget)
-                    # done sequences keep computing in lockstep until the
-                    # block exits; their writes clamp at their own
-                    # ALLOCATED frontier (per-slot write_cap) — under
-                    # paging, advancing past it would dereference table
-                    # entries the slot does not own
-                    pos = jnp.minimum(pos + 1, write_cap)
-                    return (i + 1, cache, toks, pos, key, done, buf)
+                        c["buf"], toks, i, 0)
+                    mask = jax.lax.dynamic_update_index_in_dim(
+                        c["mask"], emit, i, 0)
+                    pf = c["pf"] + (~c["done"] & in_pf
+                                    & ~last_pf).astype(jnp.int32)
+                    rem = c["rem"] - emit.astype(jnp.int32)
+                    eos_eff = sel(ref["eos"], cur["eos"], active)
+                    fin = emit & (((toks == eos_eff) & (eos_eff >= 0))
+                                  | (rem <= 0))
+                    switch = fin & ~active & ref["valid"]
+                    done = c["done"] | (fin & ~switch)
+                    # last meaningful write position (done slots'
+                    # lockstep writes are garbage clamped at cap)
+                    lw = jnp.where(~c["done"], c["pos"], c["lw"])
+                    poff = jnp.where(in_pf, c["poff"] + 1, c["poff"])
+                    pos = jnp.minimum(c["pos"] + 1, cap_eff)
+                    # in-place handoff: the refill takes over at pos 0
+                    poff = jnp.where(switch, 0, poff)
+                    pos = jnp.where(switch, 0, pos)
+                    rem = jnp.where(switch, ref["budget"], rem)
+                    return dict(
+                        i=i + 1, cache=new_cache, tok=toks, pos=pos,
+                        poff=poff, active=active | switch, rem=rem,
+                        done=done, key=key, buf=buf, mask=mask,
+                        sw=jnp.where(switch, i + 1, c["sw"]), lw=lw,
+                        pf=pf)
 
-                i, cache, _, _, _, _, buf = jax.lax.while_loop(
-                    cond, body, (jnp.int32(0), cache, tokens, pos, key,
-                                 done0, buf0))
-                return buf, i, cache
+                c = jax.lax.while_loop(cond, body, c0)
+                # pack every host-bound output into ONE int32 vector:
+                # through a tunneled chip each fetched buffer pays a full
+                # round-trip, so the block's results must be one transfer
+                packed = jnp.concatenate([
+                    c["buf"].reshape(-1),
+                    c["mask"].astype(jnp.int32).reshape(-1),
+                    c["sw"], c["lw"], c["poff"], c["pf"],
+                    c["i"][None]])
+                return packed, c["cache"]
 
             if self.mesh is None:
                 self._decode_fn = jax.jit(block_body, donate_argnums=(1,))
@@ -399,9 +527,8 @@ class ContinuousBatcher:
                 self._decode_fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P(), P(), P(), P(), P(), P(),
-                              P(), P()),
-                    out_specs=(P(), P(), self._cache_spec)),
+                              P(), P(), P()),
+                    out_specs=(P(), self._cache_spec)),
                     donate_argnums=(1,))
         return self._decode_fn
 
@@ -480,15 +607,39 @@ class ContinuousBatcher:
         self.table[slot, :] = 0
         self.pos[slot] = 0
 
-    def _write_caps(self) -> np.ndarray:
+    def _write_caps(self, pages: list[list[int]] | None = None
+                    ) -> np.ndarray:
         """Per-slot last writable position: the allocated frontier under
         paging (in-block writes must never dereference unowned table
-        entries), max_len-1 for the dense cache."""
+        entries), max_len-1 for the dense cache.  ``pages`` defaults to
+        the occupants' page lists; pass ``self.refill_pages`` for the
+        staged refills' caps."""
         if not self.paged:
             return np.full(self.slots, self.max_len - 1, np.int32)
         return np.asarray(
-            [max(len(p) * self.page - 1, 0) for p in self.slot_pages],
+            [max(len(p) * self.page - 1, 0)
+             for p in (self.slot_pages if pages is None else pages)],
             np.int32)
+
+    def _alloc_refill_pages(self, slot: int) -> bool:
+        """Reserve pages for a staged refill's worst-case in-block writes
+        (it activates at step >= 1, so at most steps_per_sync - 1
+        positions).  Returns False instead of raising when the pool
+        cannot cover it — the request then simply stays queued."""
+        upto = min(max(self.steps_per_sync - 2, 0), self.max_len - 1)
+        need = upto // self.page + 1
+        if len(self.free_pages) < need:
+            return False
+        pages = [self.free_pages.popleft() for _ in range(need)]
+        self.refill_pages[slot] = pages
+        self.r_table[slot, :] = 0
+        self.r_table[slot, :need] = pages
+        return True
+
+    def _release_refill_pages(self, slot: int) -> None:
+        self.free_pages.extend(self.refill_pages[slot])
+        self.refill_pages[slot] = []
+        self.r_table[slot, :] = 0
 
     def _insert_paged(self, slabs, slot: int) -> None:
         """Scatter a prefill's (1, hkv, bucket, d) slabs into this slot's
@@ -545,16 +696,59 @@ class ContinuousBatcher:
             jnp.full((1,), req.top_k, jnp.int32),
             jnp.full((1,), req.top_p, jnp.float32))[0])
 
-    def _occupy(self, slot: int, req: _Request, first_tok: int,
-                out: list) -> None:
-        """Install an admitted request into its slot and emit token 0."""
-        self.occupant[slot] = req
-        self.pos[slot] = len(req.prompt) - 1
+    def _set_slot_params(self, slot: int, req: _Request) -> None:
         self.slot_temp[slot] = req.temperature
         self.slot_topk[slot] = req.top_k
         self.slot_topp[slot] = req.top_p
         self.slot_eos[slot] = -1 if req.eos_id is None else req.eos_id
+
+    def _occupy(self, slot: int, req: _Request, first_tok: int,
+                out: list) -> None:
+        """Install a batch-prefilled request into its slot and emit
+        token 0 (its K/V is already in the pool; prefill complete)."""
+        self.occupant[slot] = req
+        self.pos[slot] = len(req.prompt) - 1
+        self.slot_poff[slot] = len(req.prompt)
+        self._set_slot_params(slot, req)
+        # each batch-prefilled admission emits exactly one token from
+        # its prefill dispatch(es); accounting needs this count (NOT
+        # prefill_dispatches — chunked admissions take several)
+        self.stats["batch_admissions"] += 1
         self._emit(slot, first_tok, out)
+
+    def _occupy_prefilling(self, slot: int, req: _Request) -> bool:
+        """Install a queued request into an empty slot with NO prefill
+        done yet: its prompt will be teacher-forced inside the decode
+        block (in-block admission), one token per lockstep step.  Under
+        paging, reserves pages for the first block's writes; returns
+        False (request stays queued) when the pool cannot cover them."""
+        if self.paged:
+            k = self.steps_per_sync
+            upto = min(k, len(req.prompt) + min(k, req.max_new)) - 1
+            upto = min(upto, self.max_len - 1)
+            need = min(upto // self.page + 1, self.pages_per_slot)
+            if len(self.free_pages) < need:
+                return False
+            self._alloc_pages(slot, upto)
+        self.occupant[slot] = req
+        self.pos[slot] = 0
+        self.slot_poff[slot] = 0
+        self.last_tok[slot] = 0
+        self._set_slot_params(slot, req)
+        return True
+
+    def _install_refill(self, slot: int, req: _Request) -> None:
+        """The device switched this slot to its staged refill mid-block:
+        mirror that on the host — the refill becomes the occupant and
+        (under paging) its reserved pages become the slot's pages (the
+        retired occupant's pages were already released by ``_emit``)."""
+        self.occupant[slot] = req
+        self._set_slot_params(slot, req)
+        if self.paged:
+            self.slot_pages[slot] = self.refill_pages[slot]
+            self.refill_pages[slot] = []
+            self.table[slot, :] = self.r_table[slot]
+            self.r_table[slot, :] = 0
 
     def _fill_free_slots(self) -> list[tuple[int, int]]:
         """Unchunked admission: prefill queued requests into free slots in
@@ -643,67 +837,216 @@ class ContinuousBatcher:
         else:
             self.last_tok[slot] = tok
 
-    def step(self) -> list[tuple[int, int]]:
-        """Admit queued work (whole-bucket, or one chunk per admission with
-        ``prefill_chunk``), then decode ``steps_per_sync`` tokens for every
-        active slot in one device dispatch.
+    def _stage_refills(self) -> None:
+        """Pop queued requests behind occupants that can plausibly retire
+        this block (budget reachable, or an eos armed), so the device can
+        hand their slot over in place.  Every prompt fits the in-block
+        buffer (``submit`` rejects prompts over the largest bucket ==
+        ``refill_width``).  Unused staged requests are returned to the
+        queue front after the block."""
+        k = self.steps_per_sync
+        for slot in range(self.slots):
+            if not self.queue:
+                break
+            occ = self.occupant[slot]
+            if (occ is None or slot in self.admitting
+                    or self.staged_refill[slot] is not None):
+                continue
+            pr = max(len(occ.prompt) - int(self.slot_poff[slot]), 0)
+            rem = occ.max_new - len(occ.emitted)
+            if pr >= k or (pr + rem > k and occ.eos_id is None):
+                # cannot retire this block (prompt alone spans it, or
+                # budget unreachable with no eos armed): don't hold a
+                # request (or pages) hostage behind it
+                continue
+            if self.paged and not self._alloc_refill_pages(slot):
+                break
+            self.staged_refill[slot] = self.queue.popleft()
+            self._staged_order.append(slot)
 
-        Returns (rid, token) pairs emitted this call, in per-slot sampling
-        order (admissions emit their first sampled token here too).  A
-        sequence finishing mid-block stops emitting there; its slot refills
-        on the next call.
+    def _requeue_unused_refills(self) -> None:
+        for slot in reversed(self._staged_order):
+            req = self.staged_refill[slot]
+            if req is not None:
+                self.staged_refill[slot] = None
+                if self.paged:
+                    self._release_refill_pages(slot)
+                self.queue.appendleft(req)
+        self._staged_order.clear()
+
+    def _req_fields(self, req: _Request):
+        """(temp, top_k, top_p, eos, budget) staging vectors' entries."""
+        return (req.temperature, req.top_k, req.top_p,
+                -1 if req.eos_id is None else req.eos_id, req.max_new)
+
+    def step(self) -> list[tuple[int, int]]:
+        """Admit queued work, then run one decode block (up to
+        ``steps_per_sync`` lockstep steps) for the whole pool in one
+        device dispatch.
+
+        With ``inblock_refill`` (default), admission into an empty slot
+        while the pool is running costs nothing: the request's prompt is
+        teacher-forced inside the block (one token per lockstep step that
+        runs anyway), and slots whose occupant retires mid-block hand
+        over to a staged next request in place.  Batched (bucketed /
+        chunked) prefill serves an idle pool and prompts wider than the
+        in-block prompt buffer.
+
+        Returns (rid, token) pairs emitted this call, in per-slot
+        sampling order.
         """
+        out: list[tuple[int, int]] = []
+        if (self.schedule == "longest_first" and self._queue_dirty
+                and len(self.queue) > 1):
+            # stable sort once per batch of submissions (dirty flag), not
+            # per block; requeued unused refills re-enter at the front
+            # they were popped from, preserving order
+            self.queue = deque(sorted(self.queue,
+                                      key=lambda r: -r.max_new))
+        self._queue_dirty = False
+        live_any = any(o is not None for o in self.occupant)
+        use_inblock = self.inblock_refill and live_any
+        if use_inblock:
+            # in-block admission: empty slots take narrow queued requests
+            # and prefill them inside the running block
+            for slot in range(self.slots):
+                if (self.occupant[slot] is not None
+                        or slot in self.admitting or not self.queue):
+                    continue
+                if len(self.queue[0].prompt) > self.inblock_admit_limit:
+                    break  # strict FIFO: long head admits batched below
+                req = self.queue.popleft()
+                if not self._occupy_prefilling(slot, req):
+                    self.queue.appendleft(req)  # page pool full: wait
+                    break
         if self.prefill_chunk is None:
-            out = self._fill_free_slots()
+            if not use_inblock or (
+                    self.queue and len(self.queue[0].prompt)
+                    > self.inblock_admit_limit):
+                out += self._fill_free_slots()
         else:
-            out = self._advance_admissions()
+            out += self._advance_admissions()
         live = [s for s in range(self.slots) if self.occupant[s] is not None]
         if not live:
             return out
-        # per-slot remaining budgets drive the device-side early exit
-        # (empty slots: 0 — they never extend the block)
+        k = self.steps_per_sync
+        # per-slot staging: remaining budgets drive the device-side early
+        # exit (empty slots: 0 — they never extend the block); mid-prefill
+        # occupants carry their prompt + offset for teacher-forcing
         budget = np.zeros(self.slots, np.int32)
+        plen = np.zeros(self.slots, np.int32)
+        poff = np.zeros(self.slots, np.int32)
+        prompt = np.zeros((self.slots, self.refill_width), np.int32)
+        pos = self.pos.copy()
         for s in live:
-            budget[s] = (self.occupant[s].max_new
-                         - len(self.occupant[s].emitted))
+            occ = self.occupant[s]
+            budget[s] = occ.max_new - len(occ.emitted)
+            if self.slot_poff[s] < len(occ.prompt):
+                plen[s] = len(occ.prompt)
+                poff[s] = self.slot_poff[s]
+                prompt[s, :plen[s]] = occ.prompt
+                pos[s] = poff[s]  # next write = next prompt position
+            else:
+                # established: advance to the new token's write position
+                pos[s] = min(pos[s] + 1, self.max_len - 1)
         if self.paged:
             # pre-allocate pages covering this dispatch's write frontier:
-            # a slot with budget b < K retires after b steps and its
-            # remaining lockstep writes clamp at write_cap, so it needs
-            # pages only to pos + min(K, b) — allocating for the full K
-            # would demand pages it never touches and could exhaust an
-            # oversubscribed pool on a workload whose writes fit
-            for s_ in live:
+            # min(K, prompt-left + min(K, budget)) writes from pos — a
+            # slot that retires early clamps at its frontier, so the
+            # block never needs pages past its real writes
+            for s in live:
+                pr = int(plen[s]) - int(poff[s]) if plen[s] else 0
+                writes = min(k, pr + min(k, int(budget[s])))
                 self._alloc_pages(
-                    s_, min(int(self.pos[s_])
-                            + min(self.steps_per_sync, int(budget[s_])),
-                            self.max_len - 1))
-        # advance every live slot's write position to the new token's slot
-        pos = self.pos.copy()
-        pos[live] = np.minimum(pos[live] + 1, self.max_len - 1)
+                    s, min(int(pos[s]) + writes - 1, self.max_len - 1))
+        if use_inblock:
+            self._stage_refills()
+        r_valid = np.zeros(self.slots, bool)
+        r_plen = np.zeros(self.slots, np.int32)
+        r_prompt = np.zeros((self.slots, self.refill_width), np.int32)
+        r_temp = np.ones(self.slots, np.float32)
+        r_topk = np.zeros(self.slots, np.int32)
+        r_topp = np.ones(self.slots, np.float32)
+        r_eos = np.full(self.slots, -1, np.int32)
+        r_budget = np.zeros(self.slots, np.int32)
+        for s, req in enumerate(self.staged_refill):
+            if req is None:
+                continue
+            r_valid[s] = True
+            r_plen[s] = len(req.prompt)
+            r_prompt[s, :r_plen[s]] = req.prompt
+            (r_temp[s], r_topk[s], r_topp[s], r_eos[s],
+             r_budget[s]) = self._req_fields(req)
+        if self.paged:
+            r_cap = self._write_caps(self.refill_pages)
+            r_table = self.r_table
+        else:
+            r_cap = np.full(self.slots, self.max_len - 1, np.int32)
+            r_table = np.zeros((self.slots, 1), np.int32)
+        table = (self.table if self.paged
+                 else np.zeros((self.slots, 1), np.int32))
+        cur = dict(tokens=jnp.asarray(self.last_tok),
+                   pos=jnp.asarray(pos), poff=jnp.asarray(poff),
+                   plen=jnp.asarray(plen), prompt=jnp.asarray(prompt),
+                   temp=jnp.asarray(self.slot_temp),
+                   top_k=jnp.asarray(self.slot_topk),
+                   top_p=jnp.asarray(self.slot_topp),
+                   eos=jnp.asarray(self.slot_eos),
+                   rem=jnp.asarray(budget),
+                   cap=jnp.asarray(self._write_caps()),
+                   table=jnp.asarray(table))
+        ref = dict(valid=jnp.asarray(r_valid),
+                   plen=jnp.asarray(r_plen), prompt=jnp.asarray(r_prompt),
+                   temp=jnp.asarray(r_temp), top_k=jnp.asarray(r_topk),
+                   top_p=jnp.asarray(r_topp), eos=jnp.asarray(r_eos),
+                   budget=jnp.asarray(r_budget), cap=jnp.asarray(r_cap),
+                   table=jnp.asarray(r_table))
         self.key, sub = jax.random.split(self.key)
-        table = jnp.asarray(self.table if self.paged
-                            else np.zeros((self.slots, 1), np.int32))
-        toks, steps_exec, self.cache = self._decode()(
-            self.params, self.cache, jnp.asarray(self.last_tok),
-            jnp.asarray(pos), jnp.asarray(self.slot_temp),
-            jnp.asarray(self.slot_topk), jnp.asarray(self.slot_topp),
-            jnp.asarray(self.slot_eos), jnp.asarray(budget),
-            jnp.asarray(self._write_caps()), table, sub)
-        toks = np.asarray(toks)  # (K, slots); rows >= steps_exec are zeros
-        k_steps = int(steps_exec)
+        packed, self.cache = self._decode()(self.params, self.cache, cur,
+                                            ref, sub)
+        flat = np.asarray(packed)  # ONE device->host transfer per block
+        kn, n = k * self.slots, self.slots
+        toks = flat[:kn].reshape(k, n)  # rows >= steps_exec unused
+        mask = flat[kn:2 * kn].reshape(k, n).astype(bool)
+        sw = flat[2 * kn:2 * kn + n]
+        lw = flat[2 * kn + n:2 * kn + 2 * n]
+        poff_f = flat[2 * kn + 2 * n:2 * kn + 3 * n]
+        pf = flat[2 * kn + 3 * n:2 * kn + 4 * n]
+        k_exec = int(flat[-1])
         self.stats["decode_dispatches"] += 1
-        self.stats["slot_steps"] += k_steps * self.slots
+        self.stats["slot_steps"] += k_exec * self.slots
+        self.stats["inblock_prefill_steps"] += int(np.sum(pf))
         emitted_before = self.stats["emitted_tokens"]
         for s in live:
-            self.pos[s] = min(int(pos[s]) + k_steps - 1, self.max_len - 1)
-            for i in range(k_steps):
-                if self.occupant[s] is None:
-                    break  # retired mid-block: discard the tail
-                self._emit(s, int(toks[i, s]), out)
+            cut = min(int(sw[s]), k_exec)
+            for i in range(cut):
+                if mask[i, s] and self.occupant[s] is not None:
+                    self._emit(s, int(toks[i, s]), out)
+            if self.occupant[s] is not None:
+                # current request continues; carry prefill progress only
+                # for slots staged mid-prefill (the device's poff is 0,
+                # not len(prompt), for established slots)
+                if plen[s]:
+                    self.slot_poff[s] = int(poff_f[s])
+                self.pos[s] = int(lw[s])
+            elif int(sw[s]) <= k_exec:
+                # the device switched this slot to its staged refill
+                req = self.staged_refill[s]
+                self.staged_refill[s] = None
+                self._staged_order.remove(s)
+                self._install_refill(s, req)
+                self.stats["inblock_refills"] += 1
+                for i in range(int(sw[s]), k_exec):
+                    if mask[i, s] and self.occupant[s] is not None:
+                        self._emit(s, int(toks[i, s]), out)
+                if self.occupant[s] is not None:
+                    self.slot_poff[s] = int(poff_f[s])
+                    self.pos[s] = int(lw[s])
+        self._requeue_unused_refills()
         self.stats["wasted_slot_steps"] += (
-            k_steps * self.slots
-            - (self.stats["emitted_tokens"] - emitted_before))
+            k_exec * self.slots
+            - (self.stats["emitted_tokens"] - emitted_before)
+            - int(np.sum(pf)))
         return out
 
     def run(self, prompts, max_new: int = 128) -> dict[int, np.ndarray]:

@@ -1,0 +1,113 @@
+"""Serving slot-step accounting benchmark (BASELINE.md serving table).
+
+Reproduces the round-3 measured workloads — ragged requests (16-96-token
+prompts, 64-512-token budgets) over a fixed slot pool, d512/4L model,
+bf16 on TPU, Pallas decode kernel, steps_per_sync=32 — and reports
+``ContinuousBatcher.stats``-based utilization: (emitted decode tokens +
+in-block prefill steps) / dispatched slot-steps.  Waste is split by
+WHEN it occurred: ``while_queued`` (work was available — a scheduling
+loss) vs ``queue_drained`` (tail imbalance after the last admission —
+only batch compaction could reclaim these).
+
+Run:  PYTHONPATH=. python scripts/bench_serving.py [--slots 4 --requests 16]
+"""
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.models import transformer as tfm
+from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+
+def build_workload(n_requests: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 4096, (int(rng.integers(16, 97)),))
+               .astype(np.int32) for _ in range(n_requests)]
+    budgets = [int(rng.integers(64, 513)) for _ in range(n_requests)]
+    return prompts, budgets
+
+
+def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    waste = {"while_queued": 0, "queue_drained": 0}
+    t0 = time.perf_counter()
+    while cb.pending():
+        queued = bool(cb.queue) or bool(cb.admitting)
+        w0 = cb.stats["wasted_slot_steps"]
+        cb.step()
+        waste["while_queued" if queued else "queue_drained"] += (
+            cb.stats["wasted_slot_steps"] - w0)
+    wall = time.perf_counter() - t0
+    total = sum(len(cb.result(r)) - len(p) for r, p in zip(rids, prompts))
+    s = cb.stats
+    # useful slot-steps: sampled emissions from decode dispatches plus
+    # in-block teacher-forced prefill steps (prompt work that replaces a
+    # separate prefill dispatch); each batch-prefilled ADMISSION (not
+    # each prefill dispatch — chunked admissions take several) emits its
+    # first token from prefill, not a slot-step
+    useful = (s["emitted_tokens"] - s["batch_admissions"]
+              + s["inblock_prefill_steps"])
+    util = useful / max(s["slot_steps"], 1)
+    return {"requests": len(prompts), "slots": cb.slots,
+            "tokens": total, "wall_s": round(wall, 2),
+            "tok_per_s": round(total / wall, 1),
+            "slot_steps": s["slot_steps"],
+            "emitted": s["emitted_tokens"],
+            "inblock_prefill": s["inblock_prefill_steps"],
+            "inblock_refills": s["inblock_refills"],
+            "wasted": s["wasted_slot_steps"],
+            "utilization": round(util, 4),
+            "decode_dispatches": s["decode_dispatches"],
+            "prefill_dispatches": s["prefill_dispatches"],
+            "waste_when": waste}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--steps-per-sync", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--no-refill", action="store_true",
+                    help="disable in-block refill (the round-3 "
+                    "behavior), for the contrast")
+    ap.add_argument("--schedule", default="fifo",
+                    choices=("fifo", "longest_first"))
+    args = ap.parse_args()
+
+    cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
+                                n_heads=8, head_dim=64, d_ff=2048)
+    params = tfm.init(jax.random.key(0), cfg)
+    on_tpu = jax.default_backend() != "cpu"
+    prompts, budgets = build_workload(args.requests, args.seed)
+
+    kw = {}
+    if args.no_refill:
+        kw["inblock_refill"] = False
+
+    def make():
+        return ContinuousBatcher(
+            params, cfg, slots=args.slots, max_len=1024, temperature=0.0,
+            dtype=jnp.bfloat16 if on_tpu else None,
+            prompt_buckets=(32, 128), steps_per_sync=args.steps_per_sync,
+            prefill_chunk=args.prefill_chunk, schedule=args.schedule,
+            **kw)
+
+    # cold pass compiles; the reported (timed) pass reuses its compiled
+    # fns through a fresh batcher, so tok/s is warm and stats are clean
+    cold = make()
+    run(cold, prompts, budgets)
+    cb = make()
+    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
+                 "_insert_fn", "_insert_paged_fn"):
+        setattr(cb, attr, getattr(cold, attr))
+    print(json.dumps(run(cb, prompts, budgets)))
+
+
+if __name__ == "__main__":
+    main()

@@ -231,9 +231,11 @@ def test_sample_per_seq_matches_scalar_sample(params):
 
 
 def test_serving_stats_account_for_every_slot_step(params):
-    """Accounting identity: slot_steps == emitted decode tokens + wasted
-    (idle or discarded) slot-steps; admissions' first tokens come from
-    prefill, not decode dispatches."""
+    """Accounting identity: slot_steps == emitted decode tokens +
+    in-block prefill steps + wasted (idle or discarded) slot-steps.
+    Batch-prefilled admissions emit their first token from the prefill
+    dispatch (one per bucketed prefill); in-block admitted/refilled
+    requests emit everything from decode dispatches."""
     rng = np.random.default_rng(9)
     prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
                for L in (5, 9, 14)]
@@ -242,11 +244,30 @@ def test_serving_stats_account_for_every_slot_step(params):
                            steps_per_sync=4)
     results = cb.run(prompts, max_new=6)
     s = cb.stats
-    n_prefill_tokens = len(prompts)  # one first-token emit per admission
-    decode_emitted = s["emitted_tokens"] - n_prefill_tokens
-    assert s["slot_steps"] == decode_emitted + s["wasted_slot_steps"], s
-    assert s["decode_dispatches"] > 0 and s["prefill_dispatches"] == 3
+    # one first-token emit per batch-prefilled admission; the rest
+    # entered in-block
+    decode_emitted = s["emitted_tokens"] - s["batch_admissions"]
+    assert s["slot_steps"] == (decode_emitted
+                               + s["inblock_prefill_steps"]
+                               + s["wasted_slot_steps"]), s
+    # the initial wave batch-prefills (idle pool); the third request
+    # enters through the in-block path (admission or retire handoff)
+    assert s["decode_dispatches"] > 0 and s["batch_admissions"] == 2
+    assert s["inblock_prefill_steps"] > 0
     assert all(len(results[r]) == len(prompts[r]) + 6 for r in results)
+
+    # the round-3 behavior is preserved under inblock_refill=False:
+    # every admission batch-prefills and the old identity holds
+    cb2 = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                            temperature=0.0, prompt_buckets=(32,),
+                            steps_per_sync=4, inblock_refill=False)
+    results2 = cb2.run(prompts, max_new=6)
+    s2 = cb2.stats
+    assert s2["prefill_dispatches"] == 3 and s2["batch_admissions"] == 3
+    assert s2["inblock_prefill_steps"] == 0 and s2["inblock_refills"] == 0
+    assert s2["slot_steps"] == (s2["emitted_tokens"] - 3
+                                + s2["wasted_slot_steps"]), s2
+    assert all(len(results2[r]) == len(prompts[r]) + 6 for r in results2)
 
 
 def test_tensor_parallel_chunked_prefill(params):
@@ -395,6 +416,98 @@ def test_paged_pool_oversubscription(params):
     with pytest.raises(RuntimeError, match="pool exhausted"):
         while cb2.pending():
             cb2.step()
+
+
+def test_inblock_refill_handoff_exact_and_utilized(params):
+    """In-block refill (round 4): slots retiring mid-block hand over to
+    the next queued request inside the same compiled block (teacher-
+    forced prefill through the ragged decode step), so ragged budgets
+    stop wasting slot-steps.  Exactness through multiple handoffs is
+    oracle-pinned, refills actually trigger, and the accounting shows
+    the waste collapsing vs the same workload with refill disabled."""
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (5, 17, 40, 9, 23, 12, 31, 7)]
+    budgets = [3, 25, 7, 18, 4, 30, 9, 5]   # ragged: retirements mid-block
+
+    def serve(**kw):
+        cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                               temperature=0.0, prompt_buckets=(32, 64),
+                               steps_per_sync=16, **kw)
+        rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+        while cb.pending():
+            cb.step()
+        return cb, rids
+
+    cb, rids = serve()
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(cb.result(rid),
+                                      _greedy_oracle(params, p, b))
+    assert cb.stats["inblock_refills"] >= 3, cb.stats
+    useful = (cb.stats["emitted_tokens"] - cb.stats["batch_admissions"]
+              + cb.stats["inblock_prefill_steps"])
+    util = useful / cb.stats["slot_steps"]
+
+    off, _ = serve(inblock_refill=False)
+    useful_off = off.stats["emitted_tokens"] - off.stats["batch_admissions"]
+    util_off = useful_off / off.stats["slot_steps"]
+    assert util > util_off, (util, util_off)
+    # the remaining waste on this tiny workload is the drained-queue
+    # tail (the last long request finishing alone); the >=90% target on
+    # the BASELINE workloads is measured by scripts/bench_serving.py
+    assert util >= 0.85, (util, cb.stats)
+
+
+def test_longest_first_schedule_exact_and_validated(params):
+    """LPT queue discipline: every request still lands oracle-exact
+    (admission order cannot change a greedy request's tokens — KV slots
+    are isolated), long budgets are served first, and unknown schedule
+    names raise."""
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (5, 17, 40, 9)]
+    budgets = [3, 30, 8, 21]
+    cb = ContinuousBatcher(params, CFG, slots=1, max_len=512,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           steps_per_sync=8, schedule="longest_first")
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    first_done = None
+    while cb.pending():
+        for rid, _ in cb.step():
+            if first_done is None and cb.requests[rid].done:
+                first_done = rid
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(cb.result(rid),
+                                      _greedy_oracle(params, p, b))
+    # with one slot, the largest budget (request 1) must finish first
+    assert first_done == rids[1], first_done
+    with pytest.raises(ValueError, match="schedule"):
+        ContinuousBatcher(params, CFG, schedule="shortest_first")
+
+
+def test_inblock_refill_paged_handoff_exact(params):
+    """The paged twin: the handoff switches the slot's block-table row to
+    the refill's reserved pages inside the block — oracle-exact, and
+    every page recycles."""
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (5, 17, 40, 9, 23, 12)]
+    budgets = [3, 25, 7, 18, 4, 30]
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           steps_per_sync=16, paged=True,
+                           decode_kernel=True)
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    while cb.pending():
+        cb.step()
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(
+            cb.result(rid), _greedy_oracle(params, p, b,
+                                           decode_kernel=True))
+    assert cb.stats["inblock_refills"] >= 2, cb.stats
+    assert len(cb.free_pages) == cb.pool_pages - 1
+    assert all(not p for p in cb.slot_pages)
+    assert all(not p for p in cb.refill_pages)
 
 
 def test_paged_prealloc_respects_budget(params):
