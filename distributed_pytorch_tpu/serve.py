@@ -61,7 +61,14 @@ TPU-first design constraints drive the shape:
   the next request in the same dispatch, and admissions stop idling
   through decode blocks.  Batched (bucketed/chunked) prefill still
   serves an idle pool and prompts wider than the in-block prompt buffer
-  (the largest bucket).
+  (the largest bucket);
+- **preemption** (round 4, ``paged=True``): when live sequences outgrow
+  an oversubscribed page pool, the youngest occupant is host-swapped —
+  its pages gather to host memory in one packed fetch, the request
+  waits on a resume queue, and the pages scatter back when the pool has
+  room — instead of raising.  Host-swap rather than re-prefill because
+  the generated prefix can exceed every compiled prompt bucket; the
+  request resumes mid-generation with bitwise-identical KV.
 """
 
 from __future__ import annotations
@@ -108,6 +115,23 @@ class _Admission:
     cache: object                 # (1, hkv, bucket, d) scratch slabs
     bucket: int
     off: int = 0                  # tokens prefilled so far
+    last_logits: object = None    # set once the final chunk ran; the
+    #                               install can then wait for pool pages
+
+
+@dataclass
+class _Swapped:
+    """A preempted request: its KV pages live on the HOST until the pool
+    can take it back (serve paged=True).  Host-swap rather than requeue-
+    and-re-prefill because the generated prefix can outgrow every
+    compiled prompt bucket — restoring the pages bitwise keeps the
+    request exactly where it was, mid-generation."""
+    req: _Request
+    kv: np.ndarray                # (n_leaves, n_pages, hkv, page, d)
+    n_pages: int
+    pos: int                      # last written position
+    poff: int                     # prompt progress (mid-prefill victims)
+    last_tok: int
 
 
 class ContinuousBatcher:
@@ -288,6 +312,13 @@ class ContinuousBatcher:
         if paged:
             self.refill_pages: list[list[int]] = [[] for _ in range(slots)]
             self.r_table = np.zeros((slots, self.pages_per_slot), np.int32)
+            # preemption: victims host-swap their pages and wait here;
+            # admission sequence numbers pick the YOUNGEST victim
+            self.swapped: deque[_Swapped] = deque()
+            self.slot_admit_seq = np.zeros(slots, np.int64)
+            self._admit_counter = 0
+            self._gather_fn = None
+            self._scatter_fn = None
         # accounting (BASELINE.md serving roofline): slot-steps dispatched
         # vs tokens actually delivered — the block-granularity waste.
         # inblock_prefill_steps are dispatched slot-steps consumed
@@ -297,7 +328,8 @@ class ContinuousBatcher:
         self.stats = {"decode_dispatches": 0, "slot_steps": 0,
                       "emitted_tokens": 0, "wasted_slot_steps": 0,
                       "prefill_dispatches": 0, "batch_admissions": 0,
-                      "inblock_prefill_steps": 0, "inblock_refills": 0}
+                      "inblock_prefill_steps": 0, "inblock_refills": 0,
+                      "evictions": 0, "swap_ins": 0}
 
     # -- submission / results --------------------------------------------
     def submit(self, prompt, max_new: int = 128, *,
@@ -341,6 +373,7 @@ class ContinuousBatcher:
 
     def pending(self) -> bool:
         return (bool(self.queue) or bool(self.admitting)
+                or (self.paged and bool(self.swapped))
                 or any(o is not None for o in self.occupant))
 
     def result(self, rid: int) -> np.ndarray:
@@ -621,13 +654,18 @@ class ContinuousBatcher:
              for p in (self.slot_pages if pages is None else pages)],
             np.int32)
 
+    def _pages_short(self, upto_pos: int, owned: int = 0) -> int:
+        """How many pages the free list must supply to cover positions
+        [0, upto_pos] given ``owned`` pages already held."""
+        return min(upto_pos // self.page + 1, self.pages_per_slot) - owned
+
     def _alloc_refill_pages(self, slot: int) -> bool:
         """Reserve pages for a staged refill's worst-case in-block writes
         (it activates at step >= 1, so at most steps_per_sync - 1
         positions).  Returns False instead of raising when the pool
         cannot cover it — the request then simply stays queued."""
         upto = min(max(self.steps_per_sync - 2, 0), self.max_len - 1)
-        need = upto // self.page + 1
+        need = self._pages_short(upto)
         if len(self.free_pages) < need:
             return False
         pages = [self.free_pages.popleft() for _ in range(need)]
@@ -640,6 +678,99 @@ class ContinuousBatcher:
         self.free_pages.extend(self.refill_pages[slot])
         self.refill_pages[slot] = []
         self.r_table[slot, :] = 0
+
+    # -- preemption: host-swap under pool pressure -------------------------
+    def _page_io_fns(self):
+        """Compiled page gather/scatter for host-swap: the victim's pages
+        come back as ONE stacked array (one tunnel fetch), and restore
+        writes them into freshly allocated pages.  ``pids`` is padded to
+        ``pages_per_slot``; rows past ``n`` are ignored."""
+        if self._gather_fn is None:
+            @partial(jax.jit, static_argnums=(2,))
+            def gather(cache, pids, n):
+                return jnp.stack([leaf[pids[:n]]
+                                  for leaf in jax.tree.leaves(cache)])
+
+            @partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+            def scatter(cache, stacked, pids, n):
+                leaves, td = jax.tree.flatten(cache)
+                out = [leaf.at[pids[:n]].set(stacked[i, :n]
+                                             .astype(leaf.dtype))
+                       for i, leaf in enumerate(leaves)]
+                return jax.tree.unflatten(td, out)
+
+            self._gather_fn, self._scatter_fn = gather, scatter
+        return self._gather_fn, self._scatter_fn
+
+    def _evict(self, victim: int) -> None:
+        """Preempt ``victim``: its KV pages move to host memory and the
+        request joins the resume queue; the pages go back to the pool.
+        The request continues mid-generation on swap-in — no re-prefill,
+        so the generated prefix can exceed every prompt bucket."""
+        occ = self.occupant[victim]
+        pids = np.zeros(self.pages_per_slot, np.int32)
+        n = len(self.slot_pages[victim])
+        pids[:n] = self.slot_pages[victim]
+        gather, _ = self._page_io_fns()
+        kv = np.asarray(gather(self.cache, jnp.asarray(pids), n))
+        self.swapped.append(_Swapped(
+            req=occ, kv=kv, n_pages=n, pos=int(self.pos[victim]),
+            poff=int(self.slot_poff[victim]),
+            last_tok=int(self.last_tok[victim])))
+        self.occupant[victim] = None
+        self._release_pages(victim)
+        self.stats["evictions"] += 1
+
+    def _ensure_pages_or_evict(self, slot: int, upto: int) -> None:
+        """Cover ``slot``'s write frontier, evicting the youngest
+        occupant (possibly ``slot`` itself) while the pool is short.
+        Progress is guaranteed: one sequence always fits the pool
+        (``pool_pages - 1 >= pages_per_slot``, checked at init)."""
+        while True:
+            need = self._pages_short(upto, len(self.slot_pages[slot]))
+            if need <= len(self.free_pages):
+                self._alloc_pages(slot, upto)
+                return
+            cands = [t for t in range(self.slots)
+                     if self.occupant[t] is not None]
+            victim = max(cands, key=lambda t: self.slot_admit_seq[t])
+            self._evict(victim)
+            if victim == slot:
+                return  # the requester itself was youngest: it waits
+
+    def _resume_swapped(self) -> None:
+        """Swap preempted requests back into free slots, oldest first,
+        when the pool can hold their pages plus the next block's writes
+        (the headroom requirement prevents immediate re-eviction)."""
+        k = self.steps_per_sync
+        for slot in range(self.slots):
+            if not self.swapped:
+                break
+            if self.occupant[slot] is not None or slot in self.admitting:
+                continue
+            sw = self.swapped[0]
+            pr = max(len(sw.req.prompt) - sw.poff, 0)
+            rem = sw.req.max_new - len(sw.req.emitted)
+            writes = min(k, pr + min(k, rem))
+            base = sw.poff if pr else sw.pos + 1
+            upto = min(base + writes - 1, self.max_len - 1)
+            need = max(self._pages_short(upto), sw.n_pages)
+            if len(self.free_pages) < need:
+                break
+            self.swapped.popleft()
+            self._alloc_pages(slot, sw.n_pages * self.page - 1)
+            pids = np.zeros(self.pages_per_slot, np.int32)
+            pids[:sw.n_pages] = self.table[slot, :sw.n_pages]
+            _, scatter = self._page_io_fns()
+            self.cache = scatter(self.cache, jnp.asarray(sw.kv),
+                                 jnp.asarray(pids), sw.n_pages)
+            self.occupant[slot] = sw.req
+            self._set_slot_params(slot, sw.req)
+            self.pos[slot] = sw.pos
+            self.slot_poff[slot] = sw.poff
+            self.last_tok[slot] = sw.last_tok
+            self._alloc_pages(slot, upto)
+            self.stats["swap_ins"] += 1
 
     def _insert_paged(self, slabs, slot: int) -> None:
         """Scatter a prefill's (1, hkv, bucket, d) slabs into this slot's
@@ -701,6 +832,10 @@ class ContinuousBatcher:
         self.slot_topk[slot] = req.top_k
         self.slot_topp[slot] = req.top_p
         self.slot_eos[slot] = -1 if req.eos_id is None else req.eos_id
+        if self.paged:
+            # admission order; preemption evicts the youngest occupant
+            self._admit_counter += 1
+            self.slot_admit_seq[slot] = self._admit_counter
 
     def _occupy(self, slot: int, req: _Request, first_tok: int,
                 out: list) -> None:
@@ -726,8 +861,7 @@ class ContinuousBatcher:
             k = self.steps_per_sync
             upto = min(k, len(req.prompt) + min(k, req.max_new)) - 1
             upto = min(upto, self.max_len - 1)
-            need = min(upto // self.page + 1, self.pages_per_slot)
-            if len(self.free_pages) < need:
+            if len(self.free_pages) < self._pages_short(upto):
                 return False
             self._alloc_pages(slot, upto)
         self.occupant[slot] = req
@@ -752,13 +886,18 @@ class ContinuousBatcher:
 
     def _fill_free_slots(self) -> list[tuple[int, int]]:
         """Unchunked admission: prefill queued requests into free slots in
-        one whole-bucket dispatch each; returns (rid, first token) pairs."""
+        one whole-bucket dispatch each; returns (rid, first token) pairs.
+        When the page pool cannot hold the prompt, the request WAITS in
+        the queue (live work and swapped-out victims free pages as they
+        finish) instead of raising."""
         out = []
         for slot in range(self.slots):
             if self.occupant[slot] is not None or not self.queue:
                 continue
+            L = len(self.queue[0].prompt)
+            if self.paged and len(self.free_pages) < self._pages_short(L - 1):
+                break  # pool full: hold admissions until pages free
             req = self.queue.popleft()
-            L = len(req.prompt)
             bucket = next(b for b in self.buckets if b >= L)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :L] = req.prompt
@@ -793,31 +932,40 @@ class ContinuousBatcher:
         out = []
         for slot, adm in list(self.admitting.items()):
             req, L = adm.req, len(adm.req.prompt)
-            chunk = np.zeros((1, c), np.int32)
-            take = min(c, L - adm.off)
-            chunk[0, :take] = req.prompt[adm.off:adm.off + take]
-            final = adm.off + c >= L
-            unembed_idx = jnp.int32((L - 1 - adm.off) if final else 0)
-            if adm.off == 0:
-                last_logits, adm.cache = self._prefill_chunk_fn(
-                    adm.bucket, first=True)(
-                    self.params, jnp.asarray(chunk), unembed_idx)
-            else:
-                last_logits, adm.cache = self._prefill_chunk_fn(
-                    adm.bucket, first=False)(
-                    self.params, adm.cache, jnp.asarray(chunk),
-                    jnp.int32(adm.off), unembed_idx)
-            self.stats["prefill_dispatches"] += 1
-            adm.off += c
-            if final:
+            if adm.last_logits is None:
+                chunk = np.zeros((1, c), np.int32)
+                take = min(c, L - adm.off)
+                chunk[0, :take] = req.prompt[adm.off:adm.off + take]
+                final = adm.off + c >= L
+                unembed_idx = jnp.int32((L - 1 - adm.off) if final else 0)
+                if adm.off == 0:
+                    last_logits, adm.cache = self._prefill_chunk_fn(
+                        adm.bucket, first=True)(
+                        self.params, jnp.asarray(chunk), unembed_idx)
+                else:
+                    last_logits, adm.cache = self._prefill_chunk_fn(
+                        adm.bucket, first=False)(
+                        self.params, adm.cache, jnp.asarray(chunk),
+                        jnp.int32(adm.off), unembed_idx)
+                self.stats["prefill_dispatches"] += 1
+                adm.off += c
+                if final:
+                    adm.last_logits = last_logits
+            if adm.last_logits is not None:
+                # prefill complete: install — or, when the page pool
+                # cannot hold the prompt yet, HOLD the finished slabs
+                # and retry next step (pages free as work retires)
                 if self.paged:
+                    if len(self.free_pages) < self._pages_short(L - 1):
+                        continue
                     self._alloc_pages(slot, L - 1)
                     self._insert_paged(adm.cache, slot)
                 else:
                     self._insert(adm.cache, slot)
                 del self.admitting[slot]
                 self._occupy(slot, req,
-                             self._sample_first(req, last_logits), out)
+                             self._sample_first(req, adm.last_logits),
+                             out)
         return out
 
     def _emit(self, slot: int, tok: int, out: list) -> None:
@@ -904,6 +1052,8 @@ class ContinuousBatcher:
             self.queue = deque(sorted(self.queue,
                                       key=lambda r: -r.max_new))
         self._queue_dirty = False
+        if self.paged and self.swapped:
+            self._resume_swapped()  # preempted requests take priority
         live_any = any(o is not None for o in self.occupant)
         use_inblock = self.inblock_refill and live_any
         if use_inblock:
@@ -953,12 +1103,23 @@ class ContinuousBatcher:
             # pre-allocate pages covering this dispatch's write frontier:
             # min(K, prompt-left + min(K, budget)) writes from pos — a
             # slot that retires early clamps at its frontier, so the
-            # block never needs pages past its real writes
-            for s in live:
+            # block never needs pages past its real writes.  Under pool
+            # pressure the youngest occupant is preempted (host-swap)
+            # rather than raising.
+            for s in list(live):
+                if self.occupant[s] is None:
+                    continue  # evicted as an earlier slot's victim
                 pr = int(plen[s]) - int(poff[s]) if plen[s] else 0
                 writes = min(k, pr + min(k, int(budget[s])))
-                self._alloc_pages(
+                self._ensure_pages_or_evict(
                     s, min(int(pos[s]) + writes - 1, self.max_len - 1))
+            for s in list(live):
+                if self.occupant[s] is None:  # evicted: out of the block
+                    live.remove(s)
+                    budget[s] = 0
+                    plen[s] = 0
+            if not live:
+                return out
         if use_inblock:
             self._stage_refills()
         r_valid = np.zeros(self.slots, bool)

@@ -390,8 +390,9 @@ def test_paged_kv_pool_matches_oracle(params):
 
 def test_paged_pool_oversubscription(params):
     """A pool SMALLER than slots x max_len serves fine while sequences
-    stay short (the memory win), and exhausts with a clear error when
-    they cannot fit."""
+    stay short (the memory win); when live sequences outgrow it, the
+    youngest is PREEMPTED (host-swap) and resumed later — every request
+    still completes oracle-exact (round 4; exhaustion used to raise)."""
     rng = np.random.default_rng(16)
     p = rng.integers(0, 256, (8,)).astype(np.int32)
     # 2 slots x 1024 max_len = 4 usable pages dense-equivalent; give the
@@ -405,17 +406,54 @@ def test_paged_pool_oversubscription(params):
         cb.step()
     assert len(cb.result(r1)) == len(p) + 8
     assert len(cb.result(r2)) == len(p) + 8
+    assert cb.stats["evictions"] == 0  # short sequences: no pressure
 
-    # two sequences that must BOTH cross page 0's boundary exhaust the
-    # 2-page pool: loud error, not silent corruption
+    # two sequences that must BOTH cross page 0's boundary cannot share
+    # the 2-page pool: one is evicted mid-stream, swapped to host, and
+    # resumed after the other finishes — both land oracle-exact
+    p1 = rng.integers(0, 256, (500,)).astype(np.int32)
+    p2 = rng.integers(0, 256, (500,)).astype(np.int32)
     cb2 = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
                             temperature=0.0, prompt_buckets=(512,),
                             paged=True, pool_pages=3, decode_kernel=True)
-    cb2.submit(rng.integers(0, 256, (500,)).astype(np.int32), max_new=80)
-    cb2.submit(rng.integers(0, 256, (500,)).astype(np.int32), max_new=80)
-    with pytest.raises(RuntimeError, match="pool exhausted"):
-        while cb2.pending():
-            cb2.step()
+    q1 = cb2.submit(p1, max_new=80)
+    q2 = cb2.submit(p2, max_new=80)
+    while cb2.pending():
+        cb2.step()
+    np.testing.assert_array_equal(
+        cb2.result(q1), _greedy_oracle(params, p1, 80, decode_kernel=True))
+    np.testing.assert_array_equal(
+        cb2.result(q2), _greedy_oracle(params, p2, 80, decode_kernel=True))
+    assert cb2.stats["evictions"] >= 1, cb2.stats
+    assert cb2.stats["swap_ins"] == cb2.stats["evictions"], cb2.stats
+    # the pool drained clean: every usable page back on the free list
+    assert len(cb2.free_pages) == cb2.pool_pages - 1
+    assert not cb2.swapped
+
+
+def test_preemption_resumes_past_prompt_buckets(params):
+    """The reason preemption host-swaps instead of re-prefilling: a
+    victim whose prompt + generated prefix exceeds every compiled
+    prompt bucket must still resume exactly.  Three long-budget
+    requests through 2 slots on a tight pool force mid-generation
+    evictions at positions far past the 64-token bucket."""
+    rng = np.random.default_rng(24)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (40, 60, 30)]
+    budgets = [700, 650, 600]    # all cross the 512-page boundary
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(64,),
+                           paged=True, pool_pages=4, decode_kernel=True,
+                           steps_per_sync=64)
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    while cb.pending():
+        cb.step()
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(
+            cb.result(rid), _greedy_oracle(params, p, b,
+                                           decode_kernel=True))
+    assert cb.stats["evictions"] >= 1, cb.stats
+    assert len(cb.free_pages) == cb.pool_pages - 1
 
 
 def test_inblock_refill_handoff_exact_and_utilized(params):
