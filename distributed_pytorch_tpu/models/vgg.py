@@ -89,6 +89,7 @@ def apply(
     train: bool = False,
     dtype: jnp.dtype | None = None,
     bn_axis_name: str | None = None,
+    fused_bn: bool | None = None,
 ) -> tuple[Array, PyTree]:
     """Forward pass; returns (logits[B,10], new_state).
 
@@ -98,6 +99,11 @@ def apply(
     ``dtype`` selects the compute dtype (e.g. jnp.bfloat16 for MXU-friendly
     compute with float32 params); ``bn_axis_name`` enables cross-replica
     sync-BN, which the reference does NOT do — leave None for parity.
+    ``fused_bn`` controls the fused BN+ReLU backward (ops/fused_bn.py):
+    the default (None) resolves to the PLAIN XLA path — the hand kernel
+    measured e2e slower and is a documented negative result; pass
+    ``fused_bn=True`` to run the experiment.  The forward is
+    bitwise-identical either way.
     """
     if dtype is not None:
         x = x.astype(dtype)
@@ -108,11 +114,10 @@ def apply(
             x = ops.max_pool(x)
         else:
             x = ops.conv2d(params[f"conv{idx}"], x)
-            x, new_state[f"bn{idx}"] = ops.batchnorm(
+            x, new_state[f"bn{idx}"] = ops.batchnorm_relu(
                 params[f"bn{idx}"], state[f"bn{idx}"], x,
-                train=train, axis_name=bn_axis_name,
+                train=train, axis_name=bn_axis_name, fused=fused_bn,
             )
-            x = ops.relu(x)
             idx += 1
     x = x.reshape(x.shape[0], -1)  # (B, 512); reference model.py:44
     logits = ops.dense(params["fc"], x)

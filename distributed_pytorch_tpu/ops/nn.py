@@ -99,6 +99,33 @@ def batchnorm_init(num_features: int) -> tuple[dict, dict]:
     return params, state
 
 
+def _train_stats(state: dict, x: Array,
+                 axis_name: str | None) -> tuple[Array, Array, dict]:
+    """Train-mode batch statistics + running-buffer update, shared by
+    ``batchnorm`` and the fused ``batchnorm_relu`` path so the two can
+    never drift: f32 moments; with ``axis_name`` (sync-BN) global
+    moments FIRST, then the variance (pmean of local variances would
+    understate global variance by the spread of per-replica means);
+    torch's convention for the buffers (momentum 0.1, unbiased variance
+    stored, biased used for normalization)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 2))
+    mean_sq = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
+    if axis_name is not None:
+        mean = lax.pmean(mean, axis_name)
+        mean_sq = lax.pmean(mean_sq, axis_name)
+    var = mean_sq - jnp.square(mean)
+    n = x32.shape[0] * x32.shape[1] * x32.shape[2]
+    if axis_name is not None:
+        n = n * lax.psum(jnp.ones((), jnp.float32), axis_name)
+    unbiased = var * (n / jnp.maximum(n - 1, 1))
+    new_state = {
+        "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
+        "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
+    }
+    return mean, var, new_state
+
+
 def batchnorm(
     params: dict,
     state: dict,
@@ -117,24 +144,7 @@ def batchnorm(
     stored in the running buffer, biased variance used for normalisation.
     """
     if train:
-        x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=(0, 1, 2))
-        mean_sq = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
-        if axis_name is not None:
-            # Global moments first, THEN the variance — pmean of local
-            # variances would understate global variance by the spread of the
-            # per-replica means.
-            mean = lax.pmean(mean, axis_name)
-            mean_sq = lax.pmean(mean_sq, axis_name)
-        var = mean_sq - jnp.square(mean)
-        n = x32.shape[0] * x32.shape[1] * x32.shape[2]
-        if axis_name is not None:
-            n = n * lax.psum(jnp.ones((), jnp.float32), axis_name)
-        unbiased = var * (n / jnp.maximum(n - 1, 1))
-        new_state = {
-            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
-            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
-        }
+        mean, var, new_state = _train_stats(state, x, axis_name)
     else:
         mean, var = state["mean"], state["var"]
         new_state = state
@@ -154,6 +164,53 @@ def batchnorm(
 # ---------------------------------------------------------------------------
 # Pooling / Dense
 # ---------------------------------------------------------------------------
+
+def batchnorm_relu(
+    params: dict,
+    state: dict,
+    x: Array,
+    *,
+    train: bool,
+    axis_name: str | None = None,
+    fused: bool | None = None,
+) -> tuple[Array, dict]:
+    """``relu(batchnorm(x))`` with an optionally FUSED Pallas backward.
+
+    Forward-bitwise with ``relu(batchnorm(...))`` in every mode (the
+    fused path reproduces the normalization arithmetic operation for
+    operation); ``fused=True`` replaces the autodiff backward with the
+    closed-form two-kernel Pallas pass (ops/fused_bn.py).  The default
+    (``fused=None``) resolves to the PLAIN path: the hand backward was
+    built and measured e2e SLOWER than XLA's autodiff on TPU v5e — the
+    documented negative result in ops/fused_bn.py — so the fusion stays
+    an explicit experiment, not the default.
+    """
+    from . import fused_bn
+
+    use = fused_bn.supported(x, train, axis_name) if fused is None \
+        else fused
+    if use and not fused_bn.applicable(x, train, axis_name):
+        # explicit fused=True outside the kernel envelope: a clear error
+        # here beats a Mosaic layout failure deep in the backward (and
+        # sync-BN silently computing LOCAL stats would be worse still)
+        raise ValueError(
+            f"fused BN+ReLU does not cover this configuration "
+            f"(shape {x.shape}, train={train}, axis_name={axis_name}): "
+            f"it requires train mode, local (non-synced) statistics, and "
+            f"lane-alignable channels — use fused=False/None")
+    if not (train and use):
+        y, new_state = batchnorm(params, state, x, train=train,
+                                 axis_name=axis_name)
+        return relu(y), new_state
+    mean, var, new_state = _train_stats(state, x, axis_name)
+    rstd = lax.rsqrt(var + BN_EPS)
+    # the fused VJP bakes the through-stats gradient into da; stop the
+    # outer graph from double-counting via its own reduction backward
+    r = fused_bn.bn_relu(x, params["scale"], params["bias"],
+                         lax.stop_gradient(mean),
+                         lax.stop_gradient(rstd))
+    return r, new_state
+
 
 def max_pool(x: Array, window: int = 2, stride: int = 2) -> Array:
     """MaxPool2d(kernel_size=2, stride=2) over NHWC (reference model.py:16)."""

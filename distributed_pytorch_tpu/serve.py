@@ -680,6 +680,10 @@ class ContinuousBatcher:
         self.r_table[slot, :] = 0
 
     # -- preemption: host-swap under pool pressure -------------------------
+    @staticmethod
+    def _pow2(n: int) -> int:
+        return 1 << max(n - 1, 0).bit_length()
+
     def _page_io_fns(self):
         """Compiled page gather/scatter for host-swap: the victim's pages
         come back as ONE stacked array (one tunnel fetch), and restore
@@ -712,7 +716,11 @@ class ContinuousBatcher:
         n = len(self.slot_pages[victim])
         pids[:n] = self.slot_pages[victim]
         gather, _ = self._page_io_fns()
-        kv = np.asarray(gather(self.cache, jnp.asarray(pids), n))
+        # static gather width rounded to the next power of two: bounds
+        # the distinct compiles at log2(pages_per_slot) while fetching
+        # at most 2x the owned pages (pad rows hit the scratch page)
+        kv = np.asarray(gather(self.cache, jnp.asarray(pids),
+                               self._pow2(n)))[:, :n]
         self.swapped.append(_Swapped(
             req=occ, kv=kv, n_pages=n, pos=int(self.pos[victim]),
             poff=int(self.slot_poff[victim]),
@@ -762,8 +770,16 @@ class ContinuousBatcher:
             pids = np.zeros(self.pages_per_slot, np.int32)
             pids[:sw.n_pages] = self.table[slot, :sw.n_pages]
             _, scatter = self._page_io_fns()
-            self.cache = scatter(self.cache, jnp.asarray(sw.kv),
-                                 jnp.asarray(pids), sw.n_pages)
+            # pad to the power-of-two compile width; pad rows write
+            # zeros into the reserved scratch page
+            n2 = self._pow2(sw.n_pages)
+            kv = sw.kv
+            if n2 > sw.n_pages:
+                pad = np.zeros((kv.shape[0], n2 - sw.n_pages)
+                               + kv.shape[2:], kv.dtype)
+                kv = np.concatenate([kv, pad], axis=1)
+            self.cache = scatter(self.cache, jnp.asarray(kv),
+                                 jnp.asarray(pids), n2)
             self.occupant[slot] = sw.req
             self._set_slot_params(slot, sw.req)
             self.pos[slot] = sw.pos
@@ -992,6 +1008,13 @@ class ContinuousBatcher:
         buffer (``submit`` rejects prompts over the largest bucket ==
         ``refill_width``).  Unused staged requests are returned to the
         queue front after the block."""
+        if self.paged and self.swapped:
+            # preempted requests are OLDEST and need a pages-restore
+            # dispatch before decoding, which the in-block handoff
+            # cannot do — let retiring slots go empty so the resume
+            # pass takes them next step, instead of handing them to
+            # younger queue arrivals (starvation)
+            return
         k = self.steps_per_sync
         for slot in range(self.slots):
             if not self.queue:

@@ -1,0 +1,115 @@
+"""Fused BN+ReLU backward (ops/fused_bn.py) — correctness pins for the
+documented negative-result kernel.
+
+The kernel is e2e SLOWER than XLA's autodiff on TPU v5e (module
+docstring records the measurements), so it is NOT the default path;
+these tests keep it correct so the experiment stays re-runnable on
+future toolchains.  Oracle: jax.grad of the plain
+``relu(batchnorm(train=True))`` composite — the custom VJP's closed
+form must match it to f32 reassociation noise, including the
+through-statistics gradient chain it bakes into ``da``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.ops import fused_bn
+from distributed_pytorch_tpu.ops import nn as ops
+
+
+def _problem(shape, seed):
+    rng = np.random.default_rng(seed)
+    c = shape[-1]
+    x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    params = {"scale": jnp.asarray(rng.normal(1, 0.2, c).astype(np.float32)),
+              "bias": jnp.asarray(rng.normal(0, 0.2, c).astype(np.float32))}
+    state = {"mean": jnp.zeros(c), "var": jnp.ones(c)}
+    dr = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    return x, params, state, dr
+
+
+@pytest.mark.parametrize("shape", [
+    (8, 4, 4, 128),    # lane-aligned
+    (16, 2, 2, 256),
+    (8, 8, 8, 64),     # folded: 2 rows per 128-lane
+    (4, 4, 4, 32),     # folded: 4 rows
+])
+def test_fused_vjp_matches_autodiff_f32(shape):
+    x, params, state, dr = _problem(shape, 0)
+
+    def plain(p, xx):
+        y, _ = ops.batchnorm(p, state, xx, train=True)
+        return jnp.sum(ops.relu(y) * dr)
+
+    def fused(p, xx):
+        r, _ = ops.batchnorm_relu(p, state, xx, train=True, fused=True)
+        return jnp.sum(r * dr)
+
+    # forward bitwise (the fused path reproduces the plain arithmetic)
+    assert float(plain(params, x)) == float(fused(params, x))
+    gp = jax.grad(plain, argnums=(0, 1))(params, x)
+    gf = jax.grad(fused, argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(gf[0]["scale"], gp[0]["scale"],
+                               rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(gf[0]["bias"], gp[0]["bias"],
+                               rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(gf[1], gp[1], rtol=2e-5, atol=1e-5)
+
+
+def test_running_stats_match_plain_path():
+    x, params, state, _ = _problem((8, 4, 4, 128), 1)
+    _, st_plain = ops.batchnorm(params, state, x, train=True)
+    _, st_fused = ops.batchnorm_relu(params, state, x, train=True,
+                                     fused=True)
+    for k in ("mean", "var"):
+        np.testing.assert_array_equal(st_plain[k], st_fused[k])
+
+
+def test_auto_gate_is_off_and_applicability_envelope():
+    x = jnp.zeros((8, 4, 4, 128))
+    # the measured negative result: auto never fuses
+    assert not fused_bn.supported(x, train=True, axis_name=None)
+    # ...but the kernel's shape envelope is what the experiment covers
+    assert fused_bn.applicable(x, train=True, axis_name=None)
+    assert not fused_bn.applicable(x, train=False, axis_name=None)
+    assert not fused_bn.applicable(x, train=True, axis_name="data")
+    assert not fused_bn.applicable(jnp.zeros((8, 4, 4, 96)),
+                                   train=True, axis_name=None)
+    # explicit fused=True outside the envelope raises clearly (sync-BN
+    # would otherwise silently compute LOCAL stats; bad channel counts
+    # would die opaquely in Mosaic lowering)
+    p = {"scale": jnp.ones(128), "bias": jnp.zeros(128)}
+    st = {"mean": jnp.zeros(128), "var": jnp.ones(128)}
+    with pytest.raises(ValueError, match="does not cover"):
+        ops.batchnorm_relu(p, st, x, train=True, axis_name="data",
+                           fused=True)
+    p96 = {"scale": jnp.ones(96), "bias": jnp.zeros(96)}
+    st96 = {"mean": jnp.zeros(96), "var": jnp.ones(96)}
+    with pytest.raises(ValueError, match="does not cover"):
+        ops.batchnorm_relu(p96, st96, jnp.zeros((8, 4, 4, 96)),
+                           train=True, fused=True)
+
+
+def test_vgg_trajectory_identical_with_fused_bn():
+    """One VGG-TINY train step with fused=True reproduces the plain
+    step's loss and gradients to f32 noise (the integration surface:
+    vgg.apply -> batchnorm_relu)."""
+    from distributed_pytorch_tpu.models import vgg
+
+    params, state = vgg.init(jax.random.key(0), "TINY")
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, 16).astype(np.int32))
+
+    def loss(p, fused):
+        logits, _ = vgg.apply(p, state, x, name="TINY", train=True,
+                              fused_bn=fused)
+        return ops.cross_entropy_loss(logits, labels)
+
+    lp, gp = jax.value_and_grad(lambda p: loss(p, False))(params)
+    lf, gf = jax.value_and_grad(lambda p: loss(p, True))(params)
+    assert float(lp) == float(lf)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=3e-5, atol=2e-5), gp, gf)

@@ -548,6 +548,44 @@ def test_inblock_refill_paged_handoff_exact(params):
     assert all(not p for p in cb.refill_pages)
 
 
+def test_preempted_request_not_starved_by_refill_handoffs(params):
+    """Review regression (round 4): a swapped-out victim must get the
+    next free slot even under a sustained stream of young short
+    requests — while the resume queue is non-empty, retiring slots are
+    NOT handed over in-block (the handoff cannot restore pages), so the
+    victim resumes at the next step boundary instead of waiting behind
+    every later arrival."""
+    rng = np.random.default_rng(25)
+    p_long = rng.integers(0, 256, (30,)).astype(np.int32)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1024,
+                           temperature=0.0, prompt_buckets=(32,),
+                           paged=True, pool_pages=3, decode_kernel=True,
+                           steps_per_sync=32)
+    r_long = cb.submit(p_long, max_new=600)   # will cross a page: evicted
+    other = cb.submit(rng.integers(0, 256, (20,)).astype(np.int32),
+                      max_new=600)            # the other long occupant
+    shorts = []
+    steps_to_long = None
+    for i in range(200):
+        if not cb.pending():
+            break
+        # sustained arrivals: one young short request per step
+        if i < 40:
+            shorts.append(cb.submit(
+                rng.integers(0, 256, (8,)).astype(np.int32), max_new=4))
+        cb.step()
+        if steps_to_long is None and cb.requests[r_long].done:
+            steps_to_long = i
+    assert not cb.pending()
+    assert cb.stats["evictions"] >= 1, cb.stats
+    np.testing.assert_array_equal(
+        cb.result(r_long),
+        _greedy_oracle(params, p_long, 600, decode_kernel=True))
+    # the victim finished well before the arrival stream drained: it was
+    # resumed at the first free slot, not queued behind 40 young shorts
+    assert steps_to_long is not None and steps_to_long < 150, steps_to_long
+
+
 def test_paged_prealloc_respects_budget(params):
     """Advisor regression (round 3): pre-allocation must cover only
     pos + min(steps_per_sync, budget) — the early exit never writes past
