@@ -164,6 +164,127 @@ def bench_tpu(batch_per_replica: int, warmup: int,
     return sps_chip, mfu
 
 
+def _lm_cfg():
+    """The BASELINE.md LM measurement config: byte-vocab d512/4L
+    transformer, flash attention, bf16."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+    return tfm.TransformerConfig(vocab_size=256, d_model=512, n_layers=4,
+                                 n_heads=4, head_dim=128)
+
+
+def lm_train_flops_per_token(cfg, n_params: int, seq: int) -> float:
+    """Conservative analytic train FLOPs/token: the standard 6*P plus the
+    causal attention matmuls (2 matmuls x 2 FLOPs x 3 for fwd+bwd x S/2
+    visible positions = 6*S*H*Dh per layer); flash's backward recompute
+    is NOT counted, so the MFU reported is a lower bound."""
+    return 6.0 * n_params + 6.0 * seq * cfg.n_layers * cfg.n_heads * cfg.head_dim
+
+
+def bench_lm(iters: int = 40, batch: int = 8,
+             seq: int = 2048) -> tuple[float, float | None]:
+    """(tokens/sec/chip, MFU lower bound) of the LM train step — the
+    transformer half of the framework, regression-gated since round 4
+    (VERDICT round-3 #3).  Per-step dispatch (the measured-faster shape
+    at ~30 ms steps: async dispatch already hides the host), one value
+    fetch at the end, min-of-2 windows."""
+    import jax
+
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+
+    cfg = LMTrainConfig(model=_lm_cfg())
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, (batch, seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    float(tr.train_step(toks, tgts))  # compile + warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = tr.train_step(toks, tgts)
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    tps = batch * seq * iters / best
+    n_params = sum(x.size for x in jax.tree.leaves(tr.params))
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (tps * lm_train_flops_per_token(cfg.model, n_params, seq) / peak
+           if peak else None)
+    _log(f"[bench] lm: {best / iters * 1e3:.2f} ms/step -> {tps:,.0f} "
+         f"tok/s/chip" + (f", MFU>={mfu:.1%}" if mfu else ""))
+    return tps, mfu
+
+
+def bench_decode(max_new: int = 1024) -> float:
+    """ms per decode step (B=2, prompt 64, bf16, Pallas decode kernel) —
+    the BASELINE.md warm-decode config."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu import generate as gen
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    cfg = _lm_cfg()
+    params = tfm.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 256, (2, 64)).astype(np.int32))
+
+    def run():
+        out = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
+                           max_new=max_new, temperature=0.0,
+                           dtype=jnp.bfloat16, decode_kernel=True)
+        return np.asarray(out)
+
+    run()  # compile + warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    ms = best / max_new * 1e3
+    _log(f"[bench] decode: {ms:.3f} ms/token ({max_new} new, B=2, bf16)")
+    return ms
+
+
+def bench_serving() -> tuple[float, float]:
+    """(tokens/sec, slot-step utilization) on the BASELINE.md serving
+    workload: 16 ragged requests over 4 slots, K=32, chunked prefill,
+    in-block refill, longest_first schedule (the headline config).
+    Utilization is deterministic; tok/s carries tunnel RTT."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+    import bench_serving as bs
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
+                                n_heads=8, head_dim=64, d_ff=2048)
+    params = tfm.init(jax.random.key(0), cfg)
+    prompts, budgets = bs.build_workload(16, 0)
+
+    def make():
+        return ContinuousBatcher(
+            params, cfg, slots=4, max_len=1024, temperature=0.0,
+            dtype=jnp.bfloat16, prompt_buckets=(32, 128),
+            steps_per_sync=32, prefill_chunk=32,
+            schedule="longest_first")
+
+    cold = make()
+    bs.run(cold, prompts, budgets)
+    cb = make()
+    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
+                 "_insert_fn", "_insert_paged_fn"):
+        setattr(cb, attr, getattr(cold, attr))
+    r = bs.run(cb, prompts, budgets)
+    _log(f"[bench] serving: {r['tok_per_s']} tok/s, "
+         f"util {r['utilization']:.1%} (16 req / 4 slots, LPT)")
+    return float(r["tok_per_s"]), float(r["utilization"])
+
+
 # Reference-semantics torch-CPU throughput: fallback constant for when torch
 # is unavailable, measured with the windowed metric below (BASELINE.md
 # records the methodology and the live-host measurement).
@@ -239,6 +360,26 @@ def main() -> None:
         _log(f"[bench] calibration failed ({e}); omitting")
         calib = None
 
+    # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
+    # warm decode, and continuous-batching serving were previously only
+    # recorded in BASELINE.md prose — a regression would have been
+    # invisible to the driver.  Each is optional (the VGG headline must
+    # survive any of them failing) and skippable for quick runs.
+    lm_tps = lm_mfu = decode_ms = serve_tps = serve_util = None
+    if not os.environ.get("BENCH_SKIP_LM"):
+        try:
+            lm_tps, lm_mfu = bench_lm()
+        except Exception as e:
+            _log(f"[bench] lm bench failed ({e}); omitting")
+        try:
+            decode_ms = bench_decode()
+        except Exception as e:
+            _log(f"[bench] decode bench failed ({e}); omitting")
+        try:
+            serve_tps, serve_util = bench_serving()
+        except Exception as e:
+            _log(f"[bench] serving bench failed ({e}); omitting")
+
     if os.environ.get("BENCH_SKIP_TORCH"):
         baseline = FALLBACK_BASELINE_SPS
     else:
@@ -260,6 +401,18 @@ def main() -> None:
         # matmul chain — stable ±0.3%, so a genuine device/toolchain
         # change moves it while measurement noise does not (BASELINE.md)
         "calib_tflops": round(calib, 1) if calib is not None else None,
+        # transformer-stack gates (BASELINE.md is the prose companion;
+        # these keys are the regression source of truth since round 4)
+        "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)
+                                       if lm_tps is not None else None),
+        "lm_mfu": round(lm_mfu, 4) if lm_mfu is not None else None,
+        "decode_ms_per_token": (round(decode_ms, 4)
+                                if decode_ms is not None else None),
+        "serving_tokens_per_sec": (round(serve_tps, 1)
+                                   if serve_tps is not None else None),
+        "serving_slot_step_utilization": (round(serve_util, 4)
+                                          if serve_util is not None
+                                          else None),
     }), flush=True)
 
 

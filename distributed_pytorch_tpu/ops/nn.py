@@ -189,7 +189,9 @@ def batchnorm_relu(
 
     use = fused_bn.supported(x, train, axis_name) if fused is None \
         else fused
-    if use and not fused_bn.applicable(x, train, axis_name):
+    if not train:
+        use = False  # eval has no backward to fuse: plain path, no error
+    elif use and not fused_bn.applicable(x, train, axis_name):
         # explicit fused=True outside the kernel envelope: a clear error
         # here beats a Mosaic layout failure deep in the backward (and
         # sync-BN silently computing LOCAL stats would be worse still)
@@ -198,7 +200,7 @@ def batchnorm_relu(
             f"(shape {x.shape}, train={train}, axis_name={axis_name}): "
             f"it requires train mode, local (non-synced) statistics, and "
             f"lane-alignable channels — use fused=False/None")
-    if not (train and use):
+    if not use:
         y, new_state = batchnorm(params, state, x, train=train,
                                  axis_name=axis_name)
         return relu(y), new_state

@@ -716,11 +716,13 @@ class ContinuousBatcher:
         n = len(self.slot_pages[victim])
         pids[:n] = self.slot_pages[victim]
         gather, _ = self._page_io_fns()
-        # static gather width rounded to the next power of two: bounds
-        # the distinct compiles at log2(pages_per_slot) while fetching
-        # at most 2x the owned pages (pad rows hit the scratch page)
-        kv = np.asarray(gather(self.cache, jnp.asarray(pids),
-                               self._pow2(n)))[:, :n]
+        # static gather width rounded to the next power of two (clamped
+        # to the table width — pages_per_slot need not be a power of
+        # two): bounds the distinct compiles at log2(pages_per_slot)
+        # while fetching at most 2x the owned pages (pad rows hit the
+        # scratch page)
+        n2 = min(self._pow2(n), self.pages_per_slot)
+        kv = np.asarray(gather(self.cache, jnp.asarray(pids), n2))[:, :n]
         self.swapped.append(_Swapped(
             req=occ, kv=kv, n_pages=n, pos=int(self.pos[victim]),
             poff=int(self.slot_poff[victim]),
@@ -770,9 +772,10 @@ class ContinuousBatcher:
             pids = np.zeros(self.pages_per_slot, np.int32)
             pids[:sw.n_pages] = self.table[slot, :sw.n_pages]
             _, scatter = self._page_io_fns()
-            # pad to the power-of-two compile width; pad rows write
-            # zeros into the reserved scratch page
-            n2 = self._pow2(sw.n_pages)
+            # pad to the power-of-two compile width (clamped to the
+            # table width, matching _evict); pad rows write zeros into
+            # the reserved scratch page
+            n2 = min(self._pow2(sw.n_pages), self.pages_per_slot)
             kv = sw.kv
             if n2 > sw.n_pages:
                 pad = np.zeros((kv.shape[0], n2 - sw.n_pages)

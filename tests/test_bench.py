@@ -32,3 +32,26 @@ def test_peak_lookup():
     assert bench._peak_flops(Dev("TPU v5 lite0")) == 197.0e12
     assert bench._peak_flops(Dev("TPU v4")) == 275.0e12
     assert bench._peak_flops(Dev("cpu")) is None
+
+
+def test_lm_flops_per_token_hand_count():
+    """6P plus causal attention matmuls — the conservative denominator
+    behind the lm_mfu bench key (round-4 transformer gates)."""
+    cfg = bench._lm_cfg()
+    n_params = 1_000_000
+    got = bench.lm_train_flops_per_token(cfg, n_params, seq=2048)
+    attn = 6 * 2048 * cfg.n_layers * cfg.n_heads * cfg.head_dim
+    assert got == 6 * n_params + attn
+    # the measurement config is the BASELINE one: byte-vocab d512/4L
+    assert (cfg.vocab_size, cfg.d_model, cfg.n_layers) == (256, 512, 4)
+
+
+def test_bench_json_keys_include_transformer_gates():
+    """The driver-recorded JSON line must carry the round-4 gate keys
+    (VERDICT round-3 #3) — pin the schema without running hardware."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("lm_tokens_per_sec_per_chip", "lm_mfu",
+                "decode_ms_per_token", "serving_tokens_per_sec",
+                "serving_slot_step_utilization"):
+        assert key in src, key

@@ -90,6 +90,12 @@ def test_auto_gate_is_off_and_applicability_envelope():
     with pytest.raises(ValueError, match="does not cover"):
         ops.batchnorm_relu(p96, st96, jnp.zeros((8, 4, 4, 96)),
                            train=True, fused=True)
+    # eval with fused=True falls through to the plain path (no backward
+    # to fuse; one flag threads through a train/eval loop without error)
+    y, _ = ops.batchnorm_relu(p, st, x, train=False, fused=True)
+    y_plain, _ = ops.batchnorm(p, st, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y),
+                                  np.asarray(ops.relu(y_plain)))
 
 
 def test_vgg_trajectory_identical_with_fused_bn():

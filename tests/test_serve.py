@@ -548,6 +548,32 @@ def test_inblock_refill_paged_handoff_exact(params):
     assert all(not p for p in cb.refill_pages)
 
 
+def test_preemption_with_non_power_of_two_pages_per_slot(params):
+    """Review regression (round 4): the swap gather/scatter compile
+    width is _pow2(n) CLAMPED to pages_per_slot — with max_len=1536
+    (3 pages/slot) a victim owning all 3 pages must evict and resume
+    without a shape mismatch, oracle-exact."""
+    rng = np.random.default_rng(26)
+    p1 = rng.integers(0, 256, (20,)).astype(np.int32)
+    p2 = rng.integers(0, 256, (25,)).astype(np.int32)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=1536,
+                           temperature=0.0, prompt_buckets=(32,),
+                           paged=True, pool_pages=4, decode_kernel=True,
+                           steps_per_sync=64)
+    r1 = cb.submit(p1, max_new=1100)  # needs 3 pages by the end
+    r2 = cb.submit(p2, max_new=1100)
+    while cb.pending():
+        cb.step()
+    np.testing.assert_array_equal(
+        cb.result(r1), _greedy_oracle(params, p1, 1100,
+                                      decode_kernel=True))
+    np.testing.assert_array_equal(
+        cb.result(r2), _greedy_oracle(params, p2, 1100,
+                                      decode_kernel=True))
+    assert cb.stats["evictions"] >= 1, cb.stats
+    assert len(cb.free_pages) == cb.pool_pages - 1
+
+
 def test_preempted_request_not_starved_by_refill_handoffs(params):
     """Review regression (round 4): a swapped-out victim must get the
     next free slot even under a sustained stream of young short
