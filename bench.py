@@ -265,21 +265,19 @@ def bench_serving() -> tuple[float, float]:
                                 n_heads=8, head_dim=64, d_ff=2048)
     params = tfm.init(jax.random.key(0), cfg)
     prompts, budgets = bs.build_workload(16, 0)
+    on_tpu = jax.default_backend() != "cpu"
 
     def make():
         return ContinuousBatcher(
             params, cfg, slots=4, max_len=1024, temperature=0.0,
-            dtype=jnp.bfloat16, prompt_buckets=(32, 128),
+            dtype=jnp.bfloat16 if on_tpu else None,
+            prompt_buckets=(32, 128),
             steps_per_sync=32, prefill_chunk=32,
             schedule="longest_first")
 
     cold = make()
     bs.run(cold, prompts, budgets)
-    cb = make()
-    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
-                 "_insert_fn", "_insert_paged_fn"):
-        setattr(cb, attr, getattr(cold, attr))
-    r = bs.run(cb, prompts, budgets)
+    r = bs.run(bs.warm_clone(cold, make), prompts, budgets)
     _log(f"[bench] serving: {r['tok_per_s']} tok/s, "
          f"util {r['utilization']:.1%} (16 req / 4 slots, LPT)")
     return float(r["tok_per_s"]), float(r["utilization"])

@@ -23,6 +23,19 @@ from distributed_pytorch_tpu.models import transformer as tfm
 from distributed_pytorch_tpu.serve import ContinuousBatcher
 
 
+def warm_clone(cold: ContinuousBatcher, make) -> ContinuousBatcher:
+    """Fresh batcher sharing ``cold``'s compiled functions, so a timed
+    pass runs warm with clean stats.  Single source of truth for the
+    private compiled-fn attributes (bench.py reuses this)."""
+    cb = make()
+    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
+                 "_insert_fn", "_insert_paged_fn", "_gather_fn",
+                 "_scatter_fn"):
+        if hasattr(cold, attr):
+            setattr(cb, attr, getattr(cold, attr))
+    return cb
+
+
 def build_workload(n_requests: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, 4096, (int(rng.integers(16, 97)),))
@@ -102,11 +115,7 @@ def main():
     # fns through a fresh batcher, so tok/s is warm and stats are clean
     cold = make()
     run(cold, prompts, budgets)
-    cb = make()
-    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
-                 "_insert_fn", "_insert_paged_fn"):
-        setattr(cb, attr, getattr(cold, attr))
-    print(json.dumps(run(cb, prompts, budgets)))
+    print(json.dumps(run(warm_clone(cold, make), prompts, budgets)))
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ import os
 import sys
 import time
 
+import numpy as np
 import pytest
 
 from distributed_pytorch_tpu.launch import LocalAgent, build_parser
@@ -325,6 +326,53 @@ def test_two_process_lm_training(tmp_path):
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert proc.stdout.count("OK") == 2, proc.stdout
     assert any(p.name.startswith("ckpt_") for p in ckpt_dir.iterdir())
+
+
+def test_elastic_crash_resumes_from_checkpoint_trajectory_equal(tmp_path):
+    """The composed elastic story, end to end (VERDICT round-3 #5):
+    a checkpointing 2-process gang loses rank 0 to a hard crash
+    mid-training (after a checkpoint, with further un-checkpointed steps
+    executed); the launcher detects it, tears the gang down, relaunches
+    (RESTART_ATTEMPT=1), and the new gang auto-resumes from the
+    checkpoint and replays the lost steps — reaching a final parameter
+    vector BITWISE equal to an uninterrupted run on the same
+    deterministic data.  The reference's timeout=None rendezvous
+    (main_all_reduce.py:96) would hang forever at step (a)."""
+    import subprocess
+
+    def launch(out_dir, ckpt_dir, extra_env, port):
+        out_dir.mkdir(exist_ok=True)
+        return subprocess.run(
+            [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+             "--nproc-per-node", "2", "--max-restarts", "1",
+             "--master-port", str(port), "--",
+             "tests/workers/elastic_worker.py"],
+            cwd="/root/repo", capture_output=True, text=True, timeout=420,
+            env=dict(
+                {k: v for k, v in os.environ.items()
+                 if k not in ("JAX_PLATFORMS",)},
+                PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""),
+                TEST_STEPS="6", TEST_CKPT_EVERY="2",
+                TEST_CKPT_DIR=str(ckpt_dir), TEST_OUT_DIR=str(out_dir),
+                **extra_env,
+            ),
+        )
+
+    # control: uninterrupted run
+    ctl = launch(tmp_path / "out_ctl", tmp_path / "ckpt_ctl", {}, 16781)
+    assert ctl.returncode == 0, (ctl.stdout[-2000:], ctl.stderr[-2000:])
+    # faulty: rank 0 hard-crashes after step 3 (checkpoint exists at
+    # step 2; step 3's progress is lost and must be replayed)
+    faulty = launch(tmp_path / "out_f", tmp_path / "ckpt_f",
+                    {"TEST_KILL_AT_STEP": "3"}, 16783)
+    assert faulty.returncode == 0, (faulty.stdout[-2000:],
+                                    faulty.stderr[-2000:])
+    assert "KILLING" in faulty.stdout, faulty.stdout
+    assert "attempt=1 start_step=2" in faulty.stdout, faulty.stdout
+
+    final_ctl = np.load(tmp_path / "out_ctl" / "final_attempt0.npy")
+    final_f = np.load(tmp_path / "out_f" / "final_attempt1.npy")
+    np.testing.assert_array_equal(final_f, final_ctl)
 
 
 def test_two_process_hierarchical_training():
