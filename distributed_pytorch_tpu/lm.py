@@ -43,6 +43,7 @@ from .parallel.mesh import make_mesh
 PyTree = Any
 
 DATA, SEQ, MODEL, PIPE, EXPERT = "data", "seq", "model", "pipe", "expert"
+DCN = "dcn"  # outer factor of the data axis on multislice meshes
 IGNORE = IGNORE_INDEX  # target id excluded from the loss (padding)
 
 
@@ -72,6 +73,19 @@ class LMTrainConfig:
     # non-MoE layers (EP ranks own distinct tokens — no duplicated
     # attention).  ep=1 keeps the round-2 experts-over-'model' layout.
     ep: int = 1
+    # Multislice factoring of the data axis: dp = dcn_size slices x
+    # (dp // dcn_size) chips each.  With dcn_size > 1 the mesh gains an
+    # outer 'dcn' axis and the DP gradient sync becomes the EXPLICIT
+    # two-level reduction (reduce-scatter over the slice, a SHARD-SIZED
+    # psum across slices, all-gather back) — |grads|/ici bytes cross
+    # DCN per optimizer step instead of the full payload, as a property
+    # of the emitted program (jaxpr-pinned), not an assumption about
+    # XLA's collective lowering.  Caveat: with grad_accum = A the sync
+    # runs inside every microbatch (A sequential shard-sized DCN
+    # exchanges per step — still A/ici of the flat cost); folding them
+    # into one post-accumulation exchange needs local-grad accumulation
+    # inside the shard_map and is future work.
+    dcn_size: int = 1
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     # Virtual pipeline stages per device (Megatron interleaved placement):
     # the fill/drain bubble shrinks by this factor (parallel/pipeline.py
@@ -124,6 +138,19 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
             "grad_accum does not compose with pp (the pipeline's "
             "microbatch schedule already bounds activation memory; use "
             "--microbatches)")
+    if cfg.dcn_size < 1:
+        raise ValueError(f"dcn_size must be >= 1, got {cfg.dcn_size}")
+    if cfg.dcn_size > 1:
+        if cfg.dp % cfg.dcn_size:
+            raise ValueError(f"dp={cfg.dp} does not factor into "
+                             f"dcn_size={cfg.dcn_size} slices")
+        if cfg.pp > 1:
+            raise ValueError("dcn_size does not compose with pp (the "
+                             "pipeline mesh has no factored data axis)")
+        if cfg.fsdp:
+            raise ValueError("dcn_size does not compose with fsdp yet "
+                             "(params would shard over the slice-local "
+                             "axis only; unimplemented)")
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -166,6 +193,14 @@ def make_lm_mesh(cfg: LMTrainConfig, devices=None) -> Mesh:
                          devices=devices)
     # The 'expert' axis is always present (size ep, usually 1 — free):
     # batch shards over (data, expert), expert weights over 'expert'.
+    if cfg.dcn_size > 1:
+        # multislice: the data axis factors as dcn (outer, cross-slice)
+        # x data (inner, within-slice ICI)
+        return make_mesh(cfg.dp * cfg.ep * cfg.sp * cfg.tp,
+                         axis_names=(DCN, DATA, EXPERT, SEQ, MODEL),
+                         axis_shape=(cfg.dcn_size, cfg.dp // cfg.dcn_size,
+                                     cfg.ep, cfg.sp, cfg.tp),
+                         devices=devices)
     return make_mesh(cfg.dp * cfg.ep * cfg.sp * cfg.tp,
                      axis_names=(DATA, EXPERT, SEQ, MODEL),
                      axis_shape=(cfg.dp, cfg.ep, cfg.sp, cfg.tp),
@@ -276,6 +311,73 @@ def make_optimizer(cfg: LMTrainConfig) -> optax.GradientTransformation:
     )
 
 
+def _batch_axes(cfg: LMTrainConfig) -> tuple[str, ...]:
+    """Axes the batch (and hence the loss reduction) shards over on the
+    non-pp mesh: the factored multislice data axis adds 'dcn' outermost."""
+    return ((DCN, DATA, EXPERT) if cfg.dcn_size > 1
+            else (DATA, EXPERT))
+
+
+def _lm_batch_spec(cfg: LMTrainConfig) -> P:
+    return P(_batch_axes(cfg), SEQ)
+
+
+def _spec_axes(spec) -> set:
+    out = set()
+    for part in spec:
+        if part is None:
+            continue
+        out |= set(part) if isinstance(part, tuple) else {part}
+    return out
+
+
+def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
+    """Identity whose BACKWARD owns the ENTIRE cotangent sync for the
+    factored multislice mesh: the data-axis reduction runs as the
+    explicit two-level algorithm — reduce-scatter('data') ->
+    SHARD-SIZED psum('dcn') -> all_gather_invariant('data') — instead
+    of shard_map's automatic flat psum, and each leaf's remaining
+    invariant axes (expert/seq, and 'model' for tp-replicated leaves,
+    read off its PartitionSpec) get their flat intra-slice psums.  The
+    cotangent returns fully vma-invariant, so shard_map inserts nothing
+    more: the shard-sized DCN payload is a property of the program,
+    pinned by tests/test_lm.py::test_dcn_payload_is_shard_sized_lm."""
+    from .parallel.strategies import two_level_psum
+
+    @jax.custom_vjp
+    def point(p):
+        return p
+
+    def fwd(p):
+        return p, None
+
+    def bwd(_, g):
+        g_leaves, td = jax.tree.flatten(g)
+        s_leaves = jax.tree.leaves(specs)
+        # leaves grouped by their sharded axes: two_level_psum flattens
+        # a group into ONE vector, so mixing (say) tp-sharded leaves —
+        # whose cotangents legitimately vary over 'model' — with
+        # replicated ones would poison the latter's vma
+        groups: dict = {}
+        for i, (gl, sp) in enumerate(zip(g_leaves, s_leaves)):
+            axes = _spec_axes(sp)
+            rest = tuple(a for a in (EXPERT, SEQ, MODEL)
+                         if a not in axes)
+            if rest:
+                gl = jax.lax.psum(gl, rest)
+            groups.setdefault(frozenset(axes), []).append((i, gl))
+        out: list = [None] * len(g_leaves)
+        for items in groups.values():
+            idxs = [i for i, _ in items]
+            synced = two_level_psum([gl for _, gl in items], DCN, DATA)
+            for i, s in zip(idxs, synced):
+                out[i] = s
+        return (jax.tree.unflatten(td, out),)
+
+    point.defvjp(fwd, bwd)
+    return point(params)
+
+
 def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
     """The ONE shard_mapped loss-and-grad builder shared by the single-step
     and K-step-scan train paths (their loss semantics must never drift)."""
@@ -287,7 +389,13 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
     seq_axis = SEQ if cfg.sp > 1 else None
     specs = param_specs(cfg)
 
+    reduce_axes = _batch_axes(cfg) + (SEQ,)
+
     def local_loss(params, tokens, targets, n_total, aux_w):
+        if cfg.dcn_size > 1:
+            # route the data-axis cotangent sync through the explicit
+            # two-level reduction (shard-sized DCN payload)
+            params = _dcn_sync_point(params, specs)
         if cfg.fsdp:
             params = _fsdp_gather(params, specs)
         pos = _shard_positions(cfg, tokens.shape[1])
@@ -304,15 +412,15 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
         # step's full batch — under gradient accumulation each microbatch
         # contributes ce_sum_i/n_total with aux_w = coef/A, so the SUM of
         # microbatch grads is exactly the unaccumulated step's gradient.
-        ce_sum = jax.lax.psum(ce_sum, (DATA, EXPERT, SEQ))
-        aux = jax.lax.pmean(aux, (DATA, EXPERT, SEQ))  # pmean'd over MODEL
+        ce_sum = jax.lax.psum(ce_sum, reduce_axes)
+        aux = jax.lax.pmean(aux, reduce_axes)  # pmean'd over MODEL
         return ce_sum / jnp.maximum(n_total, 1) + aux_w * aux
 
+    bspec = _lm_batch_spec(cfg)
     return shard_map(
         jax.value_and_grad(local_loss),
         mesh=mesh,
-        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ),
-                  P(), P()),
+        in_specs=(specs, bspec, bspec, P(), P()),
         out_specs=(P(), specs),
         # check_vma stays ON: the automatic psum of cotangents for
         # axis-invariant params (the fused DP/SP gradient sync) depends on it.
@@ -459,12 +567,13 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
                            seq_layout=cfg.seq_layout, tp_axis=MODEL,
                            ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos)
         ce, n = masked_ce(logits, targets)
-        return (jax.lax.psum(ce, (DATA, EXPERT, SEQ)),
-                jax.lax.psum(n, (DATA, EXPERT, SEQ)))
+        axes = _batch_axes(cfg) + (SEQ,)
+        return (jax.lax.psum(ce, axes), jax.lax.psum(n, axes))
 
+    bspec = _lm_batch_spec(cfg)
     sharded_eval = shard_map(
         local_eval, mesh=mesh,
-        in_specs=(specs, P((DATA, EXPERT), SEQ), P((DATA, EXPERT), SEQ)),
+        in_specs=(specs, bspec, bspec),
         out_specs=(P(), P()))
 
     @jax.jit
@@ -578,7 +687,7 @@ class LMTrainer:
         # batch sharding: (data, expert) jointly split the batch on the
         # non-pp mesh; the pp mesh has no expert axis (ep=1 enforced)
         self._batch_spec = (P(DATA, SEQ) if cfg.pp > 1
-                            else P((DATA, EXPERT), SEQ))
+                            else _lm_batch_spec(cfg))
 
         if cfg.fsdp and cfg.pp > 1:
             raise ValueError("fsdp composes with the (data, seq, model) "

@@ -509,35 +509,49 @@ class Hierarchical:
             dcn, ici = None, axis
         else:
             dcn, ici = axis
-        n_ici = lax.axis_size(ici)
-        n_dcn = lax.axis_size(dcn) if dcn is not None else 1
-        leaves, treedef = jax.tree.flatten(grads)
-        flat = jnp.concatenate(
-            [g.ravel().astype(jnp.float32) for g in leaves])
-        total = flat.size
-        padded = jnp.pad(flat, (0, (-total) % n_ici))
-        # 1. reduce-scatter within the slice (fast link, 1x payload)
-        shard = lax.psum_scatter(padded, ici, scatter_dimension=0, tiled=True)
-        # 2. cross-slice all-reduce of the shard (slow link, payload/ici)
-        if dcn is not None:
-            shard = lax.psum(shard, dcn)
-        # 3. gather the mean back within the slice (fast link)
-        if _all_gather_inv is not None:
-            full = _all_gather_inv(shard, ici, axis=0, tiled=True)
-        else:
-            me = lax.axis_index(ici)
-            chunk = padded.size // n_ici
-            buf = jnp.zeros_like(padded)
-            buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
-            full = lax.psum(buf, ici)
-        mean = full[:total] / (n_ici * n_dcn)
+        n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
+        total = two_level_psum(grads, dcn, ici)
+        return jax.tree.map(lambda g: (g / n).astype(g.dtype)
+                            if jnp.issubdtype(g.dtype, jnp.floating)
+                            else g, total)
 
-        out, offset = [], 0
-        for g in leaves:
-            out.append(mean[offset:offset + g.size]
-                       .reshape(g.shape).astype(g.dtype))
-            offset += g.size
-        return jax.tree.unflatten(treedef, out)
+
+def two_level_psum(grads: PyTree, dcn: str | None, ici: str) -> PyTree:
+    """The two-level SUM underlying ``Hierarchical`` (steps 1-3 of its
+    docstring, without the mean division): reduce-scatter over ``ici``,
+    a SHARD-SIZED ``psum`` over ``dcn`` (the only cross-slice traffic —
+    |grads|/ici bytes), ``all_gather_invariant`` back over ``ici``.
+    Output is provably replicated over both axes.  Shared with the LM
+    trainer's factored-mesh gradient sync (lm.py dcn_size), whose jaxpr
+    test pins the shard-sized DCN payload."""
+    n_ici = lax.axis_size(ici)
+    leaves, treedef = jax.tree.flatten(grads)
+    flat = jnp.concatenate(
+        [g.ravel().astype(jnp.float32) for g in leaves])
+    total = flat.size
+    padded = jnp.pad(flat, (0, (-total) % n_ici))
+    # 1. reduce-scatter within the slice (fast link, 1x payload)
+    shard = lax.psum_scatter(padded, ici, scatter_dimension=0, tiled=True)
+    # 2. cross-slice all-reduce of the shard (slow link, payload/ici)
+    if dcn is not None:
+        shard = lax.psum(shard, dcn)
+    # 3. gather the sum back within the slice (fast link)
+    if _all_gather_inv is not None:
+        full = _all_gather_inv(shard, ici, axis=0, tiled=True)
+    else:
+        me = lax.axis_index(ici)
+        chunk = padded.size // n_ici
+        buf = jnp.zeros_like(padded)
+        buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
+        full = lax.psum(buf, ici)
+    summed = full[:total]
+
+    out, offset = [], 0
+    for g in leaves:
+        out.append(summed[offset:offset + g.size]
+                   .reshape(g.shape).astype(g.dtype))
+        offset += g.size
+    return jax.tree.unflatten(treedef, out)
 
 
 _REGISTRY: dict[str, Callable[[], Strategy]] = {

@@ -12,12 +12,15 @@ Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       JAX_PLATFORMS=cpu PYTHONPATH=. \
       python examples/multislice_hierarchical.py
 
-Note for the LM trainer: lm.py needs no explicit hierarchical strategy —
-its DP gradient sync is the automatic cotangent psum over 'data', which
-XLA's collective scheduler already lowers hierarchically on real
-multislice meshes (ICI reduce + DCN exchange).  The explicit strategy
-exists where the reference's pedagogy lives: the VGG trainer's pluggable
-sync-strategy axis, with the algorithm visible and pinned by tests.
+Note for the LM trainer (revised round 4): the LM no longer relies on
+XLA lowering its flat cotangent psum hierarchically — set
+``LMTrainConfig(dcn_size=N)`` and the mesh factors into
+(dcn, data, expert, seq, model) with the gradient sync running the SAME
+explicit two-level reduction as this strategy (shared
+``strategies.two_level_psum``).  The shard-sized DCN payload is pinned
+as a program property by
+tests/test_lm.py::test_dcn_payload_is_shard_sized_lm, and trajectory
+parity with flat dp by test_dcn_factored_lm_matches_flat_dp.
 """
 import jax
 
