@@ -510,20 +510,22 @@ class Hierarchical:
         else:
             dcn, ici = axis
         n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
-        total = two_level_psum(grads, dcn, ici)
-        return jax.tree.map(lambda g: (g / n).astype(g.dtype)
-                            if jnp.issubdtype(g.dtype, jnp.floating)
-                            else g, total)
+        # the mean division happens on the f32 sum INSIDE two_level_psum
+        # (before the cast back to leaf dtype): low-precision leaves must
+        # not see the undivided sum, which can overflow their range
+        return two_level_psum(grads, dcn, ici, scale=1.0 / n)
 
 
-def two_level_psum(grads: PyTree, dcn: str | None, ici: str) -> PyTree:
-    """The two-level SUM underlying ``Hierarchical`` (steps 1-3 of its
-    docstring, without the mean division): reduce-scatter over ``ici``,
-    a SHARD-SIZED ``psum`` over ``dcn`` (the only cross-slice traffic —
-    |grads|/ici bytes), ``all_gather_invariant`` back over ``ici``.
-    Output is provably replicated over both axes.  Shared with the LM
-    trainer's factored-mesh gradient sync (lm.py dcn_size), whose jaxpr
-    test pins the shard-sized DCN payload."""
+def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
+                   scale: float | None = None) -> PyTree:
+    """The two-level reduction underlying ``Hierarchical`` (steps 1-3 of
+    its docstring): reduce-scatter over ``ici``, a SHARD-SIZED ``psum``
+    over ``dcn`` (the only cross-slice traffic — |grads|/ici bytes),
+    ``all_gather_invariant`` back over ``ici``.  ``scale`` (e.g. 1/n for
+    a mean) applies to the f32 sum before the cast back to each leaf's
+    dtype.  Output is provably replicated over both axes.  Shared with
+    the LM trainer's factored-mesh gradient sync (lm.py dcn_size),
+    whose jaxpr test pins the shard-sized DCN payload."""
     n_ici = lax.axis_size(ici)
     leaves, treedef = jax.tree.flatten(grads)
     flat = jnp.concatenate(
@@ -545,6 +547,8 @@ def two_level_psum(grads: PyTree, dcn: str | None, ici: str) -> PyTree:
         buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
         full = lax.psum(buf, ici)
     summed = full[:total]
+    if scale is not None:
+        summed = summed * scale
 
     out, offset = [], 0
     for g in leaves:

@@ -1,0 +1,129 @@
+"""Measure the bf16 cross-path argmax flip RATE (BASELINE.md caveat).
+
+The documented caveat: the Pallas decode kernel, the XLA decode path,
+and the paged layout accumulate bf16 attention in different orders, so
+greedy streams can diverge at near-ties (|top1 - top2| ~ the ~1e-2
+accumulation noise).  This script turns "can diverge" into a RATE:
+
+- train the d512/4L byte-LM briefly on the synthetic corpus (so the
+  logit distribution is a language model's, not random init's);
+- produce ONE reference greedy stream (kernel + dense cache);
+- TEACHER-FORCE every path along that same stream — each path sees the
+  identical context at every position (no divergence compounding) — and
+  record its per-position argmax;
+- report, per path pair, flips / positions, plus the margin
+  distribution (how often |top1 - top2| < 2e-2 at all).
+
+Run on TPU:  PYTHONPATH=. python scripts/measure_fliprate.py [--tokens 10240]
+"""
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu import generate as gen
+from distributed_pytorch_tpu.data import lm_corpus
+from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+from distributed_pytorch_tpu.models import transformer as tfm
+
+
+def teacher_forced_argmax(params, cfg, tokens, *, dtype, kernel: bool,
+                          paged: bool, page: int = 512):
+    """(B, T) reference tokens -> (B, T-1) per-position next-token argmax
+    through the DECODE path (every position fed one token at a time, the
+    path under measurement), plus the top1-top2 margin per position."""
+    b, t = tokens.shape
+    max_len = gen.pad_cache_len(t)
+    if paged:
+        per = max_len // page
+        pool = gen.init_paged_cache(cfg, b * per + 1, page, dtype=dtype)
+        # contiguous pages per sequence; page 0 reserved scratch
+        table = jnp.asarray(
+            np.arange(1, b * per + 1, dtype=np.int32).reshape(b, per))
+        cache = pool
+    else:
+        cache = gen.init_cache(cfg, b, max_len, dtype=dtype)
+        table = None
+
+    toks = jnp.asarray(tokens)
+
+    def step(cache, x):
+        i, tok = x
+        logits, cache = gen.decode_step_ragged(
+            params, cache, tok, jnp.full((b,), i, jnp.int32),
+            cfg=cfg, dtype=dtype, use_decode_kernel=kernel,
+            page_table=table)
+        top2 = jax.lax.top_k(logits.astype(jnp.float32), 2)[0]
+        return cache, (jnp.argmax(logits, -1).astype(jnp.int32),
+                       top2[:, 0] - top2[:, 1])
+
+    _, (am, margin) = jax.lax.scan(
+        step, cache, (jnp.arange(t - 1), toks[:, :-1].T))
+    return np.asarray(am).T, np.asarray(margin).T  # (B, T-1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=10240)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--train-steps", type=int, default=60)
+    args = ap.parse_args()
+
+    cfg = tfm.TransformerConfig(vocab_size=256, d_model=512, n_layers=4,
+                                n_heads=4, head_dim=128)
+    dtype = jnp.bfloat16
+
+    # quick training so the measurement runs on language-model-shaped
+    # logits (random init generates degenerate repetition)
+    tr = LMTrainer(LMTrainConfig(model=cfg))
+    text = lm_corpus.synthetic_corpus(1 << 18, seed=3)
+    data = lm_corpus.encode(text)
+    rng = np.random.default_rng(0)
+    for _ in range(args.train_steps):
+        idx = rng.integers(0, len(data) - 513, 8)
+        toks = np.stack([data[i:i + 512] for i in idx]).astype(np.int32)
+        tgts = np.stack([data[i + 1:i + 513] for i in idx]).astype(np.int32)
+        loss = tr.train_step(toks, tgts)
+    params = jax.tree.map(jnp.asarray, tr.params)
+    print(f"trained {args.train_steps} steps, loss {float(loss):.3f}")
+
+    # reference greedy stream: kernel + dense
+    per_seq = args.tokens // args.batch
+    prompts = np.stack([data[i:i + 64] for i in
+                        rng.integers(0, len(data) - 64, args.batch)])
+    ref = np.asarray(gen.generate(
+        params, jnp.asarray(prompts.astype(np.int32)), jax.random.key(1),
+        cfg=cfg, max_new=per_seq - 64, temperature=0.0, dtype=dtype,
+        decode_kernel=True))
+    n_pos = ref.shape[1] - 1
+    print(f"reference stream: {ref.shape} ({args.batch * n_pos} positions)")
+
+    paths = {
+        "kernel_dense": dict(kernel=True, paged=False),
+        "xla_dense": dict(kernel=False, paged=False),
+        "kernel_paged": dict(kernel=True, paged=True),
+    }
+    ams, margins = {}, {}
+    for name, kw in paths.items():
+        ams[name], margins[name] = teacher_forced_argmax(
+            params, cfg, ref, dtype=dtype, **kw)
+
+    total = ams["kernel_dense"].size
+    m = margins["kernel_dense"]
+    out = {"positions": int(total),
+           "near_tie_rate_lt_2e-2": float(np.mean(m < 2e-2)),
+           "margin_p50": float(np.median(m)),
+           "margin_p1": float(np.percentile(m, 1))}
+    for a, bname in (("kernel_dense", "xla_dense"),
+                     ("kernel_dense", "kernel_paged"),
+                     ("xla_dense", "kernel_paged")):
+        flips = int(np.sum(ams[a] != ams[bname]))
+        out[f"flips_{a}_vs_{bname}"] = flips
+        out[f"fliprate_{a}_vs_{bname}"] = flips / total
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
