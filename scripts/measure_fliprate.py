@@ -81,6 +81,7 @@ def main():
     text = lm_corpus.synthetic_corpus(1 << 18, seed=3)
     data = lm_corpus.encode(text)
     rng = np.random.default_rng(0)
+    loss = float("nan")  # --train-steps 0: measure on random-init logits
     for _ in range(args.train_steps):
         idx = rng.integers(0, len(data) - 513, 8)
         toks = np.stack([data[i:i + 512] for i in idx]).astype(np.int32)

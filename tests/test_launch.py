@@ -228,6 +228,7 @@ def test_sigterm_to_launcher_tears_down_gang(tmp_path):
     assert not alive, f"orphaned workers: {alive}"
 
 
+@pytest.mark.slow
 def test_two_process_distributed_training():
     """Full multi-process integration: the launcher spawns a 2-process gang
     that rendezvouses via jax.distributed, builds a mesh over both
@@ -257,6 +258,7 @@ def test_two_process_distributed_training():
            "first Gloo collective with >30s skew (context-init timeout) — "
            "inherently flaky; the 3-node coordinated-restart test covers "
            ">2-node rendezvous at the agent level on any host")
+@pytest.mark.slow
 def test_four_process_distributed_training():
     """4-process gang (1 fake device each): rendezvous, collectives, and
     replicated-state consistency beyond the 2-host case (the >2-node
@@ -281,6 +283,7 @@ def test_four_process_distributed_training():
     assert proc.stdout.count("OK") == 4, proc.stdout
 
 
+@pytest.mark.slow
 def test_two_process_sharded_eval():
     """Multi-host sharded evaluation: a 2-process / 4-device mesh evaluates
     the test set sharded over the data axis and must match the replicated
@@ -303,6 +306,7 @@ def test_two_process_sharded_eval():
     assert proc.stdout.count("OK") == 2, proc.stdout
 
 
+@pytest.mark.slow
 def test_two_process_lm_training(tmp_path):
     """2-process LM gang with sp=4 spanning both processes: the ring
     attention's ppermute hops cross the process boundary, LMTrainer's
@@ -328,6 +332,7 @@ def test_two_process_lm_training(tmp_path):
     assert any(p.name.startswith("ckpt_") for p in ckpt_dir.iterdir())
 
 
+@pytest.mark.slow
 def test_elastic_crash_resumes_from_checkpoint_trajectory_equal(tmp_path):
     """The composed elastic story, end to end (VERDICT round-3 #5):
     a checkpointing 2-process gang loses rank 0 to a hard crash
@@ -375,6 +380,7 @@ def test_elastic_crash_resumes_from_checkpoint_trajectory_equal(tmp_path):
     np.testing.assert_array_equal(final_f, final_ctl)
 
 
+@pytest.mark.slow
 def test_two_process_hierarchical_training():
     """Hierarchical (dcn x ici) gradient sync across a REAL process
     boundary: 2 processes x 2 fake devices build Mesh(('dcn','ici')) =
