@@ -162,6 +162,7 @@ class ContinuousBatcher:
                  paged: bool = False, pool_pages: int | None = None,
                  inblock_refill: bool = True,
                  schedule: str = "fifo",
+                 compact_tail: bool = True,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
@@ -276,7 +277,7 @@ class ContinuousBatcher:
         self._next_rid = 0
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[tuple[int, bool], object] = {}
-        self._decode_fn = None
+        self._decode_fns: dict[int, object] = {}
         self._insert_fn = None
         self._insert_paged_fn = None
         # in-block refill (see module docstring): per-slot prompt progress
@@ -306,6 +307,19 @@ class ContinuousBatcher:
                              f"'fifo' or 'longest_first'")
         self.schedule = schedule
         self._queue_dirty = False
+        # Drained-tail batch compaction (paged only): narrower compiled
+        # blocks once no queued/staged work remains.  Determinism
+        # caveats: (a) bf16 GREEDY streams can near-tie-flip at the
+        # compaction boundary (a narrower dispatch is a different
+        # accumulation shape; same ~0.3%/position rate as any
+        # cross-shape bf16 comparison — BASELINE.md flip-rate table);
+        # (b) SAMPLED (temperature > 0) streams change at the boundary
+        # in ANY dtype — sample_per_seq draws per-row randomness over
+        # the dispatch shape, so a request's draws shift when its row
+        # moves.  compact_tail=False keeps every dispatch full-width
+        # when seeded reproducibility matters; f32 greedy is exact
+        # either way.
+        self.compact_tail = compact_tail
         self.slot_poff = np.zeros(slots, np.int32)
         self.staged_refill: list[_Request | None] = [None] * slots
         self._staged_order: list[int] = []
@@ -329,7 +343,8 @@ class ContinuousBatcher:
                       "emitted_tokens": 0, "wasted_slot_steps": 0,
                       "prefill_dispatches": 0, "batch_admissions": 0,
                       "inblock_prefill_steps": 0, "inblock_refills": 0,
-                      "evictions": 0, "swap_ins": 0}
+                      "evictions": 0, "swap_ins": 0,
+                      "compact_dispatches": 0}
 
     # -- submission / results --------------------------------------------
     def submit(self, prompt, max_new: int = 128, *,
@@ -455,11 +470,16 @@ class ContinuousBatcher:
         frontier (``cap``) so they cannot touch pages/rows they do not
         own.  Token rows beyond ``steps_executed`` are discarded; the
         emit mask distinguishes sampled emissions from prefill steps."""
-        if self._decode_fn is None:
+        return self._decode_for(self.slots)
+
+    def _decode_for(self, n_slots: int):
+        """Compiled block of ``n_slots`` rows: the full pool width, or a
+        NARROWER variant for drained-tail batch compaction (same
+        program, fewer slot rows; one compile per width)."""
+        if self._decode_fns.get(n_slots) is None:
             cfg, dtype = self.cfg, self.dtype
             use_kernel = self.use_kernel
             k_steps = self.steps_per_sync
-            n_slots = self.slots
             width = self.refill_width
 
             tp = self.tp_axis if self.mesh is not None else None
@@ -553,17 +573,18 @@ class ContinuousBatcher:
                 return packed, c["cache"]
 
             if self.mesh is None:
-                self._decode_fn = jax.jit(block_body, donate_argnums=(1,))
+                fn = jax.jit(block_body, donate_argnums=(1,))
             else:
                 from jax import shard_map
                 from jax.sharding import PartitionSpec as P
-                self._decode_fn = jax.jit(shard_map(
+                fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
                     donate_argnums=(1,))
-        return self._decode_fn
+            self._decode_fns[n_slots] = fn
+        return self._decode_fns[n_slots]
 
     def _prefill_chunk_fn(self, bucket: int, first: bool):
         """One prompt chunk written at cache offset ``off``, attending
@@ -1172,66 +1193,125 @@ class ContinuousBatcher:
             r_table = np.zeros((self.slots, 1), np.int32)
         table = (self.table if self.paged
                  else np.zeros((self.slots, 1), np.int32))
-        cur = dict(tokens=jnp.asarray(self.last_tok),
-                   pos=jnp.asarray(pos), poff=jnp.asarray(poff),
-                   plen=jnp.asarray(plen), prompt=jnp.asarray(prompt),
-                   temp=jnp.asarray(self.slot_temp),
-                   top_k=jnp.asarray(self.slot_topk),
-                   top_p=jnp.asarray(self.slot_topp),
-                   eos=jnp.asarray(self.slot_eos),
-                   rem=jnp.asarray(budget),
-                   cap=jnp.asarray(self._write_caps()),
-                   table=jnp.asarray(table))
-        ref = dict(valid=jnp.asarray(r_valid),
-                   plen=jnp.asarray(r_plen), prompt=jnp.asarray(r_prompt),
-                   temp=jnp.asarray(r_temp), top_k=jnp.asarray(r_topk),
-                   top_p=jnp.asarray(r_topp), eos=jnp.asarray(r_eos),
-                   budget=jnp.asarray(r_budget), cap=jnp.asarray(r_cap),
-                   table=jnp.asarray(r_table))
+        caps = self._write_caps()
+        # Batch COMPACTION for the drained tail (paged): with no queued
+        # or staged work left and few slots live, dispatch a NARROWER
+        # compiled block over just the live slots' rows — the page
+        # tables carry the cache indirection, so re-rowing is free.
+        # This reclaims the empty-slot lockstep steps that neither
+        # refill nor LPT can touch (BASELINE.md waste_when
+        # 'queue_drained').  Dense caches are physically slot-indexed;
+        # they keep the full width.
+        compact = (self.compact_tail and self.paged and not self.queue
+                   and not self.admitting and not self.swapped
+                   and all(r is None for r in self.staged_refill)
+                   and len(live) <= self.slots // 2)
+        if compact:
+            w = 1 << max(len(live) - 1, 0).bit_length()
+            sel = np.asarray(live + [live[0]] * (w - len(live)))
+            npad = w - len(live)
+
+            def cut_cur(a):
+                a = np.asarray(a)[sel].copy()
+                return a
+
+            budget_c = cut_cur(budget)
+            caps_c = cut_cur(caps)
+            table_c = cut_cur(table)
+            pos_c = cut_cur(pos)
+            plen_c = cut_cur(plen)
+            poff_c = cut_cur(poff)
+            if npad:
+                # pad rows are dead: zero budget makes them done at
+                # step 0, zero plen keeps them out of prefill, and
+                # their clamped writes land on the reserved scratch page
+                budget_c[-npad:] = 0
+                caps_c[-npad:] = 0
+                table_c[-npad:] = 0
+                pos_c[-npad:] = 0
+                plen_c[-npad:] = 0
+                poff_c[-npad:] = 0
+            cur = dict(tokens=cut_cur(self.last_tok),
+                       pos=pos_c, poff=poff_c,
+                       plen=plen_c, prompt=cut_cur(prompt),
+                       temp=cut_cur(self.slot_temp),
+                       top_k=cut_cur(self.slot_topk),
+                       top_p=cut_cur(self.slot_topp),
+                       eos=cut_cur(self.slot_eos),
+                       rem=budget_c, cap=caps_c, table=table_c)
+            ref = dict(valid=np.zeros(w, bool),
+                       plen=np.zeros(w, np.int32),
+                       prompt=np.zeros((w, self.refill_width), np.int32),
+                       temp=np.ones(w, np.float32),
+                       top_k=np.zeros(w, np.int32),
+                       top_p=np.ones(w, np.float32),
+                       eos=np.full(w, -1, np.int32),
+                       budget=np.zeros(w, np.int32),
+                       cap=np.zeros(w, np.int32),
+                       table=np.zeros_like(table_c))
+            cols = {s: j for j, s in enumerate(live)}
+            self.stats["compact_dispatches"] += 1
+        else:
+            w = self.slots
+            cur = dict(tokens=self.last_tok, pos=pos, poff=poff,
+                       plen=plen, prompt=prompt, temp=self.slot_temp,
+                       top_k=self.slot_topk, top_p=self.slot_topp,
+                       eos=self.slot_eos, rem=budget, cap=caps,
+                       table=table)
+            ref = dict(valid=r_valid, plen=r_plen, prompt=r_prompt,
+                       temp=r_temp, top_k=r_topk, top_p=r_topp,
+                       eos=r_eos, budget=r_budget, cap=r_cap,
+                       table=r_table)
+            cols = {s: s for s in live}
+        cur = {k_: jnp.asarray(v) for k_, v in cur.items()}
+        ref = {k_: jnp.asarray(v) for k_, v in ref.items()}
         self.key, sub = jax.random.split(self.key)
-        packed, self.cache = self._decode()(self.params, self.cache, cur,
-                                            ref, sub)
+        packed, self.cache = self._decode_for(w)(self.params, self.cache,
+                                                 cur, ref, sub)
         flat = np.asarray(packed)  # ONE device->host transfer per block
-        kn, n = k * self.slots, self.slots
-        toks = flat[:kn].reshape(k, n)  # rows >= steps_exec unused
-        mask = flat[kn:2 * kn].reshape(k, n).astype(bool)
-        sw = flat[2 * kn:2 * kn + n]
-        lw = flat[2 * kn + n:2 * kn + 2 * n]
-        poff_f = flat[2 * kn + 2 * n:2 * kn + 3 * n]
-        pf = flat[2 * kn + 3 * n:2 * kn + 4 * n]
+        kn = k * w
+        toks = flat[:kn].reshape(k, w)  # rows >= steps_exec unused
+        mask = flat[kn:2 * kn].reshape(k, w).astype(bool)
+        sw = flat[2 * kn:2 * kn + w]
+        lw = flat[2 * kn + w:2 * kn + 2 * w]
+        poff_f = flat[2 * kn + 2 * w:2 * kn + 3 * w]
+        pf = flat[2 * kn + 3 * w:2 * kn + 4 * w]
+        if compact and npad:
+            pf = pf[:len(live)]  # pad rows: plen zeroed, no prefill
         k_exec = int(flat[-1])
         self.stats["decode_dispatches"] += 1
-        self.stats["slot_steps"] += k_exec * self.slots
+        self.stats["slot_steps"] += k_exec * w
         self.stats["inblock_prefill_steps"] += int(np.sum(pf))
         emitted_before = self.stats["emitted_tokens"]
         for s in live:
-            cut = min(int(sw[s]), k_exec)
+            j = cols[s]
+            cut = min(int(sw[j]), k_exec)
             for i in range(cut):
-                if mask[i, s] and self.occupant[s] is not None:
-                    self._emit(s, int(toks[i, s]), out)
+                if mask[i, j] and self.occupant[s] is not None:
+                    self._emit(s, int(toks[i, j]), out)
             if self.occupant[s] is not None:
                 # current request continues; carry prefill progress only
                 # for slots staged mid-prefill (the device's poff is 0,
                 # not len(prompt), for established slots)
                 if plen[s]:
-                    self.slot_poff[s] = int(poff_f[s])
-                self.pos[s] = int(lw[s])
-            elif int(sw[s]) <= k_exec:
+                    self.slot_poff[s] = int(poff_f[j])
+                self.pos[s] = int(lw[j])
+            elif int(sw[j]) <= k_exec:
                 # the device switched this slot to its staged refill
                 req = self.staged_refill[s]
                 self.staged_refill[s] = None
                 self._staged_order.remove(s)
                 self._install_refill(s, req)
                 self.stats["inblock_refills"] += 1
-                for i in range(int(sw[s]), k_exec):
-                    if mask[i, s] and self.occupant[s] is not None:
-                        self._emit(s, int(toks[i, s]), out)
+                for i in range(int(sw[j]), k_exec):
+                    if mask[i, j] and self.occupant[s] is not None:
+                        self._emit(s, int(toks[i, j]), out)
                 if self.occupant[s] is not None:
-                    self.slot_poff[s] = int(poff_f[s])
-                    self.pos[s] = int(lw[s])
+                    self.slot_poff[s] = int(poff_f[j])
+                    self.pos[s] = int(lw[j])
         self._requeue_unused_refills()
         self.stats["wasted_slot_steps"] += (
-            k_exec * self.slots
+            k_exec * w
             - (self.stats["emitted_tokens"] - emitted_before)
             - int(np.sum(pf)))
         return out

@@ -28,7 +28,7 @@ def warm_clone(cold: ContinuousBatcher, make) -> ContinuousBatcher:
     pass runs warm with clean stats.  Single source of truth for the
     private compiled-fn attributes (bench.py reuses this)."""
     cb = make()
-    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fn",
+    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fns",
                  "_insert_fn", "_insert_paged_fn", "_gather_fn",
                  "_scatter_fn"):
         if hasattr(cold, attr):
@@ -72,6 +72,7 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
             "emitted": s["emitted_tokens"],
             "inblock_prefill": s["inblock_prefill_steps"],
             "inblock_refills": s["inblock_refills"],
+            "compact_dispatches": s["compact_dispatches"],
             "wasted": s["wasted_slot_steps"],
             "utilization": round(util, 4),
             "decode_dispatches": s["decode_dispatches"],
@@ -91,6 +92,9 @@ def main():
                     "behavior), for the contrast")
     ap.add_argument("--schedule", default="fifo",
                     choices=("fifo", "longest_first"))
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV pool (enables drained-tail batch "
+                    "compaction)")
     args = ap.parse_args()
 
     cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
@@ -109,7 +113,7 @@ def main():
             dtype=jnp.bfloat16 if on_tpu else None,
             prompt_buckets=(32, 128), steps_per_sync=args.steps_per_sync,
             prefill_chunk=args.prefill_chunk, schedule=args.schedule,
-            **kw)
+            paged=args.paged, **kw)
 
     # cold pass compiles; the reported (timed) pass reuses its compiled
     # fns through a fresh batcher, so tok/s is warm and stats are clean
