@@ -434,7 +434,7 @@ class ContinuousBatcher:
             self._prefill_fns[bucket] = fn
         return fn
 
-    def _decode(self):
+    def _decode_for(self, n_slots: int):
         """(params, cache, cur, ref, key) -> ((K, slots) sampled tokens,
         (K, slots) emit mask, steps_executed, switch step, last write,
         prompt offset, prefill-step count, cache) — ONE program runs up
@@ -469,12 +469,10 @@ class ContinuousBatcher:
         computing in lockstep; their writes clamp at their allocated
         frontier (``cap``) so they cannot touch pages/rows they do not
         own.  Token rows beyond ``steps_executed`` are discarded; the
-        emit mask distinguishes sampled emissions from prefill steps."""
-        return self._decode_for(self.slots)
+        emit mask distinguishes sampled emissions from prefill steps.
 
-    def _decode_for(self, n_slots: int):
-        """Compiled block of ``n_slots`` rows: the full pool width, or a
-        NARROWER variant for drained-tail batch compaction (same
+        ``n_slots`` is the compiled row count: the full pool width, or
+        a NARROWER variant for drained-tail batch compaction (same
         program, fewer slot rows; one compile per width)."""
         if self._decode_fns.get(n_slots) is None:
             cfg, dtype = self.cfg, self.dtype
@@ -1169,28 +1167,6 @@ class ContinuousBatcher:
                 return out
         if use_inblock:
             self._stage_refills()
-        r_valid = np.zeros(self.slots, bool)
-        r_plen = np.zeros(self.slots, np.int32)
-        r_prompt = np.zeros((self.slots, self.refill_width), np.int32)
-        r_temp = np.ones(self.slots, np.float32)
-        r_topk = np.zeros(self.slots, np.int32)
-        r_topp = np.ones(self.slots, np.float32)
-        r_eos = np.full(self.slots, -1, np.int32)
-        r_budget = np.zeros(self.slots, np.int32)
-        for s, req in enumerate(self.staged_refill):
-            if req is None:
-                continue
-            r_valid[s] = True
-            r_plen[s] = len(req.prompt)
-            r_prompt[s, :r_plen[s]] = req.prompt
-            (r_temp[s], r_topk[s], r_topp[s], r_eos[s],
-             r_budget[s]) = self._req_fields(req)
-        if self.paged:
-            r_cap = self._write_caps(self.refill_pages)
-            r_table = self.r_table
-        else:
-            r_cap = np.full(self.slots, self.max_len - 1, np.int32)
-            r_table = np.zeros((self.slots, 1), np.int32)
         table = (self.table if self.paged
                  else np.zeros((self.slots, 1), np.int32))
         caps = self._write_caps()
@@ -1201,11 +1177,36 @@ class ContinuousBatcher:
         # This reclaims the empty-slot lockstep steps that neither
         # refill nor LPT can touch (BASELINE.md waste_when
         # 'queue_drained').  Dense caches are physically slot-indexed;
-        # they keep the full width.
+        # they keep the full width.  Decided BEFORE the refill staging
+        # arrays are built: compact dispatches (the whole drained tail)
+        # skip that full-width work.
         compact = (self.compact_tail and self.paged and not self.queue
                    and not self.admitting and not self.swapped
                    and all(r is None for r in self.staged_refill)
                    and len(live) <= self.slots // 2)
+        if not compact:
+            r_valid = np.zeros(self.slots, bool)
+            r_plen = np.zeros(self.slots, np.int32)
+            r_prompt = np.zeros((self.slots, self.refill_width), np.int32)
+            r_temp = np.ones(self.slots, np.float32)
+            r_topk = np.zeros(self.slots, np.int32)
+            r_topp = np.ones(self.slots, np.float32)
+            r_eos = np.full(self.slots, -1, np.int32)
+            r_budget = np.zeros(self.slots, np.int32)
+            for s, req in enumerate(self.staged_refill):
+                if req is None:
+                    continue
+                r_valid[s] = True
+                r_plen[s] = len(req.prompt)
+                r_prompt[s, :r_plen[s]] = req.prompt
+                (r_temp[s], r_topk[s], r_topp[s], r_eos[s],
+                 r_budget[s]) = self._req_fields(req)
+            if self.paged:
+                r_cap = self._write_caps(self.refill_pages)
+                r_table = self.r_table
+            else:
+                r_cap = np.full(self.slots, self.max_len - 1, np.int32)
+                r_table = np.zeros((self.slots, 1), np.int32)
         if compact:
             w = 1 << max(len(live) - 1, 0).bit_length()
             sel = np.asarray(live + [live[0]] * (w - len(live)))
