@@ -73,6 +73,7 @@ TPU-first design constraints drive the shape:
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -104,6 +105,11 @@ class _Request:
     eos_id: int | None = None
     emitted: list = field(default_factory=list)
     done: bool = False
+    # latency bookkeeping (host clock; token times land at block syncs,
+    # which is when the serving layer can actually hand tokens out)
+    t_submit: float = 0.0
+    t_first: float | None = None  # first emission (TTFT = t_first - t_submit)
+    t_done: float | None = None
 
 
 @dataclass
@@ -381,6 +387,7 @@ class ContinuousBatcher:
             top_k=0 if top_k is None else top_k,
             top_p=1.0 if top_p is None else top_p,  # 0.0 stays: -> greedy
             eos_id=self.eos_id if eos_id is _INHERIT else eos_id)
+        req.t_submit = time.perf_counter()
         self.requests[rid] = req
         self.queue.append(req)
         self._queue_dirty = True
@@ -395,6 +402,45 @@ class ContinuousBatcher:
         req = self.requests[rid]
         return np.concatenate([req.prompt,
                                np.asarray(req.emitted, np.int32)])
+
+    def latency_stats(self) -> dict[str, float]:
+        """Per-request latency percentiles over COMPLETED requests, in
+        seconds (host clock; a token's timestamp is the block sync that
+        delivered it — the moment the serving layer could hand it out,
+        which through a tunneled chip includes the transfer):
+
+        - ``ttft_*``: time to first token (submit -> first emission);
+          under in-block admission this includes queue wait;
+        - ``total_*``: submit -> retirement.
+
+        No per-request decode rate is reported: token timestamps have
+        BLOCK granularity (a whole burst lands at one sync), so
+        tokens/(t_done - t_first) would exclude the first block's work
+        from the denominator and overstate wildly for short requests —
+        use aggregate throughput (emitted tokens / wall) instead.
+        """
+        done = [r for r in self.requests.values()
+                if r.done and r.t_done is not None]
+        if not done:
+            return {"completed": 0}
+        ttft = np.asarray([r.t_first - r.t_submit for r in done])
+        total = np.asarray([r.t_done - r.t_submit for r in done])
+        return {"completed": len(done),
+                "ttft_p50": float(np.percentile(ttft, 50)),
+                "ttft_p95": float(np.percentile(ttft, 95)),
+                "total_p50": float(np.percentile(total, 50)),
+                "total_p95": float(np.percentile(total, 95))}
+
+    def utilization(self) -> float:
+        """Slot-step utilization: (sampled emissions from decode
+        dispatches + in-block teacher-forced prefill steps) / dispatched
+        slot-steps.  Each batch-prefilled admission's first token came
+        from its prefill dispatch, not a slot-step — the single source
+        of truth for the BASELINE.md serving tables."""
+        s = self.stats
+        return ((s["emitted_tokens"] - s["batch_admissions"]
+                 + s["inblock_prefill_steps"])
+                / max(s["slot_steps"], 1))
 
     # -- compiled pieces --------------------------------------------------
     def _prefill(self, bucket: int):
@@ -1008,12 +1054,15 @@ class ContinuousBatcher:
 
     def _emit(self, slot: int, tok: int, out: list) -> None:
         req = self.occupant[slot]
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
         req.emitted.append(tok)
         out.append((req.rid, tok))
         self.stats["emitted_tokens"] += 1
         if ((req.eos_id is not None and tok == req.eos_id)
                 or len(req.emitted) >= req.max_new):
             req.done = True
+            req.t_done = time.perf_counter()
             self.occupant[slot] = None  # slot free; stale K/V never read
             if self.paged:
                 # the block table row is rewritten at the next admission;
@@ -1184,29 +1233,6 @@ class ContinuousBatcher:
                    and not self.admitting and not self.swapped
                    and all(r is None for r in self.staged_refill)
                    and len(live) <= self.slots // 2)
-        if not compact:
-            r_valid = np.zeros(self.slots, bool)
-            r_plen = np.zeros(self.slots, np.int32)
-            r_prompt = np.zeros((self.slots, self.refill_width), np.int32)
-            r_temp = np.ones(self.slots, np.float32)
-            r_topk = np.zeros(self.slots, np.int32)
-            r_topp = np.ones(self.slots, np.float32)
-            r_eos = np.full(self.slots, -1, np.int32)
-            r_budget = np.zeros(self.slots, np.int32)
-            for s, req in enumerate(self.staged_refill):
-                if req is None:
-                    continue
-                r_valid[s] = True
-                r_plen[s] = len(req.prompt)
-                r_prompt[s, :r_plen[s]] = req.prompt
-                (r_temp[s], r_topk[s], r_topp[s], r_eos[s],
-                 r_budget[s]) = self._req_fields(req)
-            if self.paged:
-                r_cap = self._write_caps(self.refill_pages)
-                r_table = self.r_table
-            else:
-                r_cap = np.full(self.slots, self.max_len - 1, np.int32)
-                r_table = np.zeros((self.slots, 1), np.int32)
         if compact:
             w = 1 << max(len(live) - 1, 0).bit_length()
             sel = np.asarray(live + [live[0]] * (w - len(live)))
@@ -1253,6 +1279,31 @@ class ContinuousBatcher:
             cols = {s: j for j, s in enumerate(live)}
             self.stats["compact_dispatches"] += 1
         else:
+            # full-width dispatch: build the refill staging arrays here,
+            # their only consumer (compact dispatches skip the work —
+            # the compact condition requires no staged refills)
+            r_valid = np.zeros(self.slots, bool)
+            r_plen = np.zeros(self.slots, np.int32)
+            r_prompt = np.zeros((self.slots, self.refill_width), np.int32)
+            r_temp = np.ones(self.slots, np.float32)
+            r_topk = np.zeros(self.slots, np.int32)
+            r_topp = np.ones(self.slots, np.float32)
+            r_eos = np.full(self.slots, -1, np.int32)
+            r_budget = np.zeros(self.slots, np.int32)
+            for s, req in enumerate(self.staged_refill):
+                if req is None:
+                    continue
+                r_valid[s] = True
+                r_plen[s] = len(req.prompt)
+                r_prompt[s, :r_plen[s]] = req.prompt
+                (r_temp[s], r_topk[s], r_topp[s], r_eos[s],
+                 r_budget[s]) = self._req_fields(req)
+            if self.paged:
+                r_cap = self._write_caps(self.refill_pages)
+                r_table = self.r_table
+            else:
+                r_cap = np.full(self.slots, self.max_len - 1, np.int32)
+                r_table = np.zeros((self.slots, 1), np.int32)
             w = self.slots
             cur = dict(tokens=self.last_tok, pos=pos, poff=poff,
                        plen=plen, prompt=prompt, temp=self.slot_temp,

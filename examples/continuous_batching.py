@@ -62,10 +62,9 @@ rids = [cb.submit(p, max_new=int(rng.integers(16, 80))) for p in prompts]
 while cb.pending():
     cb.step()
 s = cb.stats
-util = (s["emitted_tokens"] - s["batch_admissions"]
-        + s["inblock_prefill_steps"]) / max(s["slot_steps"], 1)
 print(f"paged pool: {cb.pool_pages - 1} usable pages served "
-      f"{len(prompts)} requests; slot-step utilization {util:.1%} "
-      f"(in-block refills {s['inblock_refills']}, compact dispatches "
-      f"{s['compact_dispatches']}, evictions {s['evictions']})")
+      f"{len(prompts)} requests; slot-step utilization "
+      f"{cb.utilization():.1%} (in-block refills {s['inblock_refills']}, "
+      f"compact dispatches {s['compact_dispatches']}, evictions "
+      f"{s['evictions']})")
 print(f"full stats: {s}")

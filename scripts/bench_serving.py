@@ -57,14 +57,7 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
     wall = time.perf_counter() - t0
     total = sum(len(cb.result(r)) - len(p) for r, p in zip(rids, prompts))
     s = cb.stats
-    # useful slot-steps: sampled emissions from decode dispatches plus
-    # in-block teacher-forced prefill steps (prompt work that replaces a
-    # separate prefill dispatch); each batch-prefilled ADMISSION (not
-    # each prefill dispatch — chunked admissions take several) emits its
-    # first token from prefill, not a slot-step
-    useful = (s["emitted_tokens"] - s["batch_admissions"]
-              + s["inblock_prefill_steps"])
-    util = useful / max(s["slot_steps"], 1)
+    util = cb.utilization()  # the single source of truth (serve.py)
     return {"requests": len(prompts), "slots": cb.slots,
             "tokens": total, "wall_s": round(wall, 2),
             "tok_per_s": round(total / wall, 1),
@@ -77,7 +70,9 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
             "utilization": round(util, 4),
             "decode_dispatches": s["decode_dispatches"],
             "prefill_dispatches": s["prefill_dispatches"],
-            "waste_when": waste}
+            "waste_when": waste,
+            "latency": {k: (round(v, 3) if isinstance(v, float) else v)
+                        for k, v in cb.latency_stats().items()}}
 
 
 def main():
