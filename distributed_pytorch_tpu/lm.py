@@ -80,11 +80,10 @@ class LMTrainConfig:
     # psum across slices, all-gather back) — |grads|/ici bytes cross
     # DCN per optimizer step instead of the full payload, as a property
     # of the emitted program (jaxpr-pinned), not an assumption about
-    # XLA's collective lowering.  Caveat: with grad_accum = A the sync
-    # runs inside every microbatch (A sequential shard-sized DCN
-    # exchanges per step — still A/ici of the flat cost); folding them
-    # into one post-accumulation exchange needs local-grad accumulation
-    # inside the shard_map and is future work.
+    # XLA's collective lowering.  With grad_accum = A the microbatch
+    # backwards run entirely local and the accumulated grads sync ONCE
+    # (_make_accum_grad_step): one shard-sized DCN exchange per
+    # optimizer step, not A.
     dcn_size: int = 1
     microbatches: int = 0  # per-step microbatches for pp (default 2*pp)
     # Virtual pipeline stages per device (Megatron interleaved placement):
@@ -342,8 +341,6 @@ def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
     cotangent returns fully vma-invariant, so shard_map inserts nothing
     more: the shard-sized DCN payload is a property of the program,
     pinned by tests/test_lm.py::test_dcn_payload_is_shard_sized_lm."""
-    from .parallel.strategies import two_level_psum
-
     @jax.custom_vjp
     def point(p):
         return p
@@ -352,47 +349,56 @@ def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
         return p, None
 
     def bwd(_, g):
-        g_leaves, td = jax.tree.flatten(g)
-        s_leaves = jax.tree.leaves(specs)
-        # leaves grouped by their sharded axes: two_level_psum flattens
-        # a group into ONE vector, so mixing (say) tp-sharded leaves —
-        # whose cotangents legitimately vary over 'model' — with
-        # replicated ones would poison the latter's vma
-        groups: dict = {}
-        for i, (gl, sp) in enumerate(zip(g_leaves, s_leaves)):
-            axes = _spec_axes(sp)
-            rest = tuple(a for a in (EXPERT, SEQ, MODEL)
-                         if a not in axes)
-            if rest:
-                gl = jax.lax.psum(gl, rest)
-            groups.setdefault(frozenset(axes), []).append((i, gl))
-        out: list = [None] * len(g_leaves)
-        for items in groups.values():
-            idxs = [i for i, _ in items]
-            synced = two_level_psum([gl for _, gl in items], DCN, DATA)
-            for i, s in zip(idxs, synced):
-                out[i] = s
-        return (jax.tree.unflatten(td, out),)
+        return (_two_level_sync(g, specs),)
 
     point.defvjp(fwd, bwd)
     return point(params)
 
 
-def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
-    """The ONE shard_mapped loss-and-grad builder shared by the single-step
-    and K-step-scan train paths (their loss semantics must never drift)."""
+def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
+    """The factored-mesh gradient sync itself (shared by the custom-VJP
+    point and the grad-accumulation path): per-leaf flat psums over each
+    leaf's remaining invariant axes, then the grouped two-level (data,
+    dcn) reduction.  Leaves are grouped by their sharded axes:
+    ``two_level_psum`` flattens a group into ONE vector, so mixing
+    (say) tp-sharded leaves — whose values legitimately vary over
+    'model' — with replicated ones would poison the latter's vma."""
+    from .parallel.strategies import two_level_psum
+
+    g_leaves, td = jax.tree.flatten(g)
+    s_leaves = jax.tree.leaves(specs)
+    groups: dict = {}
+    for i, (gl, sp) in enumerate(zip(g_leaves, s_leaves)):
+        axes = _spec_axes(sp)
+        rest = tuple(a for a in (EXPERT, SEQ, MODEL)
+                     if a not in axes)
+        if rest:
+            gl = jax.lax.psum(gl, rest)
+        groups.setdefault(frozenset(axes), []).append((i, gl))
+    out: list = [None] * len(g_leaves)
+    for items in groups.values():
+        idxs = [i for i, _ in items]
+        synced = two_level_psum([gl for _, gl in items], DCN, DATA)
+        for i, s in zip(idxs, synced):
+            out[i] = s
+    return jax.tree.unflatten(td, out)
+
+
+def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
+    """The per-shard loss shared by every grad path.  ``dcn_sync``
+    injects the custom-VJP two-level sync point on params (the a=1
+    factored-mesh path); the accumulation path passes False and syncs
+    ONCE after its local scan instead."""
     dtype = cfg.dtype
     # tp psums always run (free over a size-1 'model' axis) — they also carry
     # the vma bookkeeping that makes the loss provably replicated.  The ring
     # only replaces local flash attention when the seq axis is actually cut.
     tp_axis = MODEL
     seq_axis = SEQ if cfg.sp > 1 else None
-    specs = param_specs(cfg)
-
     reduce_axes = _batch_axes(cfg) + (SEQ,)
 
     def local_loss(params, tokens, targets, n_total, aux_w):
-        if cfg.dcn_size > 1:
+        if dcn_sync:
             # route the data-axis cotangent sync through the explicit
             # two-level reduction (shard-sized DCN payload)
             params = _dcn_sync_point(params, specs)
@@ -416,6 +422,15 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
         aux = jax.lax.pmean(aux, reduce_axes)  # pmean'd over MODEL
         return ce_sum / jnp.maximum(n_total, 1) + aux_w * aux
 
+    return local_loss
+
+
+def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
+    """The ONE shard_mapped loss-and-grad builder shared by the single-step
+    and K-step-scan train paths (their loss semantics must never drift)."""
+    specs = param_specs(cfg)
+    local_loss = _build_local_loss(cfg, specs,
+                                   dcn_sync=cfg.dcn_size > 1)
     bspec = _lm_batch_spec(cfg)
     return shard_map(
         jax.value_and_grad(local_loss),
@@ -425,6 +440,44 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
         # check_vma stays ON: the automatic psum of cotangents for
         # axis-invariant params (the fused DP/SP gradient sync) depends on it.
     )
+
+
+def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
+    """Gradient accumulation with ONE cross-device exchange per
+    optimizer step, for the factored multislice mesh: the A microbatch
+    backwards run entirely LOCAL inside one shard_map (the loss's
+    scalar psums are the only per-microbatch collectives), local grads
+    accumulate through a lax.scan, and the accumulated tree syncs once
+    — per-leaf intra psums + the grouped two-level (data, dcn)
+    reduction.  The naive alternative (scanning the synced grad_step)
+    pays A sequential shard-sized DCN round-trips per step.
+
+    ``(params, micro_tokens (A, B, S), micro_targets, n_total, aux_w)
+    -> (summed loss, synced grads)``; numerics match the scanned path
+    to f32 reassociation noise (sum-then-sync == sync-then-sum)."""
+    specs = param_specs(cfg)
+    local_loss = _build_local_loss(cfg, specs, dcn_sync=False)
+    grad_fn = jax.value_and_grad(local_loss)
+
+    def local_accum(params, micro_t, micro_y, n_total, aux_w):
+        def body(carry, batch):
+            loss_acc, g_acc = carry
+            tk, tg = batch
+            loss_i, g_i = grad_fn(params, tk, tg, n_total, aux_w)
+            return (loss_acc + loss_i,
+                    jax.tree.map(jnp.add, g_acc, g_i)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss, g), _ = jax.lax.scan(
+            body, (jnp.float32(0), zeros), (micro_t, micro_y))
+        return loss, _two_level_sync(g, specs)
+
+    bspec = _lm_batch_spec(cfg)
+    mspec = P(None, *bspec)  # leading scan axis unsharded
+    return shard_map(
+        local_accum, mesh=mesh,
+        in_specs=(specs, mspec, mspec, P(), P()),
+        out_specs=(P(), specs))
 
 
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
@@ -443,6 +496,10 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     a = cfg.grad_accum
     if a < 1:
         raise ValueError(f"grad_accum must be >= 1, got {a}")
+    # factored multislice mesh: accumulate LOCAL grads and sync once
+    # (one shard-sized DCN exchange per optimizer step, not A)
+    accum_step = (_make_accum_grad_step(cfg, mesh)
+                  if a > 1 and cfg.dcn_size > 1 else None)
     coef = jnp.float32(cfg.aux_coef)
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -468,15 +525,20 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
             micro_t = tokens.reshape(mb, a, -1).swapaxes(0, 1)
             micro_y = targets.reshape(mb, a, -1).swapaxes(0, 1)
 
-            def body(carry, batch):
-                loss_acc, grads_acc = carry
-                loss_i, g_i = grad_step(params, *batch, n_total, coef / a)
-                return (loss_acc + loss_i,
-                        jax.tree.map(jnp.add, grads_acc, g_i)), None
+            if accum_step is not None:
+                loss, grads = accum_step(params, micro_t, micro_y,
+                                         n_total, coef / a)
+            else:
+                def body(carry, batch):
+                    loss_acc, grads_acc = carry
+                    loss_i, g_i = grad_step(params, *batch, n_total,
+                                            coef / a)
+                    return (loss_acc + loss_i,
+                            jax.tree.map(jnp.add, grads_acc, g_i)), None
 
-            zeros = jax.tree.map(jnp.zeros_like, params)
-            (loss, grads), _ = jax.lax.scan(
-                body, (jnp.float32(0), zeros), (micro_t, micro_y))
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (loss, grads), _ = jax.lax.scan(
+                    body, (jnp.float32(0), zeros), (micro_t, micro_y))
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

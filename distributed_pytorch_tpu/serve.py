@@ -407,7 +407,9 @@ class ContinuousBatcher:
         """Per-request latency percentiles over COMPLETED requests, in
         seconds (host clock; a token's timestamp is the block sync that
         delivered it — the moment the serving layer could hand it out,
-        which through a tunneled chip includes the transfer):
+        which through a tunneled chip includes the transfer).  With no
+        completed requests yet, returns ``{"completed": 0}`` ONLY — the
+        percentile keys exist once ``completed`` is positive:
 
         - ``ttft_*``: time to first token (submit -> first emission);
           under in-block admission this includes queue wait;

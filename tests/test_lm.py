@@ -583,6 +583,59 @@ def test_dcn_payload_is_shard_sized_lm():
     assert sum(sized) < n_params, (sum(sized), n_params)
 
 
+def test_dcn_grad_accum_single_exchange():
+    """grad_accum x dcn_size accumulates LOCAL grads and syncs once:
+    the trajectory matches both the unaccumulated factored run and the
+    flat-dp accumulated run to f32 noise, and the jaxpr carries exactly
+    ONE set of shard-sized dcn psums (one per spec group) — not A."""
+    import re
+
+    from distributed_pytorch_tpu.lm import (
+        _make_accum_grad_step, _spec_axes, make_lm_mesh, param_specs)
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                  n_heads=2, head_dim=32, d_ff=128)
+    tokens, targets = _data(b=8, s=64, vocab=256)
+    runs = {}
+    for name, kw in {"flat_a2": dict(dp=4, grad_accum=2),
+                     "dcn_a1": dict(dp=4, dcn_size=2),
+                     "dcn_a2": dict(dp=4, dcn_size=2,
+                                    grad_accum=2)}.items():
+        tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
+                                     aux_coef=0.0, **kw))
+        runs[name] = [float(tr.train_step(tokens, targets))
+                      for _ in range(3)]
+    np.testing.assert_allclose(runs["dcn_a2"], runs["dcn_a1"], rtol=2e-5)
+    np.testing.assert_allclose(runs["dcn_a2"], runs["flat_a2"], rtol=2e-5)
+
+    # payload pin: ONE dcn exchange per step in the accumulated program
+    cfg = LMTrainConfig(model=model, compute_dtype=None, dp=4,
+                        dcn_size=2, grad_accum=2)
+    mesh = make_lm_mesh(cfg)
+    accum = _make_accum_grad_step(cfg, mesh)
+    tr = LMTrainer(cfg, mesh=mesh)
+    groups: dict = {}
+    for leaf, spec in zip(jax.tree.leaves(tr.params),
+                          jax.tree.leaves(param_specs(cfg))):
+        key = frozenset(_spec_axes(spec))
+        groups[key] = groups.get(key, 0) + leaf.size
+    ici = cfg.dp // cfg.dcn_size
+    want = sorted(-(-g // ici) for g in groups.values())
+    micro = jnp.asarray(tokens).reshape(2, 4, -1)
+    jaxpr = str(jax.make_jaxpr(accum)(
+        tr.params, micro, jnp.asarray(targets).reshape(2, 4, -1),
+        jnp.float32(1.0), jnp.float32(0.0)))
+    sized = []
+    for ln in jaxpr.splitlines():
+        if "psum" in ln and "'dcn'" in ln:
+            for dims in re.findall(r"\w+\[([\d,]+)\]", ln):
+                size = int(np.prod([int(d) for d in dims.split(",")]))
+                if size > 1:
+                    sized.append(size)
+    assert sorted(sized) == want, (sized, want)
+
+
 def test_dcn_validation():
     from distributed_pytorch_tpu.models import transformer as tfm
     model = tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
