@@ -478,6 +478,163 @@ def generate(
                           decode_kernel=decode_kernel)
 
 
+@partial(jax.jit, static_argnames=("cfg", "draft_cfg", "max_new",
+                                   "n_spec", "dtype", "eos_id",
+                                   "decode_kernel"))
+def generate_speculative(
+    params: PyTree,
+    draft_params: PyTree,
+    prompt: jax.Array,       # (B, S0) int32
+    *,
+    cfg: tfm.TransformerConfig,
+    draft_cfg: tfm.TransformerConfig,
+    max_new: int,
+    n_spec: int = 4,
+    dtype=None,
+    eos_id: int | None = None,
+    decode_kernel: bool | None = None,
+):
+    """Greedy SPECULATIVE decoding: a small draft model proposes
+    ``n_spec`` tokens per round, the target model verifies them all in
+    ONE batched forward, and the longest matching prefix plus the
+    target's own next token are emitted — identical output to the
+    target's plain greedy decode (the standard guarantee), at up to
+    ``n_spec + 1`` tokens per target pass.
+
+    TPU-first shape: the verification pass is a (B, n_spec+1)-token
+    batched forward — exactly the matmul-heavy work the MXU wants,
+    replacing n_spec+1 bandwidth-bound single-token steps; the draft
+    runs the cheap single-token scan.  Cache REWIND after a rejected
+    proposal is free by construction: this framework's caches are
+    position-bounded (reads never pass the caller's ``pos``, stale rows
+    are overwritten before the bound reaches them — the same property
+    slot recycling in serve.py relies on), so rejecting speculated
+    tokens is just not advancing ``pos`` over their rows.
+
+    Returns ``(tokens (B, S0 + max_new), stats)`` with
+    ``stats = {"rounds": r, "drafted": d, "accepted": a}`` —
+    ``a / d`` is the acceptance rate and ``(max_new * B) / (r)`` the
+    mean tokens per target pass.  Greedy only (temperature 0): sampled
+    speculative decoding needs draft-distribution rejection sampling,
+    which this framework does not implement.  No reference analog (the
+    reference has no inference stack).
+    """
+    b, s0 = prompt.shape
+    k_tok = n_spec + 1
+    use_kernel = default_decode_kernel(decode_kernel)
+    max_len = pad_cache_len(s0 + max_new + k_tok)
+    cdtype = dtype or jnp.float32
+    cache = init_cache(cfg, b, max_len, dtype=cdtype,
+                       kv_heads=params["layer0"]["wk"].shape[1])
+    dcache = init_cache(draft_cfg, b, max_len, dtype=cdtype,
+                        kv_heads=draft_params["layer0"]["wk"].shape[1])
+
+    # prefill BOTH models over the prompt; t0 = target's greedy token
+    logits, cache = _forward_cached(
+        params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, dtype=dtype,
+        unembed_last_only=True, k_len=s0)
+    t0 = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+    _, dcache = _forward_cached(
+        draft_params, dcache, prompt, jnp.arange(s0), 0, cfg=draft_cfg,
+        dtype=dtype, unembed_last_only=True, k_len=s0)
+
+    out0 = jnp.zeros((b, max_new), jnp.int32)
+    out0 = out0.at[:, 0].set(t0)
+    done0 = ((t0 == eos_id) if eos_id is not None
+             else jnp.zeros((b,), bool))
+
+    def cond(c):
+        return jnp.any((c["n"] < max_new) & ~c["done"])
+
+    def body(c):
+        pos, last = c["pos"], c["last"]
+
+        # 1. draft proposes n_spec greedy tokens (single-token steps).
+        # One EXTRA step runs so the last proposal's own KV row lands in
+        # the draft cache too — when every draft is accepted, the next
+        # round's reads pass that row (the scan writes each step's
+        # INPUT, so n steps alone would leave d_n's row unwritten and
+        # poison every later round's draft context).
+        def draft_step(carry, _):
+            dc, tok, p = carry
+            lg, dc = decode_step_ragged(draft_params, dc, tok, p + 1,
+                                        cfg=draft_cfg, dtype=dtype,
+                                        use_decode_kernel=use_kernel)
+            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+            return (dc, nxt, p + 1), nxt
+
+        (dcache, _, _), drafts = lax.scan(
+            draft_step, (c["dcache"], last, pos), None, length=n_spec + 1)
+        drafts = drafts[:n_spec].T  # (B, n_spec); the extra is discarded
+
+        # 2. target verifies all proposals in ONE (B, k_tok) forward
+        tokens_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        vpos = pos[:, None] + 1 + jnp.arange(k_tok)[None]  # (B, k_tok)
+        vlogits, cache2 = _forward_cached(
+            params, c["cache"], tokens_in, vpos,
+            pos + 1, cfg=cfg, dtype=dtype, k_len=max_len)
+        g = jnp.argmax(vlogits, -1).astype(jnp.int32)  # (B, k_tok)
+
+        # 3. longest accepted prefix: draft j accepted iff it equals the
+        # target's token after the previous accepted prefix
+        match = drafts == g[:, :n_spec]                 # (B, n_spec)
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        # emitted tokens this round: drafts[:m], then g[m] — m+1 total
+        j = jnp.arange(k_tok)[None]                     # (B, k_tok) grid
+        gm = jnp.take_along_axis(g, m[:, None], axis=1)
+        emit = jnp.where(j < m[:, None],
+                         jnp.concatenate([drafts, drafts[:, -1:]], 1),
+                         jnp.broadcast_to(gm, (b, k_tok)))
+        n_emit = jnp.where(c["done"], 0, m + 1)
+        if eos_id is not None:
+            # stop at the first emitted eos (inclusive)
+            is_eos = emit == eos_id
+            first_eos = jnp.argmax(is_eos, axis=1)
+            has_eos = jnp.any(is_eos & (j < n_emit[:, None]), axis=1)
+            n_emit = jnp.where(has_eos,
+                               jnp.minimum(n_emit, first_eos + 1), n_emit)
+        n_emit = jnp.minimum(n_emit, max_new - c["n"])
+
+        # 4. scatter the emitted tokens into the output buffer
+        cols = c["n"][:, None] + j                      # (B, k_tok)
+        valid = j < n_emit[:, None]
+        rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, k_tok))
+        out = c["out"].at[rows, jnp.where(valid, cols, max_new)].set(
+            jnp.where(valid, emit, 0), mode="drop")
+
+        new_done = c["done"] | (c["n"] + n_emit >= max_new)
+        if eos_id is not None:
+            new_done = new_done | jnp.any(
+                (emit == eos_id) & valid, axis=1)
+        last_new = jnp.take_along_axis(
+            emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+        return dict(
+            cache=cache2, dcache=dcache,
+            pos=jnp.where(c["done"], pos, pos + n_emit),
+            last=jnp.where(c["done"] | (n_emit == 0), last, last_new),
+            out=out, n=c["n"] + n_emit, done=new_done,
+            rounds=c["rounds"] + 1,
+            drafted=c["drafted"] + jnp.sum(
+                jnp.where(c["done"], 0, n_spec)),
+            accepted=c["accepted"] + jnp.sum(jnp.where(c["done"], 0, m)))
+
+    state = lax.while_loop(cond, body, dict(
+        cache=cache, dcache=dcache, pos=jnp.full((b,), s0 - 1, jnp.int32),
+        last=t0, out=out0, n=jnp.ones((b,), jnp.int32), done=done0,
+        rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0)))
+    out = state["out"]
+    if eos_id is not None:
+        # match generate()'s fixed-shape convention: positions from the
+        # first emitted eos onward all hold the eos (a stopped sequence
+        # "keeps emitting it"), not the zero-initialized buffer
+        seen = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1) > 0
+        out = jnp.where(seen, eos_id, out)
+    tokens = jnp.concatenate([prompt, out], axis=1)
+    stats = {"rounds": state["rounds"], "drafted": state["drafted"],
+             "accepted": state["accepted"]}
+    return tokens, stats
+
+
 _TP_JIT_CACHE: dict = {}
 
 

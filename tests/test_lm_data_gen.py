@@ -423,3 +423,61 @@ def test_paged_decode_step_matches_dense_ragged():
         gen.decode_step_ragged(params, p_cache, tok, d_pos, cfg=cfg,
                                use_decode_kernel=False,
                                page_table=table_j)
+
+
+# -- speculative decoding ---------------------------------------------------
+
+def test_speculative_matches_target_greedy():
+    """Greedy speculative decoding reproduces the target's plain greedy
+    stream exactly (f32), for any draft quality: an unrelated random
+    draft (low acceptance), the target itself as draft (full
+    acceptance), and batched prompts."""
+    draft_cfg = tfm.TransformerConfig(vocab_size=256, d_model=64,
+                                      n_layers=1, n_heads=2, head_dim=32,
+                                      d_ff=128)
+    params = tfm.init(jax.random.key(0), CFG)
+    draft = tfm.init(jax.random.key(1), draft_cfg)
+    rng = np.random.default_rng(0)
+    for b, s0, new in [(1, 7, 24), (3, 12, 33)]:
+        prompt = jnp.asarray(rng.integers(0, 256, (b, s0)).astype(np.int32))
+        want = np.asarray(gen.generate(
+            params, prompt, jax.random.key(2), cfg=CFG, max_new=new,
+            temperature=0.0))
+        got, stats = gen.generate_speculative(
+            params, draft, prompt, cfg=CFG, draft_cfg=draft_cfg,
+            max_new=new, n_spec=4)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert int(stats["rounds"]) >= 1
+
+    # target as its own draft: every proposal accepted (up to rare f32
+    # batched-vs-single near-tie reassociation), ~max_new/(n_spec+1)
+    # target passes
+    prompt = jnp.asarray(rng.integers(0, 256, (2, 10)).astype(np.int32))
+    want = np.asarray(gen.generate(params, prompt, jax.random.key(2),
+                                   cfg=CFG, max_new=30, temperature=0.0))
+    got, stats = gen.generate_speculative(
+        params, params, prompt, cfg=CFG, draft_cfg=CFG, max_new=30,
+        n_spec=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(stats["accepted"]) >= 0.9 * int(stats["drafted"]), stats
+    assert int(stats["rounds"]) <= 10, stats
+
+
+def test_speculative_eos_stops():
+    """A sequence that emits its eos stops there, and the fixed-shape
+    output matches generate()'s convention exactly: positions from the
+    first eos onward all hold the eos."""
+    params = tfm.init(jax.random.key(0), CFG)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 256, (1, 8)).astype(np.int32))
+    # find what greedy emits 3rd, use it as eos
+    ref = np.asarray(gen.generate(params, prompt, jax.random.key(2),
+                                  cfg=CFG, max_new=12, temperature=0.0))[0]
+    eos = int(ref[8 + 2])
+    want = np.asarray(gen.generate(params, prompt, jax.random.key(2),
+                                   cfg=CFG, max_new=12, temperature=0.0,
+                                   eos_id=eos))[0]
+    got, _ = gen.generate_speculative(
+        params, params, prompt, cfg=CFG, draft_cfg=CFG, max_new=12,
+        n_spec=3, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got)[0], want)
