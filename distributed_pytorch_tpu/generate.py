@@ -478,6 +478,75 @@ def generate(
                           decode_kernel=decode_kernel)
 
 
+def _spec_prefill(params, prompt, cfg, dtype, max_len_pad):
+    """Shared speculative prologue: prefill the target over the prompt,
+    return (cache, first greedy token t0, done0 mask)."""
+    b, s0 = prompt.shape
+    cache = init_cache(cfg, b, max_len_pad, dtype=dtype or jnp.float32,
+                       kv_heads=params["layer0"]["wk"].shape[1])
+    logits, cache = _forward_cached(
+        params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, dtype=dtype,
+        unembed_last_only=True, k_len=s0)
+    t0 = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+    return cache, t0
+
+
+def _spec_epilogue(prompt, out, state, eos_id):
+    """Shared speculative epilogue: eos-repeat padding (generate()'s
+    fixed-shape convention), prompt concat, and the stats dict."""
+    if eos_id is not None:
+        seen = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1) > 0
+        out = jnp.where(seen, eos_id, out)
+    tokens = jnp.concatenate([prompt, out], axis=1)
+    stats = {"rounds": state["rounds"], "drafted": state["drafted"],
+             "accepted": state["accepted"]}
+    return tokens, stats
+
+
+def _spec_accept_emit(drafts, g, done, n, buf, buf_off, n_spec, max_new,
+                      eos_id):
+    """One speculative round's accept + emit + scatter, shared by the
+    draft-model and prompt-lookup paths.  ``drafts`` (B, n_spec)
+    proposals, ``g`` (B, n_spec+1) target argmaxes; returns (updated
+    ``buf`` — emissions scattered at row offsets ``buf_off + n``,
+    n_emit, accepted count m, last emitted token, new done mask).
+
+    Draft j is accepted iff it equals the target's token after the
+    previous accepted prefix; the emitted round is drafts[:m] plus the
+    target's own g[m] — m+1 tokens, capped by eos and max_new."""
+    b = drafts.shape[0]
+    k_tok = n_spec + 1
+    match = drafts == g[:, :n_spec]                 # (B, n_spec)
+    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    j = jnp.arange(k_tok)[None]                     # (B, k_tok) grid
+    gm = jnp.take_along_axis(g, m[:, None], axis=1)
+    emit = jnp.where(j < m[:, None],
+                     jnp.concatenate([drafts, drafts[:, -1:]], 1),
+                     jnp.broadcast_to(gm, (b, k_tok)))
+    n_emit = jnp.where(done, 0, m + 1)
+    if eos_id is not None:
+        # stop at the first emitted eos (inclusive)
+        is_eos = emit == eos_id
+        first_eos = jnp.argmax(is_eos, axis=1)
+        has_eos = jnp.any(is_eos & (j < n_emit[:, None]), axis=1)
+        n_emit = jnp.where(has_eos,
+                           jnp.minimum(n_emit, first_eos + 1), n_emit)
+    n_emit = jnp.minimum(n_emit, max_new - n)
+
+    cols = buf_off + n[:, None] + j                 # (B, k_tok)
+    valid = j < n_emit[:, None]
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, k_tok))
+    buf = buf.at[rows, jnp.where(valid, cols, buf.shape[1])].set(
+        jnp.where(valid, emit, 0), mode="drop")
+
+    new_done = done | (n + n_emit >= max_new)
+    if eos_id is not None:
+        new_done = new_done | jnp.any((emit == eos_id) & valid, axis=1)
+    last_new = jnp.take_along_axis(
+        emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    return buf, n_emit, m, last_new, new_done
+
+
 @partial(jax.jit, static_argnames=("cfg", "draft_cfg", "max_new",
                                    "n_spec", "dtype", "eos_id",
                                    "decode_kernel"))
@@ -523,20 +592,11 @@ def generate_speculative(
     k_tok = n_spec + 1
     use_kernel = default_decode_kernel(decode_kernel)
     max_len = pad_cache_len(s0 + max_new + k_tok)
-    cdtype = dtype or jnp.float32
-    cache = init_cache(cfg, b, max_len, dtype=cdtype,
-                       kv_heads=params["layer0"]["wk"].shape[1])
-    dcache = init_cache(draft_cfg, b, max_len, dtype=cdtype,
-                        kv_heads=draft_params["layer0"]["wk"].shape[1])
 
     # prefill BOTH models over the prompt; t0 = target's greedy token
-    logits, cache = _forward_cached(
-        params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, dtype=dtype,
-        unembed_last_only=True, k_len=s0)
-    t0 = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
-    _, dcache = _forward_cached(
-        draft_params, dcache, prompt, jnp.arange(s0), 0, cfg=draft_cfg,
-        dtype=dtype, unembed_last_only=True, k_len=s0)
+    cache, t0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
+    dcache, _ = _spec_prefill(draft_params, prompt, draft_cfg, dtype,
+                              max_len)
 
     out0 = jnp.zeros((b, max_new), jnp.int32)
     out0 = out0.at[:, 0].set(t0)
@@ -575,39 +635,11 @@ def generate_speculative(
             pos + 1, cfg=cfg, dtype=dtype, k_len=max_len)
         g = jnp.argmax(vlogits, -1).astype(jnp.int32)  # (B, k_tok)
 
-        # 3. longest accepted prefix: draft j accepted iff it equals the
-        # target's token after the previous accepted prefix
-        match = drafts == g[:, :n_spec]                 # (B, n_spec)
-        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        # emitted tokens this round: drafts[:m], then g[m] — m+1 total
-        j = jnp.arange(k_tok)[None]                     # (B, k_tok) grid
-        gm = jnp.take_along_axis(g, m[:, None], axis=1)
-        emit = jnp.where(j < m[:, None],
-                         jnp.concatenate([drafts, drafts[:, -1:]], 1),
-                         jnp.broadcast_to(gm, (b, k_tok)))
-        n_emit = jnp.where(c["done"], 0, m + 1)
-        if eos_id is not None:
-            # stop at the first emitted eos (inclusive)
-            is_eos = emit == eos_id
-            first_eos = jnp.argmax(is_eos, axis=1)
-            has_eos = jnp.any(is_eos & (j < n_emit[:, None]), axis=1)
-            n_emit = jnp.where(has_eos,
-                               jnp.minimum(n_emit, first_eos + 1), n_emit)
-        n_emit = jnp.minimum(n_emit, max_new - c["n"])
-
-        # 4. scatter the emitted tokens into the output buffer
-        cols = c["n"][:, None] + j                      # (B, k_tok)
-        valid = j < n_emit[:, None]
-        rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, k_tok))
-        out = c["out"].at[rows, jnp.where(valid, cols, max_new)].set(
-            jnp.where(valid, emit, 0), mode="drop")
-
-        new_done = c["done"] | (c["n"] + n_emit >= max_new)
-        if eos_id is not None:
-            new_done = new_done | jnp.any(
-                (emit == eos_id) & valid, axis=1)
-        last_new = jnp.take_along_axis(
-            emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+        # 3+4. accept the longest matching prefix and scatter the
+        # emissions (shared with prompt-lookup speculation)
+        out, n_emit, m, last_new, new_done = _spec_accept_emit(
+            drafts, g, c["done"], c["n"], c["out"], 0, n_spec, max_new,
+            eos_id)
         return dict(
             cache=cache2, dcache=dcache,
             pos=jnp.where(c["done"], pos, pos + n_emit),
@@ -622,17 +654,102 @@ def generate_speculative(
         cache=cache, dcache=dcache, pos=jnp.full((b,), s0 - 1, jnp.int32),
         last=t0, out=out0, n=jnp.ones((b,), jnp.int32), done=done0,
         rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0)))
-    out = state["out"]
-    if eos_id is not None:
-        # match generate()'s fixed-shape convention: positions from the
-        # first emitted eos onward all hold the eos (a stopped sequence
-        # "keeps emitting it"), not the zero-initialized buffer
-        seen = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1) > 0
-        out = jnp.where(seen, eos_id, out)
-    tokens = jnp.concatenate([prompt, out], axis=1)
-    stats = {"rounds": state["rounds"], "drafted": state["drafted"],
-             "accepted": state["accepted"]}
-    return tokens, stats
+    return _spec_epilogue(prompt, state["out"], state, eos_id)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "n_spec", "ngram",
+                                   "dtype", "eos_id"))
+def generate_lookup(
+    params: PyTree,
+    prompt: jax.Array,       # (B, S0) int32
+    *,
+    cfg: tfm.TransformerConfig,
+    max_new: int,
+    n_spec: int = 8,
+    ngram: int = 2,
+    dtype=None,
+    eos_id: int | None = None,
+):
+    """PROMPT-LOOKUP speculative decoding: draft-model-free greedy
+    speculation where each round's proposals come from matching the
+    trailing ``ngram`` tokens against the prompt + generated-so-far
+    stream and copying the continuation of the most recent match.  The
+    target verifies all ``n_spec`` proposals in one batched forward
+    (exactly as ``generate_speculative``), so the output is identical
+    to the target's plain greedy decode regardless of proposal quality
+    — bad lookups just waste a round's speculation, never correctness.
+
+    Wins on copy-heavy continuations (summarization, code, retrieval,
+    repetitive corpora) where the next tokens literally appear earlier
+    in the context; costs nothing when they don't (the proposal lookup
+    is a handful of vector compares — no draft model, no draft cache).
+    Returns ``(tokens, stats)`` as ``generate_speculative``.
+    """
+    b, s0 = prompt.shape
+    k_tok = n_spec + 1
+    total = s0 + max_new
+    max_len = pad_cache_len(total + k_tok)
+    cache, t0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
+
+    stream0 = jnp.zeros((b, total), jnp.int32)
+    stream0 = stream0.at[:, :s0].set(prompt).at[:, s0].set(t0)
+    done0 = ((t0 == eos_id) if eos_id is not None
+             else jnp.zeros((b,), bool))
+
+    def proposals(stream, n):
+        """Continuation of the most recent earlier occurrence of the
+        trailing ngram; repeats of the last token when none exists."""
+        last_i = s0 + n - 1                     # (B,) index of last token
+        jgrid = jnp.arange(total - ngram + 1)[None]     # window starts
+        win_ok = jnp.ones((b, total - ngram + 1), bool)
+        for o in range(ngram):
+            tail = jnp.take_along_axis(
+                stream, (last_i - (ngram - 1) + o)[:, None], axis=1)
+            win_ok &= stream[:, o:total - ngram + 1 + o] == tail
+        # exclude the trailing ngram matching itself; window tokens and
+        # at least the first continuation token must be already written
+        win_ok &= jgrid <= (last_i - ngram)[:, None]
+        jbest = jnp.max(jnp.where(win_ok, jgrid, -1), axis=1)
+        base = jnp.where(jbest >= 0, jbest + ngram, 0)
+        idx = jnp.clip(base[:, None] + jnp.arange(n_spec)[None],
+                       0, total - 1)
+        props = jnp.take_along_axis(stream, idx, axis=1)
+        lastv = jnp.take_along_axis(stream, last_i[:, None], axis=1)
+        return jnp.where((jbest >= 0)[:, None], props,
+                         jnp.broadcast_to(lastv, (b, n_spec)))
+
+    def cond(c):
+        return jnp.any((c["n"] < max_new) & ~c["done"])
+
+    def body(c):
+        pos = c["pos"]
+        last = jnp.take_along_axis(c["stream"],
+                                   (s0 + c["n"] - 1)[:, None], axis=1)[:, 0]
+        drafts = proposals(c["stream"], c["n"])
+        tokens_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        vpos = pos[:, None] + 1 + jnp.arange(k_tok)[None]
+        vlogits, cache2 = _forward_cached(
+            params, c["cache"], tokens_in, vpos, pos + 1,
+            cfg=cfg, dtype=dtype, k_len=max_len)
+        g = jnp.argmax(vlogits, -1).astype(jnp.int32)
+        stream, n_emit, m, _, new_done = _spec_accept_emit(
+            drafts, g, c["done"], c["n"], c["stream"], s0, n_spec,
+            max_new, eos_id)
+        return dict(
+            cache=cache2, stream=stream,
+            pos=jnp.where(c["done"], pos, pos + n_emit),
+            n=c["n"] + n_emit, done=new_done,
+            rounds=c["rounds"] + 1,
+            drafted=c["drafted"] + jnp.sum(
+                jnp.where(c["done"], 0, n_spec)),
+            accepted=c["accepted"] + jnp.sum(jnp.where(c["done"], 0, m)))
+
+    state = lax.while_loop(cond, body, dict(
+        cache=cache, stream=stream0,
+        pos=jnp.full((b,), s0 - 1, jnp.int32),
+        n=jnp.ones((b,), jnp.int32), done=done0,
+        rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0)))
+    return _spec_epilogue(prompt, state["stream"][:, s0:], state, eos_id)
 
 
 _TP_JIT_CACHE: dict = {}

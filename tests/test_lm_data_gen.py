@@ -481,3 +481,46 @@ def test_speculative_eos_stops():
         params, params, prompt, cfg=CFG, draft_cfg=CFG, max_new=12,
         n_spec=3, eos_id=eos)
     np.testing.assert_array_equal(np.asarray(got)[0], want)
+
+
+def test_lookup_speculation_matches_target_greedy():
+    """Prompt-lookup speculation (draft-model-free): output is exactly
+    the target's plain greedy stream for arbitrary prompts — bad
+    lookups can only waste a round, never change tokens — including
+    batched prompts and a repetitive prompt where lookups actually
+    accept."""
+    params = tfm.init(jax.random.key(0), CFG)
+    rng = np.random.default_rng(7)
+    for b, s0, new in [(1, 9, 25), (3, 16, 34)]:
+        prompt = jnp.asarray(rng.integers(0, 256, (b, s0)).astype(np.int32))
+        want = np.asarray(gen.generate(
+            params, prompt, jax.random.key(2), cfg=CFG, max_new=new,
+            temperature=0.0))
+        got, stats = gen.generate_lookup(params, prompt, cfg=CFG,
+                                         max_new=new, n_spec=6)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert int(stats["rounds"]) >= 1
+
+    pat = jnp.asarray(np.tile(np.asarray([5, 9, 23, 7], np.int32), 8)[None])
+    want = np.asarray(gen.generate(params, pat, jax.random.key(2), cfg=CFG,
+                                   max_new=20, temperature=0.0))
+    got, stats = gen.generate_lookup(params, pat, cfg=CFG, max_new=20,
+                                     n_spec=6, ngram=2)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_lookup_speculation_eos_matches_generate():
+    """generate_lookup with eos_id reproduces generate()'s fixed-shape
+    output exactly, including the eos-repeat tail convention."""
+    params = tfm.init(jax.random.key(0), CFG)
+    rng = np.random.default_rng(11)
+    prompt = jnp.asarray(rng.integers(0, 256, (2, 10)).astype(np.int32))
+    ref = np.asarray(gen.generate(params, prompt, jax.random.key(2),
+                                  cfg=CFG, max_new=16, temperature=0.0))
+    eos = int(ref[0, 10 + 3])  # some token greedy actually emits
+    want = np.asarray(gen.generate(params, prompt, jax.random.key(2),
+                                   cfg=CFG, max_new=16, temperature=0.0,
+                                   eos_id=eos))
+    got, _ = gen.generate_lookup(params, prompt, cfg=CFG, max_new=16,
+                                 n_spec=5, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), want)
