@@ -306,45 +306,61 @@ def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
     return logits[:, 0], cache
 
 
+def _filter_logits(logits, temperature: float, top_k: int | None,
+                   top_p: float | None):
+    """Temperature-scale + top-k/top-p mask (NEG_INF outside the keep
+    set) over the last axis; requires ``temperature > 0``.  Filter
+    semantics IDENTICAL to ``sample_per_seq`` (the serving path): both
+    thresholds come from ONE descending sort of the temperature-scaled
+    distribution — top-p is the smallest prefix with mass >= p computed
+    on the FULL distribution (not the top-k-renormalized one), and the
+    masks intersect.  ``softmax`` of the result is the WARPED target/
+    draft distribution that sampled speculative decoding must preserve
+    exactly (the rejection-sampling identity applies to whatever
+    distribution both sides agree on — here the warped one)."""
+    scaled = logits / temperature
+    v = logits.shape[-1]
+    # top_k outside (0, v) keeps all tokens (a 50-of-32 filter is a
+    # no-op, and 0/None disable), matching sample_per_seq's clamping
+    want_k = top_k is not None and 0 < top_k < v
+    want_p = top_p is not None and top_p < 1.0
+    if not want_k and not want_p:
+        return scaled
+    sorted_desc = jnp.sort(scaled, -1)[..., ::-1]
+    masked = scaled
+    if want_k:
+        kth = sorted_desc[..., top_k - 1:top_k]
+        masked = jnp.where(scaled < kth, NEG_INF, masked)
+    if want_p:
+        probs = jax.nn.softmax(sorted_desc, -1)
+        exclusive_cum = jnp.cumsum(probs, -1) - probs
+        nkeep = jnp.sum(exclusive_cum < top_p, -1)
+        pidx = jnp.clip(nkeep - 1, 0, scaled.shape[-1] - 1)
+        pth = jnp.take_along_axis(sorted_desc, pidx[..., None], axis=-1)
+        masked = jnp.where(scaled < pth, NEG_INF, masked)
+    return masked
+
+
 def _sample(key, logits, temperature: float, top_k: int | None,
             top_p: float | None = None):
-    """Static-parameter sampling; filter semantics IDENTICAL to
-    ``sample_per_seq`` (the serving path): both thresholds come from ONE
-    descending sort of the temperature-scaled distribution — top-p is the
-    smallest prefix with mass >= p computed on the FULL distribution (not
-    the top-k-renormalized one), and the masks intersect."""
+    """Static-parameter sampling: greedy at temperature 0, else a
+    categorical draw from the ``_filter_logits``-warped distribution."""
     if temperature == 0.0:
         return jnp.argmax(logits, -1).astype(jnp.int32)
-    scaled = logits / temperature
-    want_p = top_p is not None and top_p < 1.0
-    if top_k is not None or want_p:
-        sorted_desc = jnp.sort(scaled, -1)[:, ::-1]
-        masked = scaled
-        if top_k is not None:
-            kth = sorted_desc[:, top_k - 1][:, None]
-            masked = jnp.where(scaled < kth, NEG_INF, masked)
-        if want_p:
-            probs = jax.nn.softmax(sorted_desc, -1)
-            exclusive_cum = jnp.cumsum(probs, -1) - probs
-            nkeep = jnp.sum(exclusive_cum < top_p, -1)
-            pidx = jnp.clip(nkeep - 1, 0, scaled.shape[-1] - 1)
-            pth = jnp.take_along_axis(sorted_desc, pidx[:, None], axis=1)
-            masked = jnp.where(scaled < pth, NEG_INF, masked)
-        scaled = masked
-    return jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
 
 
-def sample_per_seq(key, logits, temperature, top_k, top_p):
-    """Sampling with PER-ROW parameters (continuous batching: every slot
-    serves a different request with its own settings, in one compiled
-    step).  ``logits`` (B, V); ``temperature`` (B,) f32 — <= 0 means
-    greedy; ``top_k`` (B,) int32 — 0 disables; ``top_p`` (B,) f32 — >= 1
-    disables (nucleus sampling, computed on the temperature-scaled
-    distribution).  Threshold ties keep all tied tokens, matching
-    ``_sample``.  One (B, V) sort serves both filters; V is the LM head
-    width, so this is noise next to the decode matmuls."""
+def filter_per_seq(logits, temperature, top_k, top_p):
+    """PER-ROW ``_filter_logits``: temperature-scale + top-k/top-p mask
+    with (B,)-vector parameters — the warp behind ``sample_per_seq``,
+    exposed for callers that need each row's exact warped distribution
+    (not just a draw from it).  ``temperature`` <= 0 rows are
+    scaled by 1e-6 (the caller overrides them with argmax); ``top_k`` 0
+    and ``top_p`` >= 1 disable their filters.  Threshold ties keep all
+    tied tokens, matching ``_filter_logits``."""
     v = logits.shape[-1]
-    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     sorted_desc = jnp.sort(scaled, -1)[:, ::-1]
     # top-k: mask strictly below the k-th largest value (k=0: keep all)
@@ -358,8 +374,21 @@ def sample_per_seq(key, logits, temperature, top_k, top_p):
     nkeep = jnp.sum(exclusive_cum < top_p[:, None], -1)  # >= 1 always
     pidx = jnp.clip(nkeep - 1, 0, v - 1)
     pth = jnp.take_along_axis(sorted_desc, pidx[:, None], axis=1)
-    masked = jnp.where((top_p[:, None] < 1.0) & (scaled < pth),
-                       NEG_INF, masked)
+    return jnp.where((top_p[:, None] < 1.0) & (scaled < pth),
+                     NEG_INF, masked)
+
+
+def sample_per_seq(key, logits, temperature, top_k, top_p):
+    """Sampling with PER-ROW parameters (continuous batching: every slot
+    serves a different request with its own settings, in one compiled
+    step).  ``logits`` (B, V); ``temperature`` (B,) f32 — <= 0 means
+    greedy; ``top_k`` (B,) int32 — 0 disables; ``top_p`` (B,) f32 — >= 1
+    disables (nucleus sampling, computed on the temperature-scaled
+    distribution).  One (B, V) sort serves both filters
+    (``filter_per_seq``); V is the LM head width, so this is noise next
+    to the decode matmuls."""
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    masked = filter_per_seq(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, masked).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
@@ -479,16 +508,17 @@ def generate(
 
 
 def _spec_prefill(params, prompt, cfg, dtype, max_len_pad):
-    """Shared speculative prologue: prefill the target over the prompt,
-    return (cache, first greedy token t0, done0 mask)."""
+    """Shared speculative prologue: prefill the model over the prompt,
+    return ``(cache, (B, vocab) last-position logits)`` (each caller
+    derives its own first token — argmax or a warped sample — and done
+    mask from the logits)."""
     b, s0 = prompt.shape
     cache = init_cache(cfg, b, max_len_pad, dtype=dtype or jnp.float32,
                        kv_heads=params["layer0"]["wk"].shape[1])
     logits, cache = _forward_cached(
         params, cache, prompt, jnp.arange(s0), 0, cfg=cfg, dtype=dtype,
         unembed_last_only=True, k_len=s0)
-    t0 = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
-    return cache, t0
+    return cache, logits[:, 0]
 
 
 def _spec_epilogue(prompt, out, state, eos_id):
@@ -503,20 +533,62 @@ def _spec_epilogue(prompt, out, state, eos_id):
     return tokens, stats
 
 
+def _spec_reject_tokens(key, drafts, q, p):
+    """Draft-distribution REJECTION SAMPLING (Leviathan/Chen et al.),
+    vectorized over every speculated position at once: ``drafts``
+    (B, k) tokens drawn from the draft distributions ``q`` (B, k, V);
+    ``p`` (B, k+1, V) the target's (warped) distributions at the same
+    positions plus the one after.  Returns ``(match, g)`` in the shape
+    ``_spec_accept_emit`` consumes:
+
+    - ``match[b, j]`` — position j's draft is accepted, with probability
+      ``min(1, p_j(x_j) / q_j(x_j))`` (x_j was drawn from q_j, so
+      q_j(x_j) > 0);
+    - ``g[b, j]`` — the token emitted after accepting a length-j prefix:
+      for j < k a sample from the RESIDUAL ``norm(max(p_j - q_j, 0))``
+      (the distribution that makes accept-or-resample marginally EXACTLY
+      p_j — the standard guarantee), for j = k a plain sample from
+      ``p_k`` (every draft accepted: the bonus token).
+
+    All k residual draws happen up front (cheap next to the verify
+    forward); only the one at the actual rejection point is emitted.  A
+    pointwise-zero residual (p_j <= q_j everywhere except x_j) can only
+    arise where acceptance is certain, so its replacement is never
+    emitted — it falls back to p_j to stay NaN-free."""
+    b, k, v = q.shape
+    ku, kr, kb = jax.random.split(key, 3)
+    px = jnp.take_along_axis(p[:, :k], drafts[..., None], 2)[..., 0]
+    qx = jnp.take_along_axis(q, drafts[..., None], 2)[..., 0]
+    u = jax.random.uniform(ku, (b, k))
+    match = u * qx < px                             # u < p(x)/q(x)
+    resid = jnp.maximum(p[:, :k] - q, 0.0)
+    rs = jnp.sum(resid, -1, keepdims=True)
+    resid = jnp.where(rs > 0, resid / rs, p[:, :k])
+    repl = jax.random.categorical(kr, jnp.log(resid + 1e-38), axis=-1)
+    bonus = jax.random.categorical(kb, jnp.log(p[:, -1] + 1e-38), axis=-1)
+    return match, jnp.concatenate(
+        [repl, bonus[:, None]], axis=1).astype(jnp.int32)
+
+
 def _spec_accept_emit(drafts, g, done, n, buf, buf_off, n_spec, max_new,
-                      eos_id):
+                      eos_id, match=None):
     """One speculative round's accept + emit + scatter, shared by the
     draft-model and prompt-lookup paths.  ``drafts`` (B, n_spec)
-    proposals, ``g`` (B, n_spec+1) target argmaxes; returns (updated
-    ``buf`` — emissions scattered at row offsets ``buf_off + n``,
-    n_emit, accepted count m, last emitted token, new done mask).
+    proposals, ``g`` (B, n_spec+1) the per-prefix-length continuation
+    tokens (greedy: the target argmaxes; sampled: rejection-sampling
+    replacements); returns (updated ``buf`` — emissions scattered at row
+    offsets ``buf_off + n``, n_emit, accepted count m, last emitted
+    token, new done mask).
 
-    Draft j is accepted iff it equals the target's token after the
-    previous accepted prefix; the emitted round is drafts[:m] plus the
-    target's own g[m] — m+1 tokens, capped by eos and max_new."""
+    GREEDY default (``match=None``): draft j is accepted iff it equals
+    the target's argmax after the previous accepted prefix.  A sampled
+    path passes its own accept mask (``_spec_reject_tokens``).  Either
+    way the emitted round is drafts[:m] plus g[m] — m+1 tokens, capped
+    by eos and max_new."""
     b = drafts.shape[0]
     k_tok = n_spec + 1
-    match = drafts == g[:, :n_spec]                 # (B, n_spec)
+    if match is None:
+        match = drafts == g[:, :n_spec]             # (B, n_spec)
     m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
     j = jnp.arange(k_tok)[None]                     # (B, k_tok) grid
     gm = jnp.take_along_axis(g, m[:, None], axis=1)
@@ -549,26 +621,45 @@ def _spec_accept_emit(drafts, g, done, n, buf, buf_off, n_spec, max_new,
 
 @partial(jax.jit, static_argnames=("cfg", "draft_cfg", "max_new",
                                    "n_spec", "dtype", "eos_id",
-                                   "decode_kernel"))
+                                   "decode_kernel", "temperature",
+                                   "top_k", "top_p"))
 def generate_speculative(
     params: PyTree,
     draft_params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
+    key: jax.Array | None = None,
     *,
     cfg: tfm.TransformerConfig,
     draft_cfg: tfm.TransformerConfig,
     max_new: int,
     n_spec: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     dtype=None,
     eos_id: int | None = None,
     decode_kernel: bool | None = None,
 ):
-    """Greedy SPECULATIVE decoding: a small draft model proposes
-    ``n_spec`` tokens per round, the target model verifies them all in
-    ONE batched forward, and the longest matching prefix plus the
-    target's own next token are emitted — identical output to the
-    target's plain greedy decode (the standard guarantee), at up to
-    ``n_spec + 1`` tokens per target pass.
+    """SPECULATIVE decoding: a small draft model proposes ``n_spec``
+    tokens per round, the target model verifies them all in ONE batched
+    forward, and the longest accepted prefix plus one continuation
+    token are emitted — at up to ``n_spec + 1`` tokens per target pass.
+
+    ``temperature == 0`` (default): GREEDY speculation — a draft is
+    accepted iff it equals the target's argmax, and the output is
+    identical to the target's plain greedy decode (the standard
+    guarantee; ``key`` is ignored).
+
+    ``temperature > 0``: SAMPLED speculation via draft-distribution
+    rejection sampling (``_spec_reject_tokens``): the draft SAMPLES its
+    proposals from its warped distribution q, the target accepts each
+    with probability min(1, p/q), and a rejection resamples from the
+    residual norm(max(p - q, 0)) — the emitted tokens are distributed
+    EXACTLY as the target's own warped (temperature/top-k/top-p)
+    distribution, per the standard speculative-sampling identity.
+    Requires ``key``.  Both models are warped with the same
+    temperature/top_k/top_p (the sharper the draft, the higher the
+    acceptance — warping symmetrically is the usual choice).
 
     TPU-first shape: the verification pass is a (B, n_spec+1)-token
     batched forward — exactly the matmul-heavy work the MXU wants,
@@ -583,20 +674,28 @@ def generate_speculative(
     Returns ``(tokens (B, S0 + max_new), stats)`` with
     ``stats = {"rounds": r, "drafted": d, "accepted": a}`` —
     ``a / d`` is the acceptance rate and ``(max_new * B) / (r)`` the
-    mean tokens per target pass.  Greedy only (temperature 0): sampled
-    speculative decoding needs draft-distribution rejection sampling,
-    which this framework does not implement.  No reference analog (the
-    reference has no inference stack).
+    mean tokens per target pass.  No reference analog (the reference
+    has no inference stack).
     """
     b, s0 = prompt.shape
     k_tok = n_spec + 1
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError("sampled speculative decoding (temperature > 0) "
+                         "needs a PRNG key")
     use_kernel = default_decode_kernel(decode_kernel)
     max_len = pad_cache_len(s0 + max_new + k_tok)
 
-    # prefill BOTH models over the prompt; t0 = target's greedy token
-    cache, t0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
+    # prefill BOTH models over the prompt; t0 = target's first token
+    cache, logits0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
     dcache, _ = _spec_prefill(draft_params, prompt, draft_cfg, dtype,
                               max_len)
+    if sampled:
+        key, sub = jax.random.split(key)
+        t0 = _sample(sub, logits0, temperature, top_k, top_p)
+    else:
+        key = jax.random.key(0)  # unused; a concrete carry leaf
+        t0 = jnp.argmax(logits0, -1).astype(jnp.int32)
 
     out0 = jnp.zeros((b, max_new), jnp.int32)
     out0 = out0.at[:, 0].set(t0)
@@ -608,23 +707,33 @@ def generate_speculative(
 
     def body(c):
         pos, last = c["pos"], c["last"]
+        rkey, dkey, vkey = jax.random.split(c["key"], 3)
 
-        # 1. draft proposes n_spec greedy tokens (single-token steps).
-        # One EXTRA step runs so the last proposal's own KV row lands in
-        # the draft cache too — when every draft is accepted, the next
-        # round's reads pass that row (the scan writes each step's
-        # INPUT, so n steps alone would leave d_n's row unwritten and
-        # poison every later round's draft context).
-        def draft_step(carry, _):
+        # 1. draft proposes n_spec tokens (single-token steps): greedy
+        # argmaxes, or samples from its warped distribution (whose
+        # probs the rejection step needs).  One EXTRA step runs so the
+        # last proposal's own KV row lands in the draft cache too —
+        # when every draft is accepted, the next round's reads pass
+        # that row (the scan writes each step's INPUT, so n steps alone
+        # would leave d_n's row unwritten and poison every later
+        # round's draft context).
+        def draft_step(carry, dk):
             dc, tok, p = carry
             lg, dc = decode_step_ragged(draft_params, dc, tok, p + 1,
                                         cfg=draft_cfg, dtype=dtype,
                                         use_decode_kernel=use_kernel)
-            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-            return (dc, nxt, p + 1), nxt
+            if sampled:
+                warped = _filter_logits(lg, temperature, top_k, top_p)
+                nxt = jax.random.categorical(dk, warped).astype(jnp.int32)
+                qp = jax.nn.softmax(warped, -1)
+            else:
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                qp = jnp.zeros((b, 0), jnp.float32)  # unused
+            return (dc, nxt, p + 1), (nxt, qp)
 
-        (dcache, _, _), drafts = lax.scan(
-            draft_step, (c["dcache"], last, pos), None, length=n_spec + 1)
+        (dcache, _, _), (drafts, qprobs) = lax.scan(
+            draft_step, (c["dcache"], last, pos),
+            jax.random.split(dkey, n_spec + 1))
         drafts = drafts[:n_spec].T  # (B, n_spec); the extra is discarded
 
         # 2. target verifies all proposals in ONE (B, k_tok) forward
@@ -633,15 +742,22 @@ def generate_speculative(
         vlogits, cache2 = _forward_cached(
             params, c["cache"], tokens_in, vpos,
             pos + 1, cfg=cfg, dtype=dtype, k_len=max_len)
-        g = jnp.argmax(vlogits, -1).astype(jnp.int32)  # (B, k_tok)
+        if sampled:
+            pprobs = jax.nn.softmax(
+                _filter_logits(vlogits, temperature, top_k, top_p), -1)
+            match, g = _spec_reject_tokens(
+                vkey, drafts, qprobs[:n_spec].transpose(1, 0, 2), pprobs)
+        else:
+            match = None
+            g = jnp.argmax(vlogits, -1).astype(jnp.int32)  # (B, k_tok)
 
-        # 3+4. accept the longest matching prefix and scatter the
+        # 3+4. accept the longest accepted prefix and scatter the
         # emissions (shared with prompt-lookup speculation)
         out, n_emit, m, last_new, new_done = _spec_accept_emit(
             drafts, g, c["done"], c["n"], c["out"], 0, n_spec, max_new,
-            eos_id)
+            eos_id, match=match)
         return dict(
-            cache=cache2, dcache=dcache,
+            cache=cache2, dcache=dcache, key=rkey,
             pos=jnp.where(c["done"], pos, pos + n_emit),
             last=jnp.where(c["done"] | (n_emit == 0), last, last_new),
             out=out, n=c["n"] + n_emit, done=new_done,
@@ -651,33 +767,47 @@ def generate_speculative(
             accepted=c["accepted"] + jnp.sum(jnp.where(c["done"], 0, m)))
 
     state = lax.while_loop(cond, body, dict(
-        cache=cache, dcache=dcache, pos=jnp.full((b,), s0 - 1, jnp.int32),
+        cache=cache, dcache=dcache, key=key,
+        pos=jnp.full((b,), s0 - 1, jnp.int32),
         last=t0, out=out0, n=jnp.ones((b,), jnp.int32), done=done0,
         rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0)))
     return _spec_epilogue(prompt, state["out"], state, eos_id)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "n_spec", "ngram",
-                                   "dtype", "eos_id"))
+                                   "dtype", "eos_id", "temperature",
+                                   "top_k", "top_p"))
 def generate_lookup(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
+    key: jax.Array | None = None,
     *,
     cfg: tfm.TransformerConfig,
     max_new: int,
     n_spec: int = 8,
     ngram: int = 2,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     dtype=None,
     eos_id: int | None = None,
 ):
-    """PROMPT-LOOKUP speculative decoding: draft-model-free greedy
-    speculation where each round's proposals come from matching the
-    trailing ``ngram`` tokens against the prompt + generated-so-far
-    stream and copying the continuation of the most recent match.  The
-    target verifies all ``n_spec`` proposals in one batched forward
-    (exactly as ``generate_speculative``), so the output is identical
-    to the target's plain greedy decode regardless of proposal quality
-    — bad lookups just waste a round's speculation, never correctness.
+    """PROMPT-LOOKUP speculative decoding: draft-model-free speculation
+    where each round's proposals come from matching the trailing
+    ``ngram`` tokens against the prompt + generated-so-far stream and
+    copying the continuation of the most recent match.  The target
+    verifies all ``n_spec`` proposals in one batched forward (exactly
+    as ``generate_speculative``), so bad lookups only waste a round's
+    speculation, never correctness.
+
+    ``temperature == 0`` (default): greedy — output identical to the
+    target's plain greedy decode.  ``temperature > 0`` (requires
+    ``key``): the lookup proposal is a POINT-MASS draft distribution,
+    so rejection sampling degenerates cleanly — proposal x is accepted
+    with probability p(x) (its own warped target probability), and a
+    rejection resamples from p with x removed and renormalized
+    (``_spec_reject_tokens`` with one-hot q) — emitted tokens are
+    distributed exactly as the target's warped distribution.
 
     Wins on copy-heavy continuations (summarization, code, retrieval,
     repetitive corpora) where the next tokens literally appear earlier
@@ -687,9 +817,19 @@ def generate_lookup(
     """
     b, s0 = prompt.shape
     k_tok = n_spec + 1
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError("sampled lookup decoding (temperature > 0) "
+                         "needs a PRNG key")
     total = s0 + max_new
     max_len = pad_cache_len(total + k_tok)
-    cache, t0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
+    cache, logits0 = _spec_prefill(params, prompt, cfg, dtype, max_len)
+    if sampled:
+        key, sub = jax.random.split(key)
+        t0 = _sample(sub, logits0, temperature, top_k, top_p)
+    else:
+        key = jax.random.key(0)  # unused; a concrete carry leaf
+        t0 = jnp.argmax(logits0, -1).astype(jnp.int32)
 
     stream0 = jnp.zeros((b, total), jnp.int32)
     stream0 = stream0.at[:, :s0].set(prompt).at[:, s0].set(t0)
@@ -709,6 +849,13 @@ def generate_lookup(
         # exclude the trailing ngram matching itself; window tokens and
         # at least the first continuation token must be already written
         win_ok &= jgrid <= (last_i - ngram)[:, None]
+        # short-prefix rounds (ngram > last_i, e.g. a 1-token prompt on
+        # round 1): the trailing-ngram reads above clip negative indices
+        # to 0 and compare a wrong window, but the jgrid bound's negative
+        # RHS already rejects every candidate then.  This explicit guard
+        # states that invariant rather than leaning on the clip+bound
+        # interplay (round-4 advisor note).
+        win_ok &= (ngram <= last_i)[:, None]
         jbest = jnp.max(jnp.where(win_ok, jgrid, -1), axis=1)
         base = jnp.where(jbest >= 0, jbest + ngram, 0)
         idx = jnp.clip(base[:, None] + jnp.arange(n_spec)[None],
@@ -723,6 +870,7 @@ def generate_lookup(
 
     def body(c):
         pos = c["pos"]
+        rkey, vkey = jax.random.split(c["key"])
         last = jnp.take_along_axis(c["stream"],
                                    (s0 + c["n"] - 1)[:, None], axis=1)[:, 0]
         drafts = proposals(c["stream"], c["n"])
@@ -731,12 +879,19 @@ def generate_lookup(
         vlogits, cache2 = _forward_cached(
             params, c["cache"], tokens_in, vpos, pos + 1,
             cfg=cfg, dtype=dtype, k_len=max_len)
-        g = jnp.argmax(vlogits, -1).astype(jnp.int32)
+        if sampled:
+            pprobs = jax.nn.softmax(
+                _filter_logits(vlogits, temperature, top_k, top_p), -1)
+            q = jax.nn.one_hot(drafts, cfg.vocab_size, dtype=jnp.float32)
+            match, g = _spec_reject_tokens(vkey, drafts, q, pprobs)
+        else:
+            match = None
+            g = jnp.argmax(vlogits, -1).astype(jnp.int32)
         stream, n_emit, m, _, new_done = _spec_accept_emit(
             drafts, g, c["done"], c["n"], c["stream"], s0, n_spec,
-            max_new, eos_id)
+            max_new, eos_id, match=match)
         return dict(
-            cache=cache2, stream=stream,
+            cache=cache2, stream=stream, key=rkey,
             pos=jnp.where(c["done"], pos, pos + n_emit),
             n=c["n"] + n_emit, done=new_done,
             rounds=c["rounds"] + 1,
@@ -745,7 +900,7 @@ def generate_lookup(
             accepted=c["accepted"] + jnp.sum(jnp.where(c["done"], 0, m)))
 
     state = lax.while_loop(cond, body, dict(
-        cache=cache, stream=stream0,
+        cache=cache, stream=stream0, key=key,
         pos=jnp.full((b,), s0 - 1, jnp.int32),
         n=jnp.ones((b,), jnp.int32), done=done0,
         rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0)))

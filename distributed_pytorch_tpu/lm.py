@@ -146,10 +146,6 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
         if cfg.pp > 1:
             raise ValueError("dcn_size does not compose with pp (the "
                              "pipeline mesh has no factored data axis)")
-        if cfg.fsdp:
-            raise ValueError("dcn_size does not compose with fsdp yet "
-                             "(params would shard over the slice-local "
-                             "axis only; unimplemented)")
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -213,14 +209,20 @@ def param_specs(cfg: LMTrainConfig) -> PyTree:
     with MoE experts on the dedicated 'expert' axis and their FFN width
     tp-sharded (EP x TP; at ep=1 the expert axis is size 1, so experts are
     simply replicated across tp with tp-sharded FFNs).
-    With ``fsdp``, each leaf's first dp-divisible unsharded dim additionally
-    shards over 'data' (ZeRO-3): parameters and optimizer state shrink by
-    the dp degree per device; the train step all-gathers weights for use and
-    autodiff's transpose reduce-scatters the gradients back.
+    With ``fsdp``, each leaf's first data-divisible unsharded dim
+    additionally shards over 'data' (ZeRO-3): parameters and optimizer
+    state shrink by the data degree per device; the train step all-gathers
+    weights for use and autodiff's transpose reduce-scatters the gradients
+    back.  On the factored multislice mesh (``dcn_size > 1``) 'data' is
+    the SLICE-LOCAL inner axis — ZeRO-3 partitions within each slice
+    (all-gathers ride ICI) and the state replicates across 'dcn', so the
+    per-step cross-slice exchange stays one shard-sized gradient psum
+    (the standard FSDP x multislice layout).
     """
     specs = tfm.shard_specs(cfg.model, tp_axis=MODEL,
                             ep_axis=EXPERT if cfg.ep > 1 else None)
-    if not cfg.fsdp or cfg.dp == 1:
+    inner_dp = cfg.dp // cfg.dcn_size  # the mesh's actual 'data' size
+    if not cfg.fsdp or inner_dp == 1:
         return specs
     shapes = jax.eval_shape(lambda k: tfm.init(k, cfg.model),
                             jax.random.key(0))
@@ -228,7 +230,7 @@ def param_specs(cfg: LMTrainConfig) -> PyTree:
     def add_data(spec: P, shape) -> P:
         parts = list(spec) + [None] * (len(shape.shape) - len(spec))
         for i, (ax, dim) in enumerate(zip(parts, shape.shape)):
-            if ax is None and dim % cfg.dp == 0:
+            if ax is None and dim % inner_dp == 0:
                 parts[i] = DATA
                 return P(*parts)
         return spec  # no divisible dim: leaf stays dp-replicated
@@ -362,20 +364,37 @@ def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
     dcn) reduction.  Leaves are grouped by their sharded axes:
     ``two_level_psum`` flattens a group into ONE vector, so mixing
     (say) tp-sharded leaves — whose values legitimately vary over
-    'model' — with replicated ones would poison the latter's vma."""
+    'model' — with replicated ones would poison the latter's vma.
+
+    FSDP leaves ('data' in the spec) skip the two-level reduction
+    entirely: the ``_fsdp_gather`` transpose already reduce-scattered
+    their cotangent over 'data', so what arrives here IS the
+    slice-local ZeRO-3 shard — the cross-slice exchange is one
+    shard-sized ``psum('dcn')``, the same DCN payload as the
+    replicated-state path."""
     from .parallel.strategies import two_level_psum
 
     g_leaves, td = jax.tree.flatten(g)
     s_leaves = jax.tree.leaves(specs)
     groups: dict = {}
+    fsdp_items: list = []
     for i, (gl, sp) in enumerate(zip(g_leaves, s_leaves)):
         axes = _spec_axes(sp)
         rest = tuple(a for a in (EXPERT, SEQ, MODEL)
                      if a not in axes)
         if rest:
             gl = jax.lax.psum(gl, rest)
-        groups.setdefault(frozenset(axes), []).append((i, gl))
+        if DATA in axes:
+            fsdp_items.append((i, gl))
+        else:
+            groups.setdefault(frozenset(axes), []).append((i, gl))
     out: list = [None] * len(g_leaves)
+    if fsdp_items:
+        # one psum primitive, per-leaf payloads (no concat: leaves keep
+        # their own vma; each is already data-shard-sized)
+        synced = jax.lax.psum([gl for _, gl in fsdp_items], DCN)
+        for (i, _), s in zip(fsdp_items, synced):
+            out[i] = s
     for items in groups.values():
         idxs = [i for i, _ in items]
         synced = two_level_psum([gl for _, gl in items], DCN, DATA)
@@ -445,12 +464,14 @@ def _make_grad_step(cfg: LMTrainConfig, mesh: Mesh):
 def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
     """Gradient accumulation with ONE cross-device exchange per
     optimizer step, for the factored multislice mesh: the A microbatch
-    backwards run entirely LOCAL inside one shard_map (the loss's
-    scalar psums are the only per-microbatch collectives), local grads
-    accumulate through a lax.scan, and the accumulated tree syncs once
-    — per-leaf intra psums + the grouped two-level (data, dcn)
-    reduction.  The naive alternative (scanning the synced grad_step)
-    pays A sequential shard-sized DCN round-trips per step.
+    backwards run with NO cross-slice traffic inside one shard_map (the
+    per-microbatch collectives are intra-slice only: the loss's scalar
+    psums, plus the ZeRO-3 weight gathers / gradient reduce-scatters
+    when fsdp is on), local grads accumulate through a lax.scan, and
+    the accumulated tree syncs once — per-leaf intra psums + the
+    grouped two-level (data, dcn) reduction (shard-sized psum('dcn')
+    for fsdp leaves).  The naive alternative (scanning the synced
+    grad_step) pays A sequential shard-sized DCN round-trips per step.
 
     ``(params, micro_tokens (A, B, S), micro_targets, n_total, aux_w)
     -> (summed loss, synced grads)``; numerics match the scanned path

@@ -976,6 +976,8 @@ class ContinuousBatcher:
         When the page pool cannot hold the prompt, the request WAITS in
         the queue (live work and swapped-out victims free pages as they
         finish) instead of raising."""
+        if self._hold_for_resume():
+            return []
         out = []
         for slot in range(self.slots):
             if self.occupant[slot] is not None or not self.queue:
@@ -1007,6 +1009,12 @@ class ContinuousBatcher:
         their first token."""
         c = self.prefill_chunk
         for slot in range(self.slots):
+            if self._hold_for_resume():
+                # don't reserve free slots for younger arrivals while a
+                # preempted request waits: _resume_swapped skips slots in
+                # self.admitting, so a reservation here would sit idle
+                # behind its own held install (priority inversion)
+                break
             if (self.occupant[slot] is None and slot not in self.admitting
                     and self.queue):
                 req = self.queue.popleft()
@@ -1039,10 +1047,13 @@ class ContinuousBatcher:
                     adm.last_logits = last_logits
             if adm.last_logits is not None:
                 # prefill complete: install — or, when the page pool
-                # cannot hold the prompt yet, HOLD the finished slabs
-                # and retry next step (pages free as work retires)
+                # cannot hold the prompt yet (or a preempted request is
+                # waiting on freed pages), HOLD the finished slabs and
+                # retry next step (pages free as work retires)
                 if self.paged:
-                    if len(self.free_pages) < self._pages_short(L - 1):
+                    if (self._hold_for_resume()
+                            or len(self.free_pages)
+                            < self._pages_short(L - 1)):
                         continue
                     self._alloc_pages(slot, L - 1)
                     self._insert_paged(adm.cache, slot)
@@ -1074,14 +1085,31 @@ class ContinuousBatcher:
         else:
             self.last_tok[slot] = tok
 
+    def _hold_for_resume(self) -> bool:
+        """True while a preempted request waits on the resume queue: all
+        PAGE-CONSUMING admissions hold (as ``_stage_refills`` always has)
+        so freed pages accumulate for the oldest victim's swap-in instead
+        of being grabbed by younger arrivals — ``_resume_swapped`` runs
+        first each step, so this is bounded wait, and progress is
+        guaranteed because live occupants retire on finite budgets and
+        one sequence always fits the emptied pool."""
+        return self.paged and bool(self.swapped)
+
     def _stage_refills(self) -> None:
-        """Pop queued requests behind occupants that can plausibly retire
-        this block (budget reachable, or an eos armed), so the device can
-        hand their slot over in place.  Every prompt fits the in-block
-        buffer (``submit`` rejects prompts over the largest bucket ==
-        ``refill_width``).  Unused staged requests are returned to the
-        queue front after the block."""
-        if self.paged and self.swapped:
+        """Pop queued requests behind occupants that can retire by BUDGET
+        this block, so the device can hand their slot over in place.
+        Every prompt fits the in-block buffer (``submit`` rejects prompts
+        over the largest bucket == ``refill_width``).  Unused staged
+        requests are returned to the queue front after the block.
+
+        Occupants whose only retirement path this block is an armed eos
+        (``pr + rem > k``) are NOT staged behind: whether the eos fires
+        is unknowable here, and staging every block against the one
+        block it eventually fires in is pure churn (pop + page reserve +
+        requeue per block for the request's whole lifetime) to save at
+        most one block's tail of slot-steps once — the slot refills via
+        in-block admission at the next sync instead."""
+        if self._hold_for_resume():
             # preempted requests are OLDEST and need a pages-restore
             # dispatch before decoding, which the in-block handoff
             # cannot do — let retiring slots go empty so the resume
@@ -1098,10 +1126,10 @@ class ContinuousBatcher:
                 continue
             pr = max(len(occ.prompt) - int(self.slot_poff[slot]), 0)
             rem = occ.max_new - len(occ.emitted)
-            if pr >= k or (pr + rem > k and occ.eos_id is None):
-                # cannot retire this block (prompt alone spans it, or
-                # budget unreachable with no eos armed): don't hold a
-                # request (or pages) hostage behind it
+            if pr + rem > k:
+                # cannot retire by budget this block (prompt alone spans
+                # it, or budget unreachable): don't hold a request (or
+                # pages) hostage behind it on the off-chance of an eos
                 continue
             if self.paged and not self._alloc_refill_pages(slot):
                 break
@@ -1152,7 +1180,7 @@ class ContinuousBatcher:
             self._resume_swapped()  # preempted requests take priority
         live_any = any(o is not None for o in self.occupant)
         use_inblock = self.inblock_refill and live_any
-        if use_inblock:
+        if use_inblock and not self._hold_for_resume():
             # in-block admission: empty slots take narrow queued requests
             # and prefill them inside the running block
             for slot in range(self.slots):

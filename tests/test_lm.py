@@ -644,8 +644,68 @@ def test_dcn_validation():
         LMTrainer(LMTrainConfig(model=model, dp=4, dcn_size=3))
     with pytest.raises(ValueError, match="does not compose with pp"):
         LMTrainer(LMTrainConfig(model=model, dp=2, pp=2, dcn_size=2))
-    with pytest.raises(ValueError, match="fsdp"):
-        LMTrainer(LMTrainConfig(model=model, dp=4, dcn_size=2, fsdp=True))
+
+
+def test_dcn_fsdp_composes_and_keeps_shard_payload():
+    """FSDP x multislice (round-4 missing #4): ZeRO-3 partitions over the
+    SLICE-LOCAL 'data' axis while 'dcn' carries one shard-sized gradient
+    psum per step — the trajectory matches flat dp, params are genuinely
+    data-sharded, and the jaxpr pins the DCN payload at FSDP-shard size
+    (the fsdp analog of test_dcn_payload_is_shard_sized_lm)."""
+    import re
+
+    from distributed_pytorch_tpu.lm import (
+        _make_grad_step, _spec_axes, make_lm_mesh, param_specs)
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                  n_heads=2, head_dim=32, d_ff=128)
+    tokens, targets = _data(b=4, s=64, vocab=256)
+    runs = {}
+    for name, kw in {"flat": dict(dp=4),
+                     "dcn_fsdp": dict(dp=4, dcn_size=2, fsdp=True)}.items():
+        tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None, **kw))
+        runs[name] = [float(tr.train_step(tokens, targets))
+                      for _ in range(3)]
+    np.testing.assert_allclose(runs["dcn_fsdp"], runs["flat"], rtol=2e-5)
+
+    cfg = LMTrainConfig(model=model, compute_dtype=None, dp=4,
+                        dcn_size=2, fsdp=True)
+    mesh = make_lm_mesh(cfg)
+    tr = LMTrainer(cfg, mesh=mesh)
+    ici = cfg.dp // cfg.dcn_size
+    # params genuinely shard over the slice-local 'data' axis
+    emb_spec = tr.params["embed"].sharding.spec
+    assert "data" in _spec_axes(emb_spec), emb_spec
+    # expected dcn payloads: the ZeRO shard itself for fsdp leaves
+    # (per-leaf psum — the gather transpose already reduce-scattered),
+    # ceil(group/ici) for the two-level groups of unsharded leaves
+    want, groups, n_params = [], {}, 0
+    for leaf, spec in zip(jax.tree.leaves(tr.params),
+                          jax.tree.leaves(param_specs(cfg))):
+        axes = _spec_axes(spec)
+        n_params += leaf.size
+        if "data" in axes:
+            want.append(leaf.size // ici)
+        else:
+            key = frozenset(axes)
+            groups[key] = groups.get(key, 0) + leaf.size
+    want = sorted(want + [-(-g // ici) for g in groups.values()])
+    assert want, "model has no fsdp-shardable leaf"
+
+    grad_step = _make_grad_step(cfg, mesh)
+    jaxpr = str(jax.make_jaxpr(grad_step)(
+        tr.params, jnp.asarray(tokens), jnp.asarray(targets),
+        jnp.float32(1.0), jnp.float32(0.0)))
+    sized = []
+    for ln in jaxpr.splitlines():
+        if "psum" in ln and "'dcn'" in ln:
+            for dims in re.findall(r"\w+\[([\d,]+)\]", ln):
+                size = int(np.prod([int(d) for d in dims.split(",")]))
+                if size > 1:
+                    sized.append(size)
+    assert sorted(sized) == want, (sorted(sized), want)
+    assert sum(sized) < n_params, (sum(sized), n_params)
 
 
 def test_train_steps_scan_matches_per_step_calls():

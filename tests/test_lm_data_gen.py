@@ -509,6 +509,131 @@ def test_lookup_speculation_matches_target_greedy():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+def test_rejection_sampling_marginal_is_exact():
+    """The rejection-sampling core (_spec_reject_tokens): for arbitrary
+    p != q, the accept-or-resample output at the first position is
+    distributed EXACTLY as p — the identity sampled speculative decoding
+    rests on — verified by Monte-Carlo against the analytic marginal."""
+    rng = np.random.default_rng(0)
+    v, k, n = 8, 3, 40_000
+    p_row = rng.dirichlet(np.ones(v) * 0.7, size=k + 1).astype(np.float32)
+    q_row = rng.dirichlet(np.ones(v) * 0.7, size=k).astype(np.float32)
+    p = jnp.broadcast_to(jnp.asarray(p_row), (n, k + 1, v))
+    q = jnp.broadcast_to(jnp.asarray(q_row), (n, k, v))
+    kd, kr = jax.random.split(jax.random.key(42))
+    drafts = jax.random.categorical(
+        kd, jnp.log(q), axis=-1).astype(jnp.int32)  # (n, k) ~ q rows
+    match, g = gen._spec_reject_tokens(kr, drafts, q, p)
+    first = np.where(np.asarray(match[:, 0]), np.asarray(drafts[:, 0]),
+                     np.asarray(g[:, 0]))
+    emp = np.bincount(first, minlength=v) / n
+    tv = 0.5 * np.abs(emp - p_row[0]).sum()
+    assert tv < 0.02, (tv, emp, p_row[0])
+    # and the naive no-resample baseline (always emit the draft) is NOT
+    # p-distributed for these p/q — the test has power
+    emp_q = np.bincount(np.asarray(drafts[:, 0]), minlength=v) / n
+    assert 0.5 * np.abs(emp_q - p_row[0]).sum() > 0.1
+
+
+def _marginal_pos1(params, cfg, prompt, temperature, top_k, top_p):
+    """Analytic marginal of generated position 1 under the warped target
+    distribution: sum_t0 p0(t0) * p1(t1 | prompt + t0)."""
+    v = cfg.vocab_size
+    cache = gen.init_cache(cfg, 1, prompt.shape[1] + 1)
+    logits, _ = gen._forward_cached(
+        params, cache, prompt, jnp.arange(prompt.shape[1]), 0, cfg=cfg,
+        unembed_last_only=True, k_len=prompt.shape[1])
+    p0 = jax.nn.softmax(
+        gen._filter_logits(logits[:, 0], temperature, top_k, top_p), -1)[0]
+    exts = jnp.concatenate(
+        [jnp.broadcast_to(prompt, (v, prompt.shape[1])),
+         jnp.arange(v, dtype=jnp.int32)[:, None]], axis=1)
+    cache = gen.init_cache(cfg, v, exts.shape[1])
+    logits, _ = gen._forward_cached(
+        params, cache, exts, jnp.arange(exts.shape[1]), 0, cfg=cfg,
+        unembed_last_only=True, k_len=exts.shape[1])
+    p1 = jax.nn.softmax(
+        gen._filter_logits(logits[:, 0], temperature, top_k, top_p), -1)
+    return np.asarray(p0 @ p1)  # (v,)
+
+
+@pytest.mark.parametrize("temperature,top_k,top_p",
+                         [(0.9, None, None), (1.0, 5, None),
+                          (0.8, None, 0.9)])
+def test_sampled_speculation_distribution_matches_target(
+        temperature, top_k, top_p):
+    """Sampled speculative decoding (draft-model AND prompt-lookup):
+    the empirical distribution of the first rejection-path token
+    (generated position 1 — position 0 is a direct sample) matches the
+    ANALYTIC warped-target marginal in total variation, at the same
+    tolerance the plain sampled decode achieves — the 'exact
+    target-distribution sampling' guarantee, measured."""
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=32, n_layers=1,
+                                n_heads=2, head_dim=16, d_ff=64)
+    draft_cfg = tfm.TransformerConfig(vocab_size=32, d_model=16,
+                                      n_layers=1, n_heads=1, head_dim=16,
+                                      d_ff=32)
+    params = tfm.init(jax.random.key(0), cfg)
+    draft = tfm.init(jax.random.key(1), draft_cfg)
+    prompt1 = jnp.asarray([[3, 17, 5, 9]], jnp.int32)
+    want = _marginal_pos1(params, cfg, prompt1, temperature, top_k, top_p)
+
+    b, reps, s0 = 256, 4, prompt1.shape[1]
+    prompt = jnp.broadcast_to(prompt1, (b, s0))
+    kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
+
+    def tv_of(sample_fn):
+        toks = np.concatenate([
+            np.asarray(sample_fn(jax.random.key(100 + r)))[:, s0 + 1]
+            for r in range(reps)])
+        emp = np.bincount(toks, minlength=cfg.vocab_size) / len(toks)
+        return 0.5 * np.abs(emp - want).sum()
+
+    # calibration: plain sampled decode against the analytic marginal
+    # (also validates the marginal computation itself); N = 1024, V = 32
+    # puts the TV sampling noise around 0.05
+    tv_plain = tv_of(lambda k: gen.generate(
+        params, prompt, k, cfg=cfg, max_new=3, **kw))
+    tv_spec = tv_of(lambda k: gen.generate_speculative(
+        params, draft, prompt, k, cfg=cfg, draft_cfg=draft_cfg,
+        max_new=3, n_spec=3, **kw)[0])
+    tv_lookup = tv_of(lambda k: gen.generate_lookup(
+        params, prompt, k, cfg=cfg, max_new=3, n_spec=3, ngram=2, **kw)[0])
+    assert tv_plain < 0.12, tv_plain
+    assert tv_spec < 0.12, (tv_spec, tv_plain)
+    assert tv_lookup < 0.12, (tv_lookup, tv_plain)
+
+
+def test_filter_logits_topk_out_of_range_is_noop():
+    """top_k >= vocab (a common default against a small vocab) and
+    top_k=0 keep ALL tokens — regression: the sliced kth lookup must not
+    produce an empty slice/broadcast error."""
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(3, 8)),
+                         jnp.float32)
+    want = logits / 0.7
+    for k in (50, 8, 0):
+        got = gen._filter_logits(logits, 0.7, k, None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+    # and sampling through it works
+    toks = gen._sample(jax.random.key(0), logits, 1.0, 50)
+    assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 8)).all()
+
+
+def test_sampled_speculation_requires_key():
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=32, n_layers=1,
+                                n_heads=2, head_dim=16, d_ff=64)
+    params = tfm.init(jax.random.key(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="needs a PRNG key"):
+        gen.generate_lookup(params, prompt, cfg=cfg, max_new=4,
+                            temperature=0.5)
+    with pytest.raises(ValueError, match="needs a PRNG key"):
+        gen.generate_speculative(params, params, prompt, cfg=cfg,
+                                 draft_cfg=cfg, max_new=4,
+                                 temperature=0.5)
+
+
 def test_lookup_speculation_eos_matches_generate():
     """generate_lookup with eos_id reproduces generate()'s fixed-shape
     output exactly, including the eos-repeat tail convention."""
