@@ -180,18 +180,17 @@ def lm_train_flops_per_token(cfg, n_params: int, seq: int) -> float:
     return 6.0 * n_params + 6.0 * seq * cfg.n_layers * cfg.n_heads * cfg.head_dim
 
 
-def bench_lm(iters: int = 40, batch: int = 8,
-             seq: int = 2048) -> tuple[float, float | None]:
-    """(tokens/sec/chip, MFU lower bound) of the LM train step — the
-    transformer half of the framework, regression-gated since round 4
-    (VERDICT round-3 #3).  Per-step dispatch (the measured-faster shape
-    at ~30 ms steps: async dispatch already hides the host), one value
-    fetch at the end, min-of-2 windows."""
+def _bench_lm_at(model_cfg, label: str, iters: int, batch: int,
+                 seq: int) -> tuple[float, float | None]:
+    """Shared LM train-step measurement (ONE methodology for every LM
+    gate): per-step dispatch (the measured-faster shape at ~30 ms steps:
+    async dispatch already hides the host), one value fetch at the end,
+    min-of-2 windows."""
     import jax
 
     from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
 
-    cfg = LMTrainConfig(model=_lm_cfg())
+    cfg = LMTrainConfig(model=model_cfg)
     tr = LMTrainer(cfg)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 256, (batch, seq)).astype(np.int32)
@@ -210,9 +209,36 @@ def bench_lm(iters: int = 40, batch: int = 8,
     peak = _peak_flops(jax.devices()[0])
     mfu = (tps * lm_train_flops_per_token(cfg.model, n_params, seq) / peak
            if peak else None)
-    _log(f"[bench] lm: {best / iters * 1e3:.2f} ms/step -> {tps:,.0f} "
-         f"tok/s/chip" + (f", MFU>={mfu:.1%}" if mfu else ""))
+    _log(f"[bench] {label} ({n_params / 1e6:.0f}M): "
+         f"{best / iters * 1e3:.2f} ms/step -> {tps:,.0f} tok/s/chip"
+         + (f", MFU>={mfu:.1%}" if mfu else ""))
     return tps, mfu
+
+
+def bench_lm(iters: int = 40, batch: int = 8,
+             seq: int = 2048) -> tuple[float, float | None]:
+    """(tokens/sec/chip, MFU lower bound) of the LM train step — the
+    transformer half of the framework, regression-gated since round 4
+    (VERDICT round-3 #3)."""
+    return _bench_lm_at(_lm_cfg(), "lm", iters, batch, seq)
+
+
+def _lm_large_cfg():
+    """The ~535M config (d2048/8L) the round-4 speculation study used —
+    the weight-bandwidth-bound regime where MXU utilization is the
+    honest question (the d512/4L gate is partly overhead-bound)."""
+    from distributed_pytorch_tpu.models import transformer as tfm
+    return tfm.TransformerConfig(vocab_size=256, d_model=2048, n_layers=8,
+                                 n_heads=16, head_dim=128)
+
+
+def bench_lm_large(iters: int = 12, batch: int = 4,
+                   seq: int = 2048) -> tuple[float, float | None]:
+    """(tokens/sec/chip, MFU lower bound) of the LM train step at the
+    535M d2048/8L config (round-4 VERDICT #6: gate MFU where the model
+    is large enough for the question to be about the MXU, not per-op
+    overhead).  Same methodology as bench_lm (shared _bench_lm_at)."""
+    return _bench_lm_at(_lm_large_cfg(), "lm-large", iters, batch, seq)
 
 
 def bench_decode(max_new: int = 1024) -> float:
@@ -364,11 +390,16 @@ def main() -> None:
     # invisible to the driver.  Each is optional (the VGG headline must
     # survive any of them failing) and skippable for quick runs.
     lm_tps = lm_mfu = decode_ms = serve_tps = serve_util = None
+    lml_tps = lml_mfu = None
     if not os.environ.get("BENCH_SKIP_LM"):
         try:
             lm_tps, lm_mfu = bench_lm()
         except Exception as e:
             _log(f"[bench] lm bench failed ({e}); omitting")
+        try:
+            lml_tps, lml_mfu = bench_lm_large()
+        except Exception as e:
+            _log(f"[bench] lm-large bench failed ({e}); omitting")
         try:
             decode_ms = bench_decode()
         except Exception as e:
@@ -404,6 +435,11 @@ def main() -> None:
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)
                                        if lm_tps is not None else None),
         "lm_mfu": round(lm_mfu, 4) if lm_mfu is not None else None,
+        "lm_large_tokens_per_sec_per_chip": (round(lml_tps, 1)
+                                             if lml_tps is not None
+                                             else None),
+        "lm_large_mfu": (round(lml_mfu, 4)
+                         if lml_mfu is not None else None),
         "decode_ms_per_token": (round(decode_ms, 4)
                                 if decode_ms is not None else None),
         "serving_tokens_per_sec": (round(serve_tps, 1)

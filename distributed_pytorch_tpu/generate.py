@@ -145,8 +145,16 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
 
     RAGGED batches (continuous batching): ``pos`` may be (B, S) — each
     sequence at its own depth — with ``write_at`` a (B,) vector of
-    per-sequence cache offsets; S = 1 in practice.  Attention bounds,
-    rotary phases, and cache writes are then all per-sequence.
+    per-sequence cache offsets; S = 1 for plain lockstep decode.
+    Attention bounds, rotary phases, and cache writes are then all
+    per-sequence.  With S > 1 ragged (in-batcher speculative
+    VERIFICATION, serve.py), ``write_at`` is instead a (B, S) matrix of
+    per-TOKEN write positions (the caller clamps them at each
+    sequence's allocated frontier — a clamped token overwrites the
+    frontier row, which only happens for retired slots whose cache is
+    dead), written as one scatter; the attention read is the bias path
+    (per-row ``slot <= pos[b, j]`` bounds), with a paged pool first
+    gathered into its per-sequence contiguous view.
 
     Causality comes from the cache-validity bias: query row j attends cache
     slots <= pos[j] (earlier positions plus itself), never the zero-filled
@@ -167,17 +175,37 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     k_len = k_len or next(iter(cache.values()))["k"].shape[2]
     s = tokens.shape[1]
     ragged = pos.ndim == 2  # (B, S) per-sequence positions
+    multi_ragged = ragged and s > 1  # speculative verify window
     kernel_path = use_decode_kernel and s == 1
     if page_table is not None:
         # PAGED KV pool (serve.py paged mode): cache leaves are shared
         # (P, hkv, page, D) pools; ``page_table`` (B, n_pages) maps each
-        # sequence's logical cache blocks to pool pages.  Decode-only,
-        # kernel-only (the page indirection lives in the Pallas index
-        # maps — measured free on TPU).
-        if not (kernel_path and ragged):
-            raise ValueError("page_table requires the single-token ragged "
-                             "decode kernel path (use_decode_kernel=True, "
-                             "per-sequence positions)")
+        # sequence's logical cache blocks to pool pages.  Single-token
+        # decode rides the kernel (the page indirection lives in its
+        # Pallas index maps — measured free on TPU); the multi-token
+        # ragged verify window scatters writes through the table and
+        # gathers the pool into a contiguous per-sequence view for the
+        # bias-path attention read.
+        if not ((kernel_path or multi_ragged) and ragged):
+            raise ValueError("page_table requires ragged per-sequence "
+                             "positions, and single-token decode must use "
+                             "the kernel path (use_decode_kernel=True)")
+        if multi_ragged and write_at.ndim != 2:
+            raise ValueError("a paged multi-token ragged forward needs "
+                             "(B, S) per-token write positions (the "
+                             "scatter rides the page table)")
+    # multi-token ragged writes: (B, S) write_at scatters each token at
+    # its own (caller-clamped) position — the serve.py verify window;
+    # (B,) write_at keeps the contiguous vmapped-DUS path the static
+    # speculative decoders use (their windows always start at the
+    # per-sequence frontier).
+    scatter_writes = multi_ragged and write_at.ndim == 2
+    if page_table is not None and multi_ragged:
+        # the gathered contiguous view spans the table's whole logical
+        # range; the per-row pos bias masks everything beyond each
+        # sequence's own depth
+        k_len = page_table.shape[1] * next(
+            iter(cache.values()))["k"].shape[2]
     if not kernel_path:
         # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
         slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
@@ -196,7 +224,25 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
         q = tfm.rotary(q, pos, cfg.rope_theta)
         k = tfm.rotary(k, pos, cfg.rope_theta)
-        if page_table is not None:
+        if scatter_writes:
+            # speculative verify window: one scatter writes each token
+            # at its own (caller-clamped) position — through the page
+            # table under paging, straight into the (B, hkv, L, D)
+            # buffers otherwise.  Colliding clamped rows (retired
+            # slots) resolve arbitrarily; those rows are never read.
+            kv_t = k.transpose(0, 2, 1, 3).astype(c["k"].dtype)
+            vv_t = v.transpose(0, 2, 1, 3).astype(c["v"].dtype)
+            if page_table is not None:
+                page = c["k"].shape[2]
+                pids = jnp.take_along_axis(page_table, write_at // page, 1)
+                offs = write_at % page
+                ck = c["k"].at[pids, :, offs].set(kv_t)
+                cv = c["v"].at[pids, :, offs].set(vv_t)
+            else:
+                bidx = jnp.arange(tokens.shape[0])[:, None]
+                ck = c["k"].at[bidx, :, write_at].set(kv_t)
+                cv = c["v"].at[bidx, :, write_at].set(vv_t)
+        elif page_table is not None:
             # paged write: token at position p lands in pool page
             # table[b, p // page] at row p % page
             page = c["k"].shape[2]
@@ -220,7 +266,22 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
             cv = lax.dynamic_update_slice(
                 c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
         cache[f"layer{i}"] = {"k": ck, "v": cv}
-        if page_table is not None:
+        if multi_ragged and page_table is not None:
+            # contiguous per-sequence view of the owned pages (reads the
+            # pool once; the verify is a fallback XLA path, not the hot
+            # single-token kernel)
+            bsz, hkv_l, page, hd = (tokens.shape[0], ck.shape[1],
+                                    ck.shape[2], ck.shape[3])
+            ka = (ck[page_table].transpose(0, 2, 1, 3, 4)
+                  .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
+            va = (cv[page_table].transpose(0, 2, 1, 3, 4)
+                  .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
+            if q.shape[1] != hkv_l:
+                rep = q.shape[1] // hkv_l
+                ka = jnp.repeat(ka, rep, axis=1)
+                va = jnp.repeat(va, rep, axis=1)
+            o = attention_reference(q, ka, va, bias=bias)
+        elif page_table is not None:
             o = decode_attention_paged(q, ck, cv, page_table, pos[:, 0])
         elif kernel_path:
             # Pallas decode kernel: exact pos+1 cache-read bound (dead
@@ -304,6 +365,65 @@ def decode_step_ragged(params: PyTree, cache: PyTree, token: jax.Array,
         cfg=cfg, dtype=dtype, tp_axis=tp_axis,
         use_decode_kernel=use_decode_kernel, page_table=page_table)
     return logits[:, 0], cache
+
+
+def verify_step_ragged(params: PyTree, cache: PyTree, tokens: jax.Array,
+                       pos: jax.Array, write_pos: jax.Array, *,
+                       cfg: tfm.TransformerConfig, dtype=None,
+                       tp_axis: str | None = None,
+                       page_table: jax.Array | None = None):
+    """MULTI-token ragged forward: (B, W) tokens at per-sequence
+    positions ``pos`` (B, W) -> ((B, W, vocab) logits, cache) — the
+    verification primitive of in-batcher speculative decoding
+    (serve.py): each slot's whole proposal window streams through one
+    weight read (the speculation win: W tokens of MXU work per HBM
+    weight pass instead of W bandwidth-bound single-token steps).
+
+    ``write_pos`` (B, W) gives each token's cache write position,
+    already clamped at the sequence's allocated frontier by the caller
+    (rejected tokens' K/V rows are garbage beyond the accepted prefix —
+    never read, since reads are pos-bounded and later rounds overwrite
+    them: the same free-rewind property ``generate_speculative``
+    documents).  Attention runs the bias path with exact per-row
+    ``slot <= pos`` bounds; a paged pool is gathered into its
+    contiguous per-sequence view for the read."""
+    return _forward_cached(
+        params, cache, tokens, pos, write_pos, cfg=cfg, dtype=dtype,
+        tp_axis=tp_axis, page_table=page_table)
+
+
+def lookup_proposals(stream: jax.Array, last_i: jax.Array, n_spec: int,
+                     ngram: int) -> jax.Array:
+    """PROMPT-LOOKUP proposals, shared by ``generate_lookup`` and the
+    in-batcher speculative block (serve.py): for each row of ``stream``
+    (B, T), find the most recent earlier occurrence of the trailing
+    ``ngram`` ending at index ``last_i`` (B,) and copy the ``n_spec``
+    tokens that followed it; rows with no match (or a prefix shorter
+    than the ngram — the reads above index 0 would otherwise silently
+    compare a clipped wrong window) fall back to repeating the last
+    token.  Proposals are free to be wrong: verification rejects them
+    at the cost of a round's speculation, never correctness."""
+    b, total = stream.shape
+    nwin = total - ngram + 1
+    jgrid = jnp.arange(nwin)[None]
+    win_ok = jnp.ones((b, nwin), bool)
+    for o in range(ngram):
+        tail = jnp.take_along_axis(
+            stream, jnp.clip(last_i - (ngram - 1) + o,
+                             0, total - 1)[:, None], axis=1)
+        win_ok &= stream[:, o:nwin + o] == tail
+    # exclude the trailing ngram matching itself; window tokens and at
+    # least the first continuation token must be already written
+    win_ok &= jgrid <= (last_i - ngram)[:, None]
+    win_ok &= (ngram <= last_i)[:, None]
+    jbest = jnp.max(jnp.where(win_ok, jgrid, -1), axis=1)
+    base = jnp.where(jbest >= 0, jbest + ngram, 0)
+    idx = jnp.clip(base[:, None] + jnp.arange(n_spec)[None], 0, total - 1)
+    props = jnp.take_along_axis(stream, idx, axis=1)
+    lastv = jnp.take_along_axis(
+        stream, jnp.clip(last_i, 0, total - 1)[:, None], axis=1)
+    return jnp.where((jbest >= 0)[:, None], props,
+                     jnp.broadcast_to(lastv, (b, n_spec)))
 
 
 def _filter_logits(logits, temperature: float, top_k: int | None,
@@ -836,35 +956,6 @@ def generate_lookup(
     done0 = ((t0 == eos_id) if eos_id is not None
              else jnp.zeros((b,), bool))
 
-    def proposals(stream, n):
-        """Continuation of the most recent earlier occurrence of the
-        trailing ngram; repeats of the last token when none exists."""
-        last_i = s0 + n - 1                     # (B,) index of last token
-        jgrid = jnp.arange(total - ngram + 1)[None]     # window starts
-        win_ok = jnp.ones((b, total - ngram + 1), bool)
-        for o in range(ngram):
-            tail = jnp.take_along_axis(
-                stream, (last_i - (ngram - 1) + o)[:, None], axis=1)
-            win_ok &= stream[:, o:total - ngram + 1 + o] == tail
-        # exclude the trailing ngram matching itself; window tokens and
-        # at least the first continuation token must be already written
-        win_ok &= jgrid <= (last_i - ngram)[:, None]
-        # short-prefix rounds (ngram > last_i, e.g. a 1-token prompt on
-        # round 1): the trailing-ngram reads above clip negative indices
-        # to 0 and compare a wrong window, but the jgrid bound's negative
-        # RHS already rejects every candidate then.  This explicit guard
-        # states that invariant rather than leaning on the clip+bound
-        # interplay (round-4 advisor note).
-        win_ok &= (ngram <= last_i)[:, None]
-        jbest = jnp.max(jnp.where(win_ok, jgrid, -1), axis=1)
-        base = jnp.where(jbest >= 0, jbest + ngram, 0)
-        idx = jnp.clip(base[:, None] + jnp.arange(n_spec)[None],
-                       0, total - 1)
-        props = jnp.take_along_axis(stream, idx, axis=1)
-        lastv = jnp.take_along_axis(stream, last_i[:, None], axis=1)
-        return jnp.where((jbest >= 0)[:, None], props,
-                         jnp.broadcast_to(lastv, (b, n_spec)))
-
     def cond(c):
         return jnp.any((c["n"] < max_new) & ~c["done"])
 
@@ -873,7 +964,8 @@ def generate_lookup(
         rkey, vkey = jax.random.split(c["key"])
         last = jnp.take_along_axis(c["stream"],
                                    (s0 + c["n"] - 1)[:, None], axis=1)[:, 0]
-        drafts = proposals(c["stream"], c["n"])
+        drafts = lookup_proposals(c["stream"], s0 + c["n"] - 1, n_spec,
+                                  ngram)
         tokens_in = jnp.concatenate([last[:, None], drafts], axis=1)
         vpos = pos[:, None] + 1 + jnp.arange(k_tok)[None]
         vlogits, cache2 = _forward_cached(

@@ -68,7 +68,25 @@ TPU-first design constraints drive the shape:
   waits on a resume queue, and the pages scatter back when the pool has
   room — instead of raising.  Host-swap rather than re-prefill because
   the generated prefix can exceed every compiled prompt bucket; the
-  request resumes mid-generation with bitwise-identical KV.
+  request resumes mid-generation with bitwise-identical KV;
+- **in-batcher speculation** (round 5, ``speculate`` = n_spec): the
+  decode block becomes a while_loop of speculation ROUNDS — every slot
+  proposes n_spec tokens by prompt-lookup from its own stream and one
+  (slots, n_spec+1)-token ragged verify forward checks them all
+  (``_decode_spec_for``).  Decode at serving batch sizes is
+  weight-read-bound, so emitting the accepted prefix per ONE weight
+  pass is where the round-4 static-path speculation speedup actually
+  pays; greedy slots stay exact-greedy, temperature>0 slots get exact
+  warped-distribution sampling via point-mass rejection;
+- **prefix caching** (round 5, ``prefix_cache=True``, paged): full
+  512-token prompt pages are content-addressed by chain hash and
+  SHARED across requests through the block tables with refcounts — a
+  repeated system prompt admits by reusing the cached pages and
+  prefilling only its suffix (one ``verify_step_ragged`` window
+  attending the shared prefix).  Sharing is read-only by construction
+  (decode writes always land in the slot's own fresh tail pages);
+  unreferenced cached pages are reclaimed LRU under pool pressure
+  before any occupant is preempted.
 """
 
 from __future__ import annotations
@@ -105,6 +123,9 @@ class _Request:
     eos_id: int | None = None
     emitted: list = field(default_factory=list)
     done: bool = False
+    # chain hashes of the prompt's full pages, computed ONCE at submit
+    # when prefix caching is on (lookups run per scheduling decision)
+    prefix_hashes: list | None = None
     # latency bookkeeping (host clock; token times land at block syncs,
     # which is when the serving layer can actually hand tokens out)
     t_submit: float = 0.0
@@ -169,12 +190,35 @@ class ContinuousBatcher:
                  inblock_refill: bool = True,
                  schedule: str = "fifo",
                  compact_tail: bool = True,
+                 speculate: int = 0, spec_ngram: int = 2,
+                 prefix_cache: bool = False,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
         self.slots = slots
         # whole 512-slot blocks keep the decode kernel's tiles MXU-friendly
         self.max_len = gen.pad_cache_len(max_len)
+        # IN-BATCHER SPECULATION (``speculate`` = n_spec > 0): each
+        # round, every slot proposes n_spec tokens by prompt-lookup from
+        # its own stream (trailing ``spec_ngram`` match) and ONE
+        # (slots, n_spec+1)-token ragged verify forward checks them all
+        # — accepted prefixes advance multiple positions per weight
+        # read, greedy slots get exact-greedy outputs and temperature>0
+        # slots exact warped-distribution sampling (point-mass rejection:
+        # accept proposal x with prob p(x), resample from p minus x).
+        # The cache gains one extra 512-block of headroom: the verify
+        # window writes up to n_spec positions past the accepted
+        # frontier, and those garbage rows must never clamp onto live
+        # ones.
+        if speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got {speculate}")
+        self.n_spec = speculate
+        self.spec_ngram = spec_ngram
+        if speculate and spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        self.kv_len = (gen.pad_cache_len(self.max_len + speculate + 1)
+                       if speculate else self.max_len)
+        self._spec_fns: dict[int, object] = {}
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
@@ -229,7 +273,7 @@ class ContinuousBatcher:
         # paged therefore requires the kernel decode path.
         self.paged = paged
         self.page = 512
-        self.pages_per_slot = self.max_len // self.page
+        self.pages_per_slot = self.kv_len // self.page
         if paged:
             if not self.use_kernel and decode_kernel is not None:
                 raise ValueError("paged serving requires the decode-kernel "
@@ -255,9 +299,38 @@ class ContinuousBatcher:
             self.free_pages = deque(range(1, self.pool_pages))
             self.slot_pages: list[list[int]] = [[] for _ in range(slots)]
         else:
-            self.cache = gen.init_cache(cfg, slots, self.max_len,
+            self.cache = gen.init_cache(cfg, slots, self.kv_len,
                                         dtype=dtype or jnp.float32,
                                         kv_heads=self.kv_heads)
+        # PREFIX CACHING (paged only): full 512-token pages of prompt K/V
+        # are content-addressed by a per-page CHAIN hash (page i's key
+        # commits to every token before it, so matching hash == matching
+        # K/V context) and SHARED across requests via the block tables —
+        # a repeated system prompt admits by pointing its table at the
+        # cached pages (refcounted) and prefilling only the suffix.
+        # Sharing is read-only by construction rather than copy-on-write:
+        # decode writes land at positions >= the prompt length, which
+        # always fall in the slot's own fresh tail pages (the partial
+        # tail page is never registered), so no occupant ever writes a
+        # shared page.  Retired requests' registered pages stay in the
+        # registry at refcount 0 (that IS the cache) and are reclaimed
+        # LRU under pool pressure before any occupant is preempted.
+        self.prefix_cache = prefix_cache
+        if prefix_cache:
+            if not paged:
+                raise ValueError("prefix_cache requires paged=True (the "
+                                 "sharing rides the block tables)")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "prefix_cache does not compose with prefill_chunk: "
+                    "chunked admission re-prefills every prompt and "
+                    "would silently never share pages — a shared-prefix "
+                    "admission is already one suffix-sized dispatch, "
+                    "which is the latency problem chunking solves")
+            self.registry: dict[bytes, int] = {}   # chain hash -> page id
+            self.page_hash: dict[int, bytes] = {}  # registered page -> hash
+            self.page_refs: dict[int, int] = {}    # registered page -> refs
+            self._suffix_fns: dict[int, object] = {}
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._cache_spec = jax.tree.map(lambda _: P(None, tp_axis),
@@ -350,7 +423,21 @@ class ContinuousBatcher:
                       "prefill_dispatches": 0, "batch_admissions": 0,
                       "inblock_prefill_steps": 0, "inblock_refills": 0,
                       "evictions": 0, "swap_ins": 0,
-                      "compact_dispatches": 0}
+                      "compact_dispatches": 0,
+                      # speculation accounting (speculate > 0):
+                      # slot_steps then counts dispatched VERIFY
+                      # POSITIONS (rounds x slots x window) — the
+                      # position-efficiency denominator; the speedup
+                      # itself shows up as fewer rounds (weight reads)
+                      # per emitted token = emitted / (spec_rounds x
+                      # slots)
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0,
+                      # prefix caching: admissions that reused cached
+                      # prompt pages, pages reused, and registry pages
+                      # reclaimed under pool pressure
+                      "prefix_hits": 0, "prefix_pages_shared": 0,
+                      "prefix_reclaimed": 0}
 
     # -- submission / results --------------------------------------------
     def submit(self, prompt, max_new: int = 128, *,
@@ -388,6 +475,8 @@ class ContinuousBatcher:
             top_p=1.0 if top_p is None else top_p,  # 0.0 stays: -> greedy
             eos_id=self.eos_id if eos_id is _INHERIT else eos_id)
         req.t_submit = time.perf_counter()
+        if self.prefix_cache:
+            req.prefix_hashes = self._prefix_hashes(req.prompt)
         self.requests[rid] = req
         self.queue.append(req)
         self._queue_dirty = True
@@ -632,6 +721,229 @@ class ContinuousBatcher:
             self._decode_fns[n_slots] = fn
         return self._decode_fns[n_slots]
 
+    def _decode_spec_for(self, n_slots: int):
+        """SPECULATIVE decode block: ``(params, cache, cur, ref, key) ->
+        (packed int32 vector, cache)`` — a device-side ``while_loop`` of
+        up to ``steps_per_sync`` speculation ROUNDS.  Each round, every
+        slot:
+
+        1. proposes ``n_spec`` tokens by PROMPT-LOOKUP from its own
+           stream (continuation of the most recent earlier occurrence of
+           the trailing ``spec_ngram``; repeat-last fallback), and
+        2. joins ONE (slots, W = n_spec+1)-token ragged verify forward
+           (gen.verify_step_ragged) — W tokens of MXU work per weight
+           read instead of W bandwidth-bound lockstep steps; then
+        3. accepts the longest correct prefix: greedy slots match the
+           argmax, temperature>0 slots run point-mass rejection (accept
+           proposal x with prob p(x) under the slot's own warped
+           distribution, resample from p-minus-x on reject — emitted
+           tokens are EXACTLY warped-target-distributed), and the
+           frontier token comes free from the last accepted position's
+           logits.
+
+        The per-slot state machine mirrors ``_decode_for``'s, re-based
+        on (``stream``, ``det``, ``wr``): ``stream`` holds the known
+        tokens at their positions, ``det`` counts them, and ``wr`` is
+        the cache frontier — positions in [wr, min(wr+W, det)-1] are
+        known (teacher-forced prefill rides the SAME verify window at W
+        tokens/round, including across the prompt→decode boundary),
+        later window entries are proposals.  Writes clamp at ``cap``
+        (done slots scribble on their frontier row, never on pages/rows
+        they do not own); retirement hands off in place to the staged
+        refill exactly as in the lockstep block."""
+        if self._spec_fns.get(n_slots) is None:
+            cfg, dtype = self.cfg, self.dtype
+            r_max = self.steps_per_sync
+            n_spec, ngram = self.n_spec, self.spec_ngram
+            wk = n_spec + 1
+            width = self.refill_width
+            kv_len = self.kv_len
+            vocab = cfg.vocab_size
+            tp = self.tp_axis if self.mesh is not None else None
+            paged = self.paged
+            rows = np.arange(n_slots)
+
+            def block_body(params, cache, cur, ref, key):
+                ref_stream = jnp.zeros((n_slots, kv_len), jnp.int32)
+                ref_stream = ref_stream.at[:, :width].set(ref["prompt"])
+                c0 = dict(i=jnp.int32(0), cache=cache,
+                          stream=cur["stream"], det=cur["det"],
+                          wr=cur["wr"], rem=cur["rem"],
+                          active=jnp.zeros((n_slots,), jnp.bool_),
+                          done=cur["rem"] <= 0, key=key,
+                          etok=jnp.zeros((r_max, n_slots, wk), jnp.int32),
+                          ecnt=jnp.zeros((r_max, n_slots), jnp.int32),
+                          sw=jnp.full((n_slots,), r_max + 1, jnp.int32),
+                          pf=jnp.zeros((n_slots,), jnp.int32),
+                          prop_n=jnp.int32(0), prop_acc=jnp.int32(0))
+
+                def cond(c):
+                    return (c["i"] < r_max) & ~jnp.all(c["done"])
+
+                def sel(a, b, active):
+                    return jnp.where(active, a, b)
+
+                def body(c):
+                    i, active, live = c["i"], c["active"], ~c["done"]
+                    det, wr, stream = c["det"], c["wr"], c["stream"]
+                    plen_eff = sel(ref["plen"], cur["plen"], active)
+                    temp_eff = sel(ref["temp"], cur["temp"], active)
+                    topk_eff = sel(ref["top_k"], cur["top_k"], active)
+                    topp_eff = sel(ref["top_p"], cur["top_p"], active)
+                    eos_eff = sel(ref["eos"], cur["eos"], active)
+                    cap_eff = sel(ref["cap"], cur["cap"], active)
+                    table_eff = (jnp.where(active[:, None], ref["table"],
+                                           cur["table"])
+                                 if paged else None)
+                    key, ku, krj, kb = jax.random.split(c["key"], 4)
+
+                    # 1. prompt-lookup proposals from each slot's stream
+                    # (the same helper generate_lookup uses)
+                    props = gen.lookup_proposals(stream, det - 1,
+                                                 wk - 1, ngram)
+
+                    # 2. the input window: known stream tokens (prefill /
+                    # the frontier token), proposals beyond
+                    idx = wr[:, None] + jnp.arange(wk)[None]
+                    known = idx < det[:, None]
+                    stream_at = jnp.take_along_axis(
+                        stream, jnp.clip(idx, 0, kv_len - 1), 1)
+                    prop_at = jnp.take_along_axis(
+                        props, jnp.clip(idx - det[:, None], 0, wk - 2), 1)
+                    inp = jnp.where(known, stream_at, prop_at)
+                    wpos = jnp.minimum(idx, cap_eff[:, None])
+                    logits, new_cache = gen.verify_step_ragged(
+                        params, c["cache"], inp, idx, wpos, cfg=cfg,
+                        dtype=dtype, tp_axis=tp, page_table=table_eff)
+
+                    # 3. accept: greedy match or point-mass rejection
+                    g = jnp.argmax(logits, -1).astype(jnp.int32)
+                    masked = gen.filter_per_seq(
+                        logits.reshape(n_slots * wk, vocab),
+                        jnp.repeat(temp_eff, wk),
+                        jnp.repeat(topk_eff, wk),
+                        jnp.repeat(topp_eff, wk)).reshape(
+                            n_slots, wk, vocab)
+                    probs = jax.nn.softmax(masked, -1)
+                    x_next = inp[:, 1:]                       # (n, W-1)
+                    px = jnp.take_along_axis(
+                        probs[:, :-1], x_next[..., None], 2)[..., 0]
+                    u = jax.random.uniform(ku, (n_slots, wk - 1))
+                    greedy_slot = (temp_eff <= 0.0)[:, None]
+                    ok_prop = jnp.where(greedy_slot,
+                                        x_next == g[:, :-1], u < px)
+                    ok = known[:, 1:] | ok_prop
+                    okc = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+                    m = jnp.sum(okc, axis=1)                  # [0, W-1]
+                    # frontier token: argmax (greedy) / residual sample
+                    # at the rejection point / bonus draw on full accept
+                    viota = jax.lax.broadcasted_iota(
+                        jnp.int32, (n_slots, wk - 1, vocab), 2)
+                    repl_logits = jnp.where(
+                        viota == x_next[..., None], gen.NEG_INF,
+                        masked[:, :-1])
+                    repl = jax.random.categorical(
+                        krj, repl_logits.reshape(-1, vocab)).reshape(
+                            n_slots, wk - 1).astype(jnp.int32)
+                    bonus = jax.random.categorical(
+                        kb, masked[:, -1]).astype(jnp.int32)
+                    f_samp = jnp.where(
+                        m == wk - 1, bonus,
+                        jnp.take_along_axis(
+                            repl, jnp.clip(m, 0, wk - 2)[:, None],
+                            1)[:, 0])
+                    f_greedy = jnp.take_along_axis(g, m[:, None], 1)[:, 0]
+                    f = jnp.where(greedy_slot[:, 0], f_greedy, f_samp)
+
+                    # 4. advance: write accepted proposals + frontier
+                    # into the stream, count emissions, cap by eos/budget
+                    wr_new = wr + m + 1
+                    det_new = jnp.maximum(det, wr_new + 1)
+                    jj = jnp.arange(1, wk + 1)[None]
+                    inp_sh = jnp.concatenate([inp[:, 1:], f[:, None]], 1)
+                    val = jnp.where(jj <= m[:, None], inp_sh, f[:, None])
+                    posw = wr[:, None] + jj
+                    write_ok = (live[:, None] & (jj <= (m + 1)[:, None])
+                                & ~((jj == (m + 1)[:, None])
+                                    & (posw < det[:, None]))
+                                & (posw < kv_len))
+                    cols = jnp.where(write_ok, posw, kv_len)
+                    stream_new = stream.at[
+                        rows[:, None], cols].set(
+                            jnp.where(write_ok, val, 0), mode="drop")
+                    e_new = jnp.where(live, det_new - det, 0)
+                    eidx = jnp.clip(det[:, None] + jnp.arange(wk)[None],
+                                    0, kv_len - 1)
+                    echunk = jnp.take_along_axis(stream_new, eidx, 1)
+                    tgrid = jnp.arange(wk)[None]
+                    evalid = tgrid < e_new[:, None]
+                    is_eos = (echunk == eos_eff[:, None]) \
+                        & (eos_eff >= 0)[:, None] & evalid
+                    has_eos = jnp.any(is_eos, axis=1)
+                    first_eos = jnp.argmax(is_eos, axis=1)
+                    n1 = jnp.where(has_eos,
+                                   jnp.minimum(e_new, first_eos + 1),
+                                   e_new)
+                    n_allow = jnp.minimum(n1, c["rem"])
+                    rem_new = c["rem"] - n_allow
+                    fin = live & ((rem_new <= 0)
+                                  | (has_eos & (first_eos < n_allow)))
+
+                    etok = jax.lax.dynamic_update_index_in_dim(
+                        c["etok"], echunk, i, 0)
+                    ecnt = jax.lax.dynamic_update_index_in_dim(
+                        c["ecnt"], n_allow, i, 0)
+                    pf = c["pf"] + jnp.where(
+                        live,
+                        jnp.maximum(0, jnp.minimum(wr_new, plen_eff)
+                                    - jnp.minimum(wr, plen_eff)), 0)
+                    prop_used = live[:, None] & ~known[:, 1:]
+                    jj2 = jnp.arange(1, wk)[None]
+                    prop_n = c["prop_n"] + jnp.sum(prop_used)
+                    prop_acc = c["prop_acc"] + jnp.sum(
+                        prop_used & (jj2 <= m[:, None]))
+
+                    # 5. retire / in-place handoff to the staged refill
+                    switch = fin & ~active & ref["valid"]
+                    done = c["done"] | (fin & ~switch)
+                    stream_out = jnp.where(switch[:, None], ref_stream,
+                                           stream_new)
+                    det_out = jnp.where(switch, ref["plen"],
+                                        jnp.where(live, det + n_allow,
+                                                  det))
+                    wr_out = jnp.where(switch, 0,
+                                       jnp.where(live, wr_new, wr))
+                    rem_out = jnp.where(switch, ref["budget"], rem_new)
+                    return dict(
+                        i=i + 1, cache=new_cache, stream=stream_out,
+                        det=det_out, wr=wr_out, rem=rem_out,
+                        active=active | switch, done=done, key=key,
+                        etok=etok, ecnt=ecnt,
+                        sw=jnp.where(switch, i + 1, c["sw"]), pf=pf,
+                        prop_n=prop_n, prop_acc=prop_acc)
+
+                c = jax.lax.while_loop(cond, body, c0)
+                packed = jnp.concatenate([
+                    c["etok"].reshape(-1), c["ecnt"].reshape(-1),
+                    c["sw"], c["wr"], c["pf"],
+                    c["prop_n"][None], c["prop_acc"][None],
+                    c["i"][None]])
+                return packed, c["cache"]
+
+            if self.mesh is None:
+                fn = jax.jit(block_body, donate_argnums=(1,))
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                fn = jax.jit(shard_map(
+                    block_body, mesh=self.mesh,
+                    in_specs=(self._param_specs, self._cache_spec,
+                              P(), P(), P()),
+                    out_specs=(P(), self._cache_spec)),
+                    donate_argnums=(1,))
+            self._spec_fns[n_slots] = fn
+        return self._spec_fns[n_slots]
+
     def _prefill_chunk_fn(self, bucket: int, first: bool):
         """One prompt chunk written at cache offset ``off``, attending
         causally to everything already prefilled (k_len=bucket; rows read
@@ -684,16 +996,47 @@ class ContinuousBatcher:
         return fn
 
     # -- paged-pool bookkeeping (self.paged) ------------------------------
+    def _avail_pages(self) -> int:
+        """Pages the pool can still supply: the free list plus registry
+        pages no occupant references (reclaimable prefix cache)."""
+        n = len(self.free_pages)
+        if self.prefix_cache:
+            n += sum(1 for pid in self.registry.values()
+                     if self.page_refs.get(pid, 0) == 0)
+        return n
+
+    def _reclaim_registry(self, n: int) -> None:
+        """Free ``n`` unreferenced registry pages, LEAST RECENTLY USED
+        first (insertion order, with ``_admit_shared`` re-inserting on
+        every hit) — cold cached prefixes yield to live work under pool
+        pressure, before any occupant is preempted; hot ones survive."""
+        for h in list(self.registry):
+            if n <= 0:
+                break
+            pid = self.registry[h]
+            if self.page_refs.get(pid, 0) == 0:
+                del self.registry[h]
+                del self.page_hash[pid]
+                del self.page_refs[pid]
+                self.free_pages.append(pid)
+                self.stats["prefix_reclaimed"] += 1
+                n -= 1
+
+    def _take_free_page(self) -> int:
+        if not self.free_pages and self.prefix_cache:
+            self._reclaim_registry(1)
+        if not self.free_pages:
+            raise RuntimeError(
+                f"KV page pool exhausted ({self.pool_pages} pages): "
+                f"raise pool_pages or lower concurrency/max_new")
+        return self.free_pages.popleft()
+
     def _alloc_pages(self, slot: int, upto_pos: int) -> None:
         """Ensure ``slot``'s block table covers positions [0, upto_pos]."""
         need = min(upto_pos // self.page + 1, self.pages_per_slot)
         pages = self.slot_pages[slot]
         while len(pages) < need:
-            if not self.free_pages:
-                raise RuntimeError(
-                    f"KV page pool exhausted ({self.pool_pages} pages): "
-                    f"raise pool_pages or lower concurrency/max_new")
-            pid = self.free_pages.popleft()
+            pid = self._take_free_page()
             self.table[slot, len(pages)] = pid
             pages.append(pid)
 
@@ -701,11 +1044,97 @@ class ContinuousBatcher:
         """Return a retired slot's pages and repoint its table row at the
         scratch page 0 (resetting pos too): the slot keeps lockstep-
         writing in later dispatches until re-admitted, and those writes
-        must never land in pages recycled to OTHER slots."""
-        self.free_pages.extend(self.slot_pages[slot])
+        must never land in pages recycled to OTHER slots.  Registered
+        (prefix-cache) pages stay in the registry at one fewer
+        reference instead of returning to the free list."""
+        for pid in self.slot_pages[slot]:
+            if self.prefix_cache and pid in self.page_hash:
+                self.page_refs[pid] -= 1
+            else:
+                self.free_pages.append(pid)
         self.slot_pages[slot] = []
         self.table[slot, :] = 0
         self.pos[slot] = 0
+
+    # -- prefix cache (self.prefix_cache) ---------------------------------
+    def _prefix_hashes(self, prompt: np.ndarray) -> list[bytes]:
+        """Chain hash per FULL prompt page: page i's key commits to
+        tokens [0, (i+1)*page), so equal keys imply the cached page's
+        K/V was computed under the identical token context."""
+        import hashlib
+        out: list[bytes] = []
+        h = b""
+        for i in range(len(prompt) // self.page):
+            h = hashlib.sha1(
+                h + prompt[i * self.page:(i + 1) * self.page]
+                .astype(np.int32).tobytes()).digest()
+            out.append(h)
+        return out
+
+    def _prefix_lookup(self, req: _Request) -> list[int]:
+        """Longest cached chain of the request's full prompt pages
+        (hashes memoized at submit), capped so at least one suffix token
+        is always left to prefill (its logits seed the first emission;
+        shared pages are never re-written)."""
+        hashes = req.prefix_hashes
+        if len(req.prompt) % self.page == 0:
+            hashes = hashes[:-1]
+        shared: list[int] = []
+        for h in hashes:
+            pid = self.registry.get(h)
+            if pid is None:
+                break
+            shared.append(pid)
+        return shared
+
+    def _register_prompt_pages(self, slot: int, req: _Request) -> None:
+        """Publish a freshly prefilled prompt's full pages.  Only pages
+        wholly covered by prompt tokens register — the partial tail page
+        takes decode writes and must stay private."""
+        for i, h in enumerate(req.prefix_hashes):
+            pid = self.slot_pages[slot][i]
+            if h in self.registry or pid in self.page_hash:
+                continue  # this chain (or page) is already published
+            self.registry[h] = pid
+            self.page_hash[pid] = h
+            self.page_refs[pid] = 1
+
+    def _suffix_prefill(self, sbucket: int):
+        """Compiled suffix prefill for shared-prefix admissions: a
+        (1, sbucket) token window at positions base.. attends the shared
+        pages through the slot's table (gen.verify_step_ragged) and
+        writes its own K/V into the fresh tail pages; returns the
+        (vocab,) logits at the TRUE last prompt position.  Pad tokens
+        past the suffix all clamp onto position ``wcap`` = the prompt
+        length L — decode's own first write position, overwritten before
+        any read, and inside a page the occupant needs for decode anyway
+        (no pages are ever allocated just for pad garbage)."""
+        fn = self._suffix_fns.get(sbucket)
+        if fn is None:
+            cfg, dtype = self.cfg, self.dtype
+            tp = self.tp_axis if self.mesh is not None else None
+
+            def suffix_body(params, cache, chunk, base, uidx, wcap, trow):
+                pos = base + jnp.arange(sbucket)[None]
+                logits, cache = gen.verify_step_ragged(
+                    params, cache, chunk, pos,
+                    jnp.minimum(pos, wcap), cfg=cfg, dtype=dtype,
+                    tp_axis=tp, page_table=trow)
+                return logits[0, uidx], cache
+
+            if self.mesh is None:
+                fn = jax.jit(suffix_body, donate_argnums=(1,))
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                fn = jax.jit(shard_map(
+                    suffix_body, mesh=self.mesh,
+                    in_specs=(self._param_specs, self._cache_spec,
+                              P(), P(), P(), P(), P()),
+                    out_specs=(P(), self._cache_spec)),
+                    donate_argnums=(1,))
+            self._suffix_fns[sbucket] = fn
+        return fn
 
     def _write_caps(self, pages: list[list[int]] | None = None
                     ) -> np.ndarray:
@@ -715,11 +1144,24 @@ class ContinuousBatcher:
         the occupants' page lists; pass ``self.refill_pages`` for the
         staged refills' caps."""
         if not self.paged:
-            return np.full(self.slots, self.max_len - 1, np.int32)
+            return np.full(self.slots, self.kv_len - 1, np.int32)
         return np.asarray(
             [max(len(p) * self.page - 1, 0)
              for p in (self.slot_pages if pages is None else pages)],
             np.int32)
+
+    def _block_writes(self, pr: int, rem: int) -> int:
+        """Worst-case cache writes ONE dispatch can make for a slot with
+        ``pr`` prompt tokens left and ``rem`` emission budget: K lockstep
+        single-token steps, or — under speculation — R rounds advancing
+        up to W = n_spec+1 positions each (bounded by the slot's real
+        progress pr + rem) plus the W-wide not-yet-accepted tail the
+        verify window writes past the frontier."""
+        k = self.steps_per_sync
+        if self.n_spec:
+            w_ = self.n_spec + 1
+            return min(k * w_, pr + rem) + w_
+        return min(k, pr + min(k, rem))
 
     def _pages_short(self, upto_pos: int, owned: int = 0) -> int:
         """How many pages the free list must supply to cover positions
@@ -731,11 +1173,18 @@ class ContinuousBatcher:
         (it activates at step >= 1, so at most steps_per_sync - 1
         positions).  Returns False instead of raising when the pool
         cannot cover it — the request then simply stays queued."""
-        upto = min(max(self.steps_per_sync - 2, 0), self.max_len - 1)
+        k = self.steps_per_sync
+        if self.n_spec:
+            # spec block: a switched-in refill can advance W positions
+            # per round from 0, plus the W-wide garbage tail
+            w_ = self.n_spec + 1
+            upto = min(k * w_ + w_ - 1, self.kv_len - 1)
+        else:
+            upto = min(max(k - 2, 0), self.kv_len - 1)
         need = self._pages_short(upto)
-        if len(self.free_pages) < need:
+        if self._avail_pages() < need:
             return False
-        pages = [self.free_pages.popleft() for _ in range(need)]
+        pages = [self._take_free_page() for _ in range(need)]
         self.refill_pages[slot] = pages
         self.r_table[slot, :] = 0
         self.r_table[slot, :need] = pages
@@ -805,7 +1254,7 @@ class ContinuousBatcher:
         (``pool_pages - 1 >= pages_per_slot``, checked at init)."""
         while True:
             need = self._pages_short(upto, len(self.slot_pages[slot]))
-            if need <= len(self.free_pages):
+            if need <= self._avail_pages():
                 self._alloc_pages(slot, upto)
                 return
             cands = [t for t in range(self.slots)
@@ -828,11 +1277,11 @@ class ContinuousBatcher:
             sw = self.swapped[0]
             pr = max(len(sw.req.prompt) - sw.poff, 0)
             rem = sw.req.max_new - len(sw.req.emitted)
-            writes = min(k, pr + min(k, rem))
+            writes = self._block_writes(pr, rem)
             base = sw.poff if pr else sw.pos + 1
-            upto = min(base + writes - 1, self.max_len - 1)
+            upto = min(base + writes - 1, self.kv_len - 1)
             need = max(self._pages_short(upto), sw.n_pages)
-            if len(self.free_pages) < need:
+            if self._avail_pages() < need:
                 break
             self.swapped.popleft()
             self._alloc_pages(slot, sw.n_pages * self.page - 1)
@@ -944,10 +1393,9 @@ class ContinuousBatcher:
         paging, reserves pages for the first block's writes; returns
         False (request stays queued) when the pool cannot cover them."""
         if self.paged:
-            k = self.steps_per_sync
-            upto = min(k, len(req.prompt) + min(k, req.max_new)) - 1
-            upto = min(upto, self.max_len - 1)
-            if len(self.free_pages) < self._pages_short(upto):
+            upto = self._block_writes(len(req.prompt), req.max_new) - 1
+            upto = min(upto, self.kv_len - 1)
+            if self._avail_pages() < self._pages_short(upto):
                 return False
             self._alloc_pages(slot, upto)
         self.occupant[slot] = req
@@ -982,24 +1430,78 @@ class ContinuousBatcher:
         for slot in range(self.slots):
             if self.occupant[slot] is not None or not self.queue:
                 continue
-            L = len(self.queue[0].prompt)
-            if self.paged and len(self.free_pages) < self._pages_short(L - 1):
-                break  # pool full: hold admissions until pages free
-            req = self.queue.popleft()
-            bucket = next(b for b in self.buckets if b >= L)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :L] = req.prompt
-            last_logits, slabs = self._prefill(bucket)(
-                self.params, jnp.asarray(padded), L)
-            self.stats["prefill_dispatches"] += 1
+            head = self.queue[0]
+            L = len(head.prompt)
+            shared = (self._prefix_lookup(head)
+                      if self.prefix_cache else [])
             if self.paged:
-                self._alloc_pages(slot, L - 1)
-                self._insert_paged(slabs, slot)
+                # shared admissions allocate through position L (the
+                # suffix pad's clamp row, = decode's first write)
+                upto = min(L, self.kv_len - 1) if shared else L - 1
+                # fresh pages needed beyond the shared prefix; idle
+                # shared pages must not double-count as reclaimable
+                # (reclaiming them would destroy the very prefix we
+                # are about to reuse)
+                shared_idle = sum(1 for pid in shared
+                                  if self.page_refs.get(pid, 0) == 0)
+                if (self._avail_pages() - shared_idle
+                        < self._pages_short(upto) - len(shared)):
+                    break  # pool full: hold admissions until pages free
+            req = self.queue.popleft()
+            if shared:
+                last_logits = self._admit_shared(slot, req, shared)
             else:
-                self._insert(slabs, slot)
+                bucket = next(b for b in self.buckets if b >= L)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :L] = req.prompt
+                last_logits, slabs = self._prefill(bucket)(
+                    self.params, jnp.asarray(padded), L)
+                self.stats["prefill_dispatches"] += 1
+                if self.paged:
+                    self._alloc_pages(slot, L - 1)
+                    self._insert_paged(slabs, slot)
+                    if self.prefix_cache:
+                        self._register_prompt_pages(slot, req)
+                else:
+                    self._insert(slabs, slot)
             self._occupy(slot, req, self._sample_first(req, last_logits),
                          out)
         return out
+
+    def _admit_shared(self, slot: int, req: _Request,
+                      shared: list[int]):
+        """Admit over cached prompt pages: the slot's table points at
+        the shared pages (refcounted, LRU-touched), fresh tail pages are
+        allocated, and only the un-cached suffix prefills — ONE dispatch
+        whose window attends the shared prefix through the table
+        (gen.verify_step_ragged) and writes its own K/V into the fresh
+        pages.  Returns the last-prompt-position logits."""
+        self.stats["prefix_hits"] += 1
+        self.stats["prefix_pages_shared"] += len(shared)
+        pages = self.slot_pages[slot]
+        for i, pid in enumerate(shared):
+            self.page_refs[pid] += 1
+            h = self.page_hash[pid]
+            self.registry.pop(h)        # LRU touch: re-insert newest
+            self.registry[h] = pid
+            self.table[slot, i] = pid
+            pages.append(pid)
+        L = len(req.prompt)
+        base = len(shared) * self.page
+        srem = L - base                  # >= 1 (_prefix_lookup cap)
+        sbucket = next(b for b in self.buckets if b >= srem)
+        # allocate through position L (decode's first write — needed
+        # next dispatch regardless); pad writes clamp onto row L
+        self._alloc_pages(slot, min(L, self.kv_len - 1))
+        chunk = np.zeros((1, sbucket), np.int32)
+        chunk[0, :srem] = req.prompt[base:]
+        last_logits, self.cache = self._suffix_prefill(sbucket)(
+            self.params, self.cache, jnp.asarray(chunk),
+            jnp.int32(base), jnp.int32(srem - 1),
+            jnp.int32(min(L, self.kv_len - 1)),
+            jnp.asarray(self.table[slot:slot + 1]))
+        self.stats["prefill_dispatches"] += 1
+        return last_logits
 
     def _advance_admissions(self) -> list[tuple[int, int]]:
         """Chunked admission: reserve free slots for queued requests, then
@@ -1052,7 +1554,7 @@ class ContinuousBatcher:
                 # retry next step (pages free as work retires)
                 if self.paged:
                     if (self._hold_for_resume()
-                            or len(self.free_pages)
+                            or self._avail_pages()
                             < self._pages_short(L - 1)):
                         continue
                     self._alloc_pages(slot, L - 1)
@@ -1119,6 +1621,13 @@ class ContinuousBatcher:
         k = self.steps_per_sync
         for slot in range(self.slots):
             if not self.queue:
+                break
+            if (self.prefix_cache
+                    and self._prefix_lookup(self.queue[0])):
+                # the queue head has a CACHED prefix: handing it off
+                # in-block would teacher-force the whole prompt one
+                # token per step and forfeit the shared pages — let the
+                # batched path admit it over the cache instead
                 break
             occ = self.occupant[slot]
             if (occ is None or slot in self.admitting
@@ -1189,6 +1698,10 @@ class ContinuousBatcher:
                     continue
                 if len(self.queue[0].prompt) > self.inblock_admit_limit:
                     break  # strict FIFO: long head admits batched below
+                if (self.prefix_cache
+                        and self._prefix_lookup(self.queue[0])):
+                    break  # cached prefix: teacher-forcing from 0 would
+                    #        forfeit the shared pages (as _stage_refills)
                 req = self.queue.popleft()
                 if not self._occupy_prefilling(slot, req):
                     self.queue.appendleft(req)  # page pool full: wait
@@ -1234,9 +1747,9 @@ class ContinuousBatcher:
                 if self.occupant[s] is None:
                     continue  # evicted as an earlier slot's victim
                 pr = int(plen[s]) - int(poff[s]) if plen[s] else 0
-                writes = min(k, pr + min(k, int(budget[s])))
+                writes = self._block_writes(pr, int(budget[s]))
                 self._ensure_pages_or_evict(
-                    s, min(int(pos[s]) + writes - 1, self.max_len - 1))
+                    s, min(int(pos[s]) + writes - 1, self.kv_len - 1))
             for s in list(live):
                 if self.occupant[s] is None:  # evicted: out of the block
                     live.remove(s)
@@ -1249,6 +1762,25 @@ class ContinuousBatcher:
         table = (self.table if self.paged
                  else np.zeros((self.slots, 1), np.int32))
         caps = self._write_caps()
+        if self.n_spec:
+            # speculative staging: each live slot's STREAM (its known
+            # tokens at their positions), determined count, and cache
+            # frontier — the (stream, det, wr) machine _decode_spec_for
+            # documents.  wr < det always: the frontier token is known.
+            stream = np.zeros((self.slots, self.kv_len), np.int32)
+            det = np.zeros(self.slots, np.int32)
+            wr = np.zeros(self.slots, np.int32)
+            for s in live:
+                occ = self.occupant[s]
+                lp = len(occ.prompt)
+                stream[s, :lp] = occ.prompt
+                ne = len(occ.emitted)
+                if ne:
+                    stream[s, lp:lp + ne] = np.asarray(occ.emitted,
+                                                       np.int32)
+                det[s] = lp + ne
+                wr[s] = (self.slot_poff[s] if self.slot_poff[s] < lp
+                         else self.pos[s] + 1)
         # Batch COMPACTION for the drained tail (paged): with no queued
         # or staged work left and few slots live, dispatch a NARROWER
         # compiled block over just the live slots' rows — the page
@@ -1288,14 +1820,23 @@ class ContinuousBatcher:
                 pos_c[-npad:] = 0
                 plen_c[-npad:] = 0
                 poff_c[-npad:] = 0
-            cur = dict(tokens=cut_cur(self.last_tok),
-                       pos=pos_c, poff=poff_c,
-                       plen=plen_c, prompt=cut_cur(prompt),
-                       temp=cut_cur(self.slot_temp),
+            # the seven staging fields both block flavors share, then
+            # the mode-specific state (ONE place defines the common set;
+            # the full-width branch below builds the same shape uncut)
+            cur = dict(plen=plen_c, temp=cut_cur(self.slot_temp),
                        top_k=cut_cur(self.slot_topk),
                        top_p=cut_cur(self.slot_topp),
                        eos=cut_cur(self.slot_eos),
                        rem=budget_c, cap=caps_c, table=table_c)
+            if self.n_spec:
+                det_c, wr_c = cut_cur(det), cut_cur(wr)
+                if npad:
+                    det_c[-npad:] = 1  # pad rows: rem 0 -> done at round 0
+                    wr_c[-npad:] = 0
+                cur.update(stream=cut_cur(stream), det=det_c, wr=wr_c)
+            else:
+                cur.update(tokens=cut_cur(self.last_tok), pos=pos_c,
+                           poff=poff_c, prompt=cut_cur(prompt))
             ref = dict(valid=np.zeros(w, bool),
                        plen=np.zeros(w, np.int32),
                        prompt=np.zeros((w, self.refill_width), np.int32),
@@ -1332,14 +1873,18 @@ class ContinuousBatcher:
                 r_cap = self._write_caps(self.refill_pages)
                 r_table = self.r_table
             else:
-                r_cap = np.full(self.slots, self.max_len - 1, np.int32)
+                r_cap = np.full(self.slots, self.kv_len - 1, np.int32)
                 r_table = np.zeros((self.slots, 1), np.int32)
             w = self.slots
-            cur = dict(tokens=self.last_tok, pos=pos, poff=poff,
-                       plen=plen, prompt=prompt, temp=self.slot_temp,
+            cur = dict(plen=plen, temp=self.slot_temp,
                        top_k=self.slot_topk, top_p=self.slot_topp,
                        eos=self.slot_eos, rem=budget, cap=caps,
                        table=table)
+            if self.n_spec:
+                cur.update(stream=stream, det=det, wr=wr)
+            else:
+                cur.update(tokens=self.last_tok, pos=pos, poff=poff,
+                           prompt=prompt)
             ref = dict(valid=r_valid, plen=r_plen, prompt=r_prompt,
                        temp=r_temp, top_k=r_topk, top_p=r_topp,
                        eos=r_eos, budget=r_budget, cap=r_cap,
@@ -1348,6 +1893,10 @@ class ContinuousBatcher:
         cur = {k_: jnp.asarray(v) for k_, v in cur.items()}
         ref = {k_: jnp.asarray(v) for k_, v in ref.items()}
         self.key, sub = jax.random.split(self.key)
+        if self.n_spec:
+            packed, self.cache = self._decode_spec_for(w)(
+                self.params, self.cache, cur, ref, sub)
+            return self._parse_spec_block(packed, live, cols, w, out)
         packed, self.cache = self._decode_for(w)(self.params, self.cache,
                                                  cur, ref, sub)
         flat = np.asarray(packed)  # ONE device->host transfer per block
@@ -1394,6 +1943,70 @@ class ContinuousBatcher:
         self._requeue_unused_refills()
         self.stats["wasted_slot_steps"] += (
             k_exec * w
+            - (self.stats["emitted_tokens"] - emitted_before)
+            - int(np.sum(pf)))
+        return out
+
+    def _sync_spec_slot(self, s: int, wr: int) -> None:
+        """Mirror a continuing slot's device frontier on the host after a
+        speculative block: ``wr`` is the cache frontier, so the last
+        written position is wr-1 and prompt progress is min(wr, plen)."""
+        occ = self.occupant[s]
+        self.slot_poff[s] = min(wr, len(occ.prompt))
+        self.pos[s] = wr - 1
+        if occ.emitted:
+            self.last_tok[s] = occ.emitted[-1]
+
+    def _parse_spec_block(self, packed, live, cols, w: int, out):
+        """Unpack a speculative block's results and mirror them on the
+        host: per-round emission chunks (device-truncated at eos/budget,
+        re-checked by ``_emit``), the retire→refill handoff at round
+        granularity, frontier sync, and the speculation accounting."""
+        r_max, wk = self.steps_per_sync, self.n_spec + 1
+        flat = np.asarray(packed)  # ONE device->host transfer per block
+        n = r_max * w * wk
+        etok = flat[:n].reshape(r_max, w, wk)
+        ecnt = flat[n:n + r_max * w].reshape(r_max, w)
+        off = n + r_max * w
+        sw = flat[off:off + w]
+        wrf = flat[off + w:off + 2 * w]
+        pf = flat[off + 2 * w:off + 3 * w]
+        prop_n, prop_acc = int(flat[-3]), int(flat[-2])
+        n_exec = int(flat[-1])
+        self.stats["decode_dispatches"] += 1
+        self.stats["slot_steps"] += n_exec * w * wk
+        self.stats["spec_rounds"] += n_exec
+        self.stats["spec_proposed"] += prop_n
+        self.stats["spec_accepted"] += prop_acc
+        self.stats["inblock_prefill_steps"] += int(np.sum(pf))
+        emitted_before = self.stats["emitted_tokens"]
+        for s in live:
+            j = cols[s]
+            cut = min(int(sw[j]), n_exec)
+            for r in range(cut):
+                for t in range(int(ecnt[r, j])):
+                    if self.occupant[s] is None:
+                        break
+                    self._emit(s, int(etok[r, j, t]), out)
+            if self.occupant[s] is not None:
+                self._sync_spec_slot(s, int(wrf[j]))
+            elif int(sw[j]) <= n_exec:
+                # the device switched this slot to its staged refill
+                req = self.staged_refill[s]
+                self.staged_refill[s] = None
+                self._staged_order.remove(s)
+                self._install_refill(s, req)
+                self.stats["inblock_refills"] += 1
+                for r in range(int(sw[j]), n_exec):
+                    for t in range(int(ecnt[r, j])):
+                        if self.occupant[s] is None:
+                            break
+                        self._emit(s, int(etok[r, j, t]), out)
+                if self.occupant[s] is not None:
+                    self._sync_spec_slot(s, int(wrf[j]))
+        self._requeue_unused_refills()
+        self.stats["wasted_slot_steps"] += (
+            n_exec * w * wk
             - (self.stats["emitted_tokens"] - emitted_before)
             - int(np.sum(pf)))
         return out

@@ -29,6 +29,7 @@ def warm_clone(cold: ContinuousBatcher, make) -> ContinuousBatcher:
     private compiled-fn attributes (bench.py reuses this)."""
     cb = make()
     for attr in ("_prefill_fns", "_chunk_fns", "_decode_fns",
+                 "_spec_fns", "_suffix_fns",
                  "_insert_fn", "_insert_paged_fn", "_gather_fn",
                  "_scatter_fn"):
         if hasattr(cold, attr):
@@ -70,6 +71,13 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
             "utilization": round(util, 4),
             "decode_dispatches": s["decode_dispatches"],
             "prefill_dispatches": s["prefill_dispatches"],
+            "spec": {k: s[k] for k in ("spec_rounds", "spec_proposed",
+                                       "spec_accepted")} if s.get(
+                "spec_rounds") else None,
+            "prefix": {k: s[k] for k in ("prefix_hits",
+                                         "prefix_pages_shared",
+                                         "prefix_reclaimed")} if s.get(
+                "prefix_hits") else None,
             "waste_when": waste,
             "latency": {k: (round(v, 3) if isinstance(v, float) else v)
                         for k, v in cb.latency_stats().items()}}
@@ -90,6 +98,14 @@ def main():
     ap.add_argument("--paged", action="store_true",
                     help="paged KV pool (enables drained-tail batch "
                     "compaction)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="in-batcher prompt-lookup speculation: n_spec "
+                    "proposals per round, one multi-token verify")
+    ap.add_argument("--spec-ngram", type=int, default=2)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="share full prompt pages across requests "
+                    "(requires --paged)")
+    ap.add_argument("--temperature", type=float, default=0.0)
     args = ap.parse_args()
 
     cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
@@ -104,11 +120,14 @@ def main():
 
     def make():
         return ContinuousBatcher(
-            params, cfg, slots=args.slots, max_len=1024, temperature=0.0,
+            params, cfg, slots=args.slots, max_len=1024,
+            temperature=args.temperature,
             dtype=jnp.bfloat16 if on_tpu else None,
             prompt_buckets=(32, 128), steps_per_sync=args.steps_per_sync,
             prefill_chunk=args.prefill_chunk, schedule=args.schedule,
-            paged=args.paged, **kw)
+            paged=args.paged, speculate=args.speculate,
+            spec_ngram=args.spec_ngram, prefix_cache=args.prefix_cache,
+            **kw)
 
     # cold pass compiles; the reported (timed) pass reuses its compiled
     # fns through a fresh batcher, so tok/s is warm and stats are clean

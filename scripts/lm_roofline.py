@@ -1,0 +1,189 @@
+"""LM train-step roofline decomposition (round-4 VERDICT #6).
+
+Gives the LM step the VGG-grade treatment (ROADMAP.md MFU accounting):
+measure the full step, then its pieces — forward, forward+backward,
+optimizer — and microbench the four matmul families (attention,
+QKV/O projections, SwiGLU FFN, embed/unembed+CE) at the exact training
+shapes, each as fwd+bwd.  The gap between the summed matmul time and
+the measured fwd+bwd is the elementwise/HBM remainder (norms,
+residual adds, rotary, remat traffic); opt is the f32 optimizer HBM
+pass.  Achieved TF/s per family vs the chip's bf16 peak says which op
+(if any) is a lever.
+
+All timings per-step-dispatch loops with ONE value fetch at the end and
+min-of-2 windows (the bench.py methodology — through a tunneled chip a
+fetch costs 60-130 ms RTT).
+
+Run (TPU):  PYTHONPATH=. python scripts/lm_roofline.py [--model large]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.lm import (
+    LMTrainConfig, LMTrainer, make_optimizer)
+from distributed_pytorch_tpu.models import transformer as tfm
+from distributed_pytorch_tpu.ops.attention import flash_attention
+from distributed_pytorch_tpu.ops.nn import masked_ce
+
+MODELS = {
+    "small": dict(d_model=512, n_layers=4, n_heads=4, head_dim=128,
+                  batch=8),
+    "large": dict(d_model=2048, n_layers=8, n_heads=16, head_dim=128,
+                  batch=4),
+}
+
+
+def timed(run, fetch, iters: int) -> float:
+    """ms per call: ``run`` dispatches once (async), ``fetch(out)``
+    forces the final value; min-of-2 windows of ``iters`` calls."""
+    fetch(run())  # compile + warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = run()
+        fetch(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=("small", "large"), default="small")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+    spec = MODELS[args.model]
+    batch, seq = spec["batch"], args.seq
+    model = tfm.TransformerConfig(vocab_size=256, d_model=spec["d_model"],
+                                  n_layers=spec["n_layers"],
+                                  n_heads=spec["n_heads"],
+                                  head_dim=spec["head_dim"])
+    cfg = LMTrainConfig(model=model)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 256, (batch, seq)).astype(np.int32))
+    tgts = jnp.asarray(np.roll(np.asarray(toks), -1, 1).astype(np.int32))
+    dtype = jnp.bfloat16
+    d, ff = model.d_model, model.ff
+    h, dh, nl = model.n_heads, model.head_dim, model.n_layers
+    vocab = model.vocab_size
+    n_tok = batch * seq
+    res = {"model": args.model, "batch": batch, "seq": seq}
+
+    # 1. the full train step (params+opt donated through the loop)
+    state = {"p": tr.params, "o": tr.opt_state}
+
+    def full_step():
+        state["p"], state["o"], loss = tr.step_fn(state["p"], state["o"],
+                                                  toks, tgts)
+        return loss
+
+    res["step_ms"] = timed(full_step, lambda x: float(x), args.iters)
+
+    # 2. forward only and forward+backward of the same loss
+    def loss_fn(params):
+        logits, aux = tfm.apply(params, toks, cfg=model, dtype=dtype,
+                                return_aux=True)
+        ce, n = masked_ce(logits, tgts)
+        return ce / jnp.maximum(n, 1) + 0.01 * aux
+
+    fwd = jax.jit(loss_fn)
+    res["fwd_ms"] = timed(lambda: fwd(tr.params), lambda x: float(x),
+                          args.iters)
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    res["fwd_bwd_ms"] = timed(lambda: vg(tr.params),
+                              lambda x: float(x[0]), args.iters)
+
+    # 3. optimizer alone (clip + AdamW + weight decay, f32 state HBM)
+    tx = make_optimizer(cfg)
+    grads = jax.tree.map(jnp.ones_like, tr.params)
+    ostate = {"o": jax.jit(tx.init)(tr.params), "p": tr.params}
+
+    @jax.jit
+    def opt_step(g, o, p):
+        u, o = tx.update(g, o, p)
+        import optax
+        return optax.apply_updates(p, u), o
+
+    def run_opt():
+        ostate["p"], ostate["o"] = opt_step(grads, ostate["o"],
+                                            ostate["p"])
+        return ostate["p"]
+
+    res["opt_ms"] = timed(
+        run_opt, lambda p: float(jax.tree.leaves(p)[0][0, 0]), args.iters)
+
+    # 4. matmul-family microbenches at training shapes, each fwd+bwd,
+    # scaled by layer count.  FLOPs: 2*M*N*K fwd, x3 train.
+    def micro(f, *xs):
+        # grads w.r.t. EVERY operand: the backward then runs the same
+        # matmul set training does (d-input AND d-weight products)
+        g = jax.jit(jax.grad(lambda *a: f(*a).astype(jnp.float32).sum(),
+                             argnums=tuple(range(len(xs)))))
+        return timed(lambda: g(*xs),
+                     lambda o: float(jax.tree.leaves(o)[0].ravel()[0]),
+                     args.iters)
+
+    q = jnp.asarray(rng.normal(size=(batch, h, seq, dh)), dtype)
+    res["attn_ms"] = nl * micro(
+        lambda q, k, v: flash_attention(q, k, v, causal=True), q, q, q)
+    attn_flops = nl * 3 * 2 * 2 * batch * h * seq * seq * dh / 2  # causal
+
+    x2 = jnp.asarray(rng.normal(size=(n_tok, d)), dtype)
+    wq = jnp.asarray(rng.normal(size=(d, h * dh)) / np.sqrt(d), dtype)
+
+    def qkvo(x, w):
+        return ((x @ w) @ w.T) @ w @ w.T  # 4 projections' worth
+
+    res["qkvo_ms"] = nl * micro(qkvo, x2, wq)
+    qkvo_flops = nl * 3 * 4 * 2 * n_tok * d * h * dh
+
+    wg = jnp.asarray(rng.normal(size=(d, ff)) / np.sqrt(d), dtype)
+    wd = jnp.asarray(rng.normal(size=(ff, d)) / np.sqrt(ff), dtype)
+
+    def ffn(x, wg_, wu_, wd_):
+        return (jax.nn.silu(x @ wg_) * (x @ wu_)) @ wd_
+
+    res["ffn_ms"] = nl * micro(ffn, x2, wg, wg, wd)
+    ffn_flops = nl * 3 * 3 * 2 * n_tok * d * ff
+
+    emb = jnp.asarray(rng.normal(size=(vocab, d)) / np.sqrt(d), dtype)
+
+    def unembed(x, e):
+        logits = x.astype(jnp.float32) @ e.T.astype(jnp.float32)
+        ce, n = masked_ce(logits[None], tgts.reshape(1, -1))
+        return ce / jnp.maximum(n, 1)
+
+    res["embed_ce_ms"] = micro(unembed, x2, emb)
+    emb_flops = 3 * 2 * n_tok * d * vocab
+
+    # 5. the accounting
+    matmul_ms = (res["attn_ms"] + res["qkvo_ms"] + res["ffn_ms"]
+                 + res["embed_ce_ms"])
+    res["matmul_sum_ms"] = round(matmul_ms, 3)
+    res["elementwise_remainder_ms"] = round(
+        res["fwd_bwd_ms"] - matmul_ms, 3)
+    res["step_minus_parts_ms"] = round(
+        res["step_ms"] - res["fwd_bwd_ms"] - res["opt_ms"], 3)
+    peak = 197e12  # v5e bf16
+    for k, fl in (("attn", attn_flops), ("qkvo", qkvo_flops),
+                  ("ffn", ffn_flops), ("embed_ce", emb_flops)):
+        key = f"{k}_ms" if f"{k}_ms" in res else "embed_ce_ms"
+        res[f"{k}_mxu"] = round(fl / (res[key] / 1e3) / peak, 3)
+    for k in list(res):
+        if k.endswith("_ms"):
+            res[k] = round(res[k], 3)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -381,6 +381,49 @@ def test_elastic_crash_resumes_from_checkpoint_trajectory_equal(tmp_path):
 
 
 @pytest.mark.slow
+def test_lm_elastic_crash_resumes_trajectory_equal(tmp_path):
+    """LM elastic recovery end to end (round-4 VERDICT #7): the
+    LMTrainer analog of the VGG elastic test — a 2-process gang training
+    a ZeRO-3-sharded transformer (AdamW state and params SPLIT across
+    the process boundary) loses rank 0 to a hard crash mid-run; the
+    relaunched gang restores the sharded state + data position from the
+    checkpoint and replays the lost steps to a final parameter vector
+    BITWISE equal to an uninterrupted run."""
+    import subprocess
+
+    def launch(out_dir, ckpt_dir, extra_env, port):
+        out_dir.mkdir(exist_ok=True)
+        return subprocess.run(
+            [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+             "--nproc-per-node", "2", "--max-restarts", "1",
+             "--master-port", str(port), "--",
+             "tests/workers/lm_elastic_worker.py"],
+            cwd="/root/repo", capture_output=True, text=True, timeout=420,
+            env=dict(
+                {k: v for k, v in os.environ.items()
+                 if k not in ("JAX_PLATFORMS",)},
+                PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""),
+                TEST_STEPS="6", TEST_CKPT_EVERY="2",
+                TEST_CKPT_DIR=str(ckpt_dir), TEST_OUT_DIR=str(out_dir),
+                **extra_env,
+            ),
+        )
+
+    ctl = launch(tmp_path / "out_ctl", tmp_path / "ckpt_ctl", {}, 16791)
+    assert ctl.returncode == 0, (ctl.stdout[-2000:], ctl.stderr[-2000:])
+    faulty = launch(tmp_path / "out_f", tmp_path / "ckpt_f",
+                    {"TEST_KILL_AT_STEP": "3"}, 16793)
+    assert faulty.returncode == 0, (faulty.stdout[-2000:],
+                                    faulty.stderr[-2000:])
+    assert "KILLING" in faulty.stdout, faulty.stdout
+    assert "attempt=1 start_step=2" in faulty.stdout, faulty.stdout
+
+    final_ctl = np.load(tmp_path / "out_ctl" / "final_attempt0.npy")
+    final_f = np.load(tmp_path / "out_f" / "final_attempt1.npy")
+    np.testing.assert_array_equal(final_f, final_ctl)
+
+
+@pytest.mark.slow
 def test_two_process_hierarchical_training():
     """Hierarchical (dcn x ici) gradient sync across a REAL process
     boundary: 2 processes x 2 fake devices build Mesh(('dcn','ici')) =

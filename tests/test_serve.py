@@ -782,3 +782,307 @@ def test_tensor_parallel_paged_serving(params):
     for rid, p in enumerate(prompts):
         np.testing.assert_array_equal(results[rid], _greedy_oracle(params, p, 8, decode_kernel=True))
     assert len(cb.free_pages) == cb.pool_pages - 1
+
+
+# -- in-batcher speculation ---------------------------------------------------
+
+SPEC_CFG = tfm.TransformerConfig(vocab_size=64, d_model=64, n_layers=2,
+                                 n_heads=2, head_dim=32, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def spec_params():
+    return tfm.init(jax.random.key(0), SPEC_CFG)
+
+
+def _spec_workload():
+    rng = np.random.default_rng(0)
+    prompts = [np.tile(np.asarray([5, 9, 23, 7], np.int32), 6),
+               rng.integers(0, 64, (9,)).astype(np.int32),
+               np.tile(np.asarray([3, 11], np.int32), 8),
+               rng.integers(0, 64, (15,)).astype(np.int32),
+               np.tile(np.asarray([40, 2, 19], np.int32), 5)]
+    budgets = [18, 7, 25, 12, 21]
+    return prompts, budgets
+
+
+def _spec_oracle(spec_params, prompts, budgets):
+    return [np.asarray(gen.generate(
+        spec_params, jnp.asarray(p)[None], jax.random.key(0), cfg=SPEC_CFG,
+        max_new=b, temperature=0.0))[0] for p, b in zip(prompts, budgets)]
+
+
+@pytest.mark.parametrize("kw", [dict(), dict(paged=True),
+                                dict(paged=True, pool_pages=3)])
+def test_spec_serving_oracle_exact(spec_params, kw):
+    """In-batcher speculation (round-4 VERDICT #1): greedy serving with
+    per-slot prompt-lookup proposals + one multi-token ragged verify per
+    round is EXACTLY the non-speculative greedy stream for every request
+    (f32), across slot recycling, in-block refill handoff, mixed
+    lookup-friendly/hostile prompts, dense and paged pools — and the
+    lookup-friendly workload actually accepts proposals."""
+    prompts, budgets = _spec_workload()
+    want = _spec_oracle(spec_params, prompts, budgets)
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=512,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32,), speculate=4, **kw)
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    while cb.pending():
+        cb.step()
+    for r in rids:
+        np.testing.assert_array_equal(cb.result(r), want[r])
+    s = cb.stats
+    assert s["spec_rounds"] > 0 and s["spec_proposed"] > 0
+    assert 0 < s["spec_accepted"] <= s["spec_proposed"]
+    # the speedup identity: tokens per weight pass > 1 requires accepted
+    # proposals; on this half-repetitive workload acceptance is real
+    assert s["spec_accepted"] / s["spec_proposed"] > 0.1, s
+
+
+def test_spec_serving_eos_exact(spec_params):
+    prompts, budgets = _spec_workload()
+    p = prompts[0]
+    ref = _spec_oracle(spec_params, [p], [18])[0]
+    eos = int(ref[len(p) + 3])
+    weos = np.asarray(gen.generate(
+        spec_params, jnp.asarray(p)[None], jax.random.key(0), cfg=SPEC_CFG,
+        max_new=18, temperature=0.0, eos_id=eos))[0]
+    cut = int(np.where(weos[len(p):] == eos)[0][0]) + 1
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=512,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32,), speculate=4)
+    rid = cb.submit(p, max_new=18, eos_id=eos)
+    while cb.pending():
+        cb.step()
+    np.testing.assert_array_equal(cb.result(rid), weos[:len(p) + cut])
+
+
+def test_spec_serving_preemption_exact(spec_params):
+    """Speculation x host-swap preemption: an oversubscribed page pool
+    that actually evicts mid-generation still produces the exact greedy
+    streams (the swapped pages restore bitwise; spec windows clamp at
+    the restored frontier)."""
+    rng = np.random.default_rng(3)
+    # IDENTICAL requests progress in lockstep (same greedy stream, same
+    # acceptance), so both cross the 512-token page boundary in the SAME
+    # block — with only 3 usable pages for 2x2 needed, the second
+    # crosser must preempt deterministically (no timing luck)
+    p = np.tile(rng.integers(0, 64, (4,)).astype(np.int32), 8)
+    prompts = [p, p]
+    budgets = [610, 610]
+    want = _spec_oracle(spec_params, prompts, budgets)
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=1024,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32,), speculate=4,
+                           paged=True, pool_pages=4)
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    while cb.pending():
+        cb.step()
+    for r in rids:
+        np.testing.assert_array_equal(cb.result(r), want[r])
+    assert cb.stats["evictions"] > 0 and cb.stats["swap_ins"] > 0, cb.stats
+
+
+def test_spec_serving_tp_exact(spec_params):
+    """Speculation through tensor-parallel serving: the verify forward
+    runs inside shard_map on Megatron shards with a head-sharded pool."""
+    from jax.sharding import Mesh, NamedSharding
+    prompts, budgets = _spec_workload()
+    want = _spec_oracle(spec_params, prompts, budgets)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    specs = tfm.shard_specs(SPEC_CFG, tp_axis="model")
+    sharded = jax.device_put(spec_params, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs))
+    cb = ContinuousBatcher(sharded, SPEC_CFG, slots=2, max_len=512,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32,), speculate=4, mesh=mesh)
+    rids = [cb.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    while cb.pending():
+        cb.step()
+    for r in rids:
+        np.testing.assert_array_equal(cb.result(r), want[r])
+
+
+def test_spec_serving_sampled_distribution(spec_params):
+    """Sampled in-batcher speculation preserves the warped target
+    distribution: the serve block's OWN point-mass rejection sampler
+    (independent of generate.py's) is pinned against the analytic
+    marginal of generated position 1, with plain (speculate=0) sampled
+    serving as the calibration at the same sample count."""
+    from tests.test_lm_data_gen import _marginal_pos1
+    prompt = np.asarray([3, 17, 5, 9], np.int32)
+    temperature = 1.0
+    want = _marginal_pos1(spec_params, SPEC_CFG,
+                          jnp.asarray(prompt)[None], temperature, None,
+                          None)
+
+    def harvest(speculate, reps=9, slots=8):
+        toks = []
+        for rep in range(reps):
+            cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=slots,
+                                   max_len=512, temperature=temperature,
+                                   steps_per_sync=2,
+                                   prompt_buckets=(32,),
+                                   speculate=speculate, seed=100 + rep)
+            rids = [cb.submit(prompt, max_new=3) for _ in range(slots)]
+            while cb.pending():
+                cb.step()
+            toks += [cb.result(r)[len(prompt) + 1] for r in rids]
+        emp = np.bincount(np.asarray(toks), minlength=SPEC_CFG.vocab_size)
+        return 0.5 * np.abs(emp / len(toks) - want).sum()
+
+    tv_spec = harvest(speculate=3)
+    tv_plain = harvest(speculate=0)
+    # 72 samples over vocab 64: noise TV ~0.45 — catches gross bias
+    # (always-accept / never-resample), not fine error; the fine-grained
+    # pin is the generate.py marginal test sharing filter_per_seq
+    assert tv_spec < tv_plain + 0.15, (tv_spec, tv_plain)
+
+
+def test_spec_serving_stats_identity(spec_params):
+    """Speculation accounting: dispatched verify positions bound useful
+    work, and utilization() stays the single coherent source."""
+    prompts, budgets = _spec_workload()
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=512,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32,), speculate=4)
+    cb.run(prompts, max_new=8)
+    s = cb.stats
+    useful = (s["emitted_tokens"] - s["batch_admissions"]
+              + s["inblock_prefill_steps"])
+    assert 0 < useful <= s["slot_steps"] + s["spec_rounds"] * cb.slots, s
+    assert s["slot_steps"] == s["spec_rounds"] * cb.slots * (cb.n_spec + 1)
+    assert abs(cb.utilization()
+               - useful / s["slot_steps"]) < 1e-9
+
+
+# -- prefix caching -----------------------------------------------------------
+
+def _prefix_oracle(spec_params, p, b):
+    return np.asarray(gen.generate(
+        spec_params, jnp.asarray(p)[None], jax.random.key(0), cfg=SPEC_CFG,
+        max_new=b, temperature=0.0))[0]
+
+
+def test_prefix_cache_shared_prompt_workload(spec_params):
+    """Prefix caching (round-4 VERDICT #3): N requests sharing a >1-page
+    system prompt admit over the SAME cached pages — prefill work drops
+    to one full prefill + per-request suffix dispatches, pages in use
+    drop ~Nx, outputs stay oracle-exact, and the registry persists
+    across retirements (a later wave is all hits)."""
+    rng = np.random.default_rng(0)
+    sysp = rng.integers(0, 64, (520,)).astype(np.int32)
+    prompts = [np.concatenate([sysp,
+                               rng.integers(0, 64, (6,)).astype(np.int32)])
+               for _ in range(4)]
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=1024,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32, 1024), paged=True,
+                           prefix_cache=True)
+    rids = [cb.submit(p, max_new=6) for p in prompts]
+    while cb.pending():
+        cb.step()
+    for r, p in zip(rids, prompts):
+        np.testing.assert_array_equal(cb.result(r),
+                                      _prefix_oracle(spec_params, p, 6))
+    s = cb.stats
+    # one full prefill registered the page; the other three shared it
+    assert s["prefix_hits"] == 3 and s["prefix_pages_shared"] == 3, s
+    # page economy: at any point a sharing slot owns 1 shared + 1 fresh
+    # page instead of 2 private ones; across the run the single shared
+    # page replaced 3 private prefix pages
+    assert len(cb.registry) == 1
+    pid = next(iter(cb.registry.values()))
+    assert cb.page_refs[pid] == 0  # all retired; cached for the future
+
+    # second wave: every admission hits the persistent registry
+    rids = [cb.submit(p, max_new=6) for p in prompts]
+    while cb.pending():
+        cb.step()
+    for r, p in zip(rids, prompts):
+        np.testing.assert_array_equal(cb.result(r),
+                                      _prefix_oracle(spec_params, p, 6))
+    assert cb.stats["prefix_hits"] == 7, cb.stats
+
+
+def test_prefix_cache_reclaim_under_pressure(spec_params):
+    """Registry pages yield to live work: distinct cached prefixes are
+    reclaimed FIFO when the free list runs dry, instead of preempting
+    occupants or failing admissions — and reuse stays exact afterward."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 64, (513,)).astype(np.int32)
+               for _ in range(4)]  # 4 DISTINCT 1-full-page prefixes
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=1024,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32, 1024), paged=True,
+                           prefix_cache=True, pool_pages=6)
+    rids = [cb.submit(p, max_new=4) for p in prompts]
+    while cb.pending():
+        cb.step()
+    for r, p in zip(rids, prompts):
+        np.testing.assert_array_equal(cb.result(r),
+                                      _prefix_oracle(spec_params, p, 4))
+    s = cb.stats
+    # 5 usable pages cannot hold 4 registered prefixes + 2x2 live pages:
+    # old registrations were reclaimed to keep admissions flowing
+    assert s["prefix_reclaimed"] > 0, s
+    assert len(cb.registry) + len(cb.free_pages) == cb.pool_pages - 1
+
+
+def test_prefix_cache_composes_with_speculation(spec_params):
+    """prefix_cache x speculate: shared-prefix admission then
+    speculative decode — exact streams, hits recorded, and the spec
+    window's clamped writes never corrupt the shared pages (a second
+    shared-prefix wave decodes identically)."""
+    rng = np.random.default_rng(2)
+    sysp = np.tile(rng.integers(0, 64, (8,)).astype(np.int32), 65)[:516]
+    prompts = [np.concatenate([sysp,
+                               rng.integers(0, 64, (5,)).astype(np.int32)])
+               for _ in range(3)]
+    cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2, max_len=1024,
+                           temperature=0.0, steps_per_sync=4,
+                           prompt_buckets=(32, 1024), paged=True,
+                           prefix_cache=True, speculate=4)
+    for wave in range(2):
+        rids = [cb.submit(p, max_new=12) for p in prompts]
+        while cb.pending():
+            cb.step()
+        for r, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                cb.result(r), _prefix_oracle(spec_params, p, 12))
+    assert cb.stats["prefix_hits"] >= 5, cb.stats
+    assert cb.stats["spec_rounds"] > 0
+
+
+# -- scheduling fairness ------------------------------------------------------
+
+def test_lpt_delays_short_requests(spec_params):
+    """The fairness cost of longest_first (round-4 VERDICT #10): LPT
+    admits the largest budgets first, so a SHORT request submitted first
+    gets its first token strictly LATER (in step() calls — the
+    deterministic clock behind the wall-clock TTFT percentiles) than
+    under fifo, which serves it immediately.  This pins the trade the
+    latency_stats exist to expose."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, (6,)).astype(np.int32)
+               for _ in range(4)]
+    budgets = [4, 60, 50, 40]  # the short request arrives FIRST
+
+    def first_emit_step(schedule):
+        cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=2,
+                               max_len=512, temperature=0.0,
+                               steps_per_sync=4, prompt_buckets=(32,),
+                               schedule=schedule)
+        rids = [cb.submit(p, max_new=b)
+                for p, b in zip(prompts, budgets)]
+        first, step_i = {}, 0
+        while cb.pending():
+            step_i += 1
+            for rid, _ in cb.step():
+                first.setdefault(rid, step_i)
+        return {r: first[r] for r in rids}, rids[0]
+
+    fifo, short = first_emit_step("fifo")
+    lpt, _ = first_emit_step("longest_first")
+    assert fifo[short] == 1, fifo       # fifo serves the head immediately
+    assert lpt[short] > fifo[short], (lpt, fifo)
