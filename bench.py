@@ -181,11 +181,15 @@ def lm_train_flops_per_token(cfg, n_params: int, seq: int) -> float:
 
 
 def _bench_lm_at(model_cfg, label: str, iters: int, batch: int,
-                 seq: int) -> tuple[float, float | None]:
+                 seq: int, sync_every: int = 0) -> tuple[float, float | None]:
     """Shared LM train-step measurement (ONE methodology for every LM
     gate): per-step dispatch (the measured-faster shape at ~30 ms steps:
     async dispatch already hides the host), one value fetch at the end,
-    min-of-2 windows."""
+    min-of-2 windows.  ``sync_every=1`` fetches the loss every step —
+    required at 535M, where queueing many un-synced dispatches of
+    multi-GB donated state makes the tunnel client mirror them host-side
+    (observed 15GB RSS and a stall); the sync tail is small next to a
+    ~300 ms step."""
     import jax
 
     from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
@@ -202,6 +206,8 @@ def _bench_lm_at(model_cfg, label: str, iters: int, batch: int,
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = tr.train_step(toks, tgts)
+            if sync_every:
+                float(loss)
         float(loss)
         best = min(best, time.perf_counter() - t0)
     tps = batch * seq * iters / best
@@ -238,7 +244,8 @@ def bench_lm_large(iters: int = 12, batch: int = 4,
     535M d2048/8L config (round-4 VERDICT #6: gate MFU where the model
     is large enough for the question to be about the MXU, not per-op
     overhead).  Same methodology as bench_lm (shared _bench_lm_at)."""
-    return _bench_lm_at(_lm_large_cfg(), "lm-large", iters, batch, seq)
+    return _bench_lm_at(_lm_large_cfg(), "lm-large", iters, batch,
+                        seq, sync_every=1)
 
 
 def bench_decode(max_new: int = 1024) -> float:

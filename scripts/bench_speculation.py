@@ -70,9 +70,23 @@ def build_corpus(kind: str, n_bytes: int) -> np.ndarray:
 
 
 def train_model(name: str, tokens: np.ndarray, steps: int, batch: int,
-                seq: int):
+                seq: int, cache_dir: str | None = None):
     cfg = LMTrainConfig(model=tfm.TransformerConfig(vocab_size=256,
                                                     **MODELS[name]))
+    if cache_dir:
+        import os
+        path = os.path.join(cache_dir, f"{name}_{steps}.npz")
+        if os.path.exists(path):
+            import jax
+            z = np.load(path, allow_pickle=True)
+            flat = [z[f"a{i}"] for i in range(len(z.files) - 1)]
+            import pickle
+            td = pickle.loads(z["treedef"].tobytes())
+            params = jax.tree.unflatten(td, [jax.numpy.asarray(a)
+                                             for a in flat])
+            print(f"[spec-bench] {name}: loaded cached params ({path})",
+                  flush=True)
+            return params, cfg.model, float("nan")
     tr = LMTrainer(cfg)
     dl = lm_corpus.LMDataLoader(lm_corpus.LMCorpus(tokens),
                                 batch_size=batch, seq_len=seq, seed=0)
@@ -90,6 +104,12 @@ def train_model(name: str, tokens: np.ndarray, steps: int, batch: int,
     print(f"[spec-bench] {name}: {steps} steps in "
           f"{time.perf_counter() - t0:.0f}s, final loss {loss:.3f}",
           flush=True)
+    if cache_dir:
+        import os, pickle, jax
+        leaves, td = jax.tree.flatten(tr.params)
+        np.savez(os.path.join(cache_dir, f"{name}_{steps}.npz"),
+                 treedef=np.frombuffer(pickle.dumps(td), np.uint8),
+                 **{f"a{i}": np.asarray(x) for i, x in enumerate(leaves)})
     return tr.params, tr.cfg.model, loss
 
 
@@ -120,13 +140,17 @@ def bench_static(params, cfg, draft, draft_cfg, prompts, max_new, n_spec,
     rows = {"plain_wall_s": round(t_plain, 2)}
 
     def stats_of(out):
-        toks, st = out
-        jax.block_until_ready(toks)
+        _, st = out
         return {k: int(v) for k, v in st.items()}
 
-    t_lk, out = timed(lambda: gen.generate_lookup(
+    def _fetched(out):
+        # a real value FETCH, matching the plain path: through the
+        # tunnel block_until_ready can return before compute finishes
+        return (np.asarray(out[0]), out[1])
+
+    t_lk, out = timed(lambda: _fetched(gen.generate_lookup(
         params, prompt, cfg=cfg, max_new=max_new, n_spec=n_spec,
-        ngram=ngram, dtype=jnp.bfloat16))
+        ngram=ngram, dtype=jnp.bfloat16)))
     st = stats_of(out)
     rows["lookup"] = dict(wall_s=round(t_lk, 2),
                           speedup=round(t_plain / t_lk, 2),
@@ -134,10 +158,11 @@ def bench_static(params, cfg, draft, draft_cfg, prompts, max_new, n_spec,
                                            / max(st["drafted"], 1), 3),
                           rounds=st["rounds"])
     if draft is not None:
-        t_sp, out = timed(lambda: gen.generate_speculative(
-            params, draft, prompt, cfg=cfg, draft_cfg=draft_cfg,
-            max_new=max_new, n_spec=max(n_spec // 2, 3),
-            dtype=jnp.bfloat16, decode_kernel=True))
+        t_sp, out = timed(lambda: _fetched(
+            gen.generate_speculative(
+                params, draft, prompt, cfg=cfg, draft_cfg=draft_cfg,
+                max_new=max_new, n_spec=max(n_spec // 2, 3),
+                dtype=jnp.bfloat16, decode_kernel=True)))
         st = stats_of(out)
         rows["draft_spec"] = dict(wall_s=round(t_sp, 2),
                                   speedup=round(t_plain / t_sp, 2),
@@ -171,6 +196,9 @@ def bench_serving(params, cfg, prompts, budgets, n_spec, ngram, slots,
         while cb.pending():
             cb.step()
         wall = time.perf_counter() - t0
+        print(f"[spec-bench] spec={spec}: warm wall {wall:.1f}s, "
+              f"{cb.stats['decode_dispatches']} decode dispatches, "
+              f"{cb.stats['prefill_dispatches']} prefills", flush=True)
         total = sum(len(cb.result(r)) - len(p)
                     for r, p in zip(rids, prompts))
         s = cb.stats
@@ -212,16 +240,25 @@ def main():
     ap.add_argument("--steps-per-sync", type=int, default=8)
     ap.add_argument("--paged", action="store_true")
     ap.add_argument("--corpus-bytes", type=int, default=1 << 21)
+    ap.add_argument("--params-cache", default=None,
+                    help="dir to cache trained params (skips retraining)")
     args = ap.parse_args()
 
     tokens = build_corpus(args.corpus, args.corpus_bytes)
+    cache = (f"{args.params_cache}/{args.corpus}"
+             if args.params_cache else None)
+    if cache:
+        import os
+        os.makedirs(cache, exist_ok=True)
     params, cfg, loss = train_model(args.model, tokens, args.train_steps,
-                                    args.train_batch, args.train_seq)
+                                    args.train_batch, args.train_seq,
+                                    cache_dir=cache)
     draft = draft_cfg = None
     if args.with_draft:
         draft, draft_cfg, _ = train_model("draft", tokens,
                                           args.train_steps,
-                                          args.train_batch, args.train_seq)
+                                          args.train_batch, args.train_seq,
+                                          cache_dir=cache)
     out = {"mode": args.mode, "corpus": args.corpus, "model": args.model,
            "train_steps": args.train_steps, "target_loss": round(loss, 3),
            "n_spec": args.n_spec, "ngram": args.ngram}

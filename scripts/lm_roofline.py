@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import jax
@@ -55,11 +56,55 @@ def timed(run, fetch, iters: int) -> float:
     return best / iters * 1e3
 
 
+def timed_scan(body, carry, inner: int, fetch, carry_fn=None,
+               target_ms: float = 2500.0) -> float:
+    """ms per INNER iteration of a dependency-chained ``lax.scan``.
+    The tunnel charges a FIXED ~100 ms dispatch+fetch overhead per
+    synchronized window (measured: a 60-iteration window over a 0.09 ms
+    matmul reads 20x slow), so the timed window CHAINS repeated calls
+    of one fixed-length compiled loop — carry out feeds carry in, all
+    async, ONE fetch at the end — until it spans ``target_ms`` of
+    device time; min-of-2 windows on top.  No per-repetition compiles.
+
+    ``carry_fn`` (optional) rebuilds a fresh carry per window and the
+    loop DONATES it — for carries the size of optimizer state, where
+    keeping input and output trees alive would not fit HBM; the rebuild
+    runs outside the timed region (donation makes chaining free)."""
+    def scan_body(c):
+        return jax.lax.scan(lambda c, _: (body(c), None), c, None,
+                            length=inner)[0]
+
+    loop = (jax.jit(scan_body, donate_argnums=(0,)) if carry_fn
+            else jax.jit(scan_body))
+    get = carry_fn if carry_fn is not None else lambda: carry
+
+    def window(reps):
+        c0 = get()
+        jax.block_until_ready(jax.tree.leaves(c0)[0])
+        t0 = time.perf_counter()
+        c = loop(c0)
+        for _ in range(reps - 1):
+            c = loop(c)
+        fetch(c)
+        return time.perf_counter() - t0
+
+    fetch(loop(get()))      # compile + warm
+    w1 = window(1)
+    reps = max(int(target_ms / max(w1 * 1e3, 1e-6)), 1)
+    best = min(window(reps), window(reps))
+    return best / (reps * inner) * 1e3
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=("small", "large"), default="small")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--inner", type=int, default=60,
+                    help="chained iterations per scan dispatch")
+    ap.add_argument("--skip-step", action="store_true",
+                    help="skip the full-step phase (use the bench.py "
+                         "lm gate number instead)")
     args = ap.parse_args()
     spec = MODELS[args.model]
     batch, seq = spec["batch"], args.seq
@@ -67,8 +112,10 @@ def main():
                                   n_layers=spec["n_layers"],
                                   n_heads=spec["n_heads"],
                                   head_dim=spec["head_dim"])
+    print("[roofline] building trainer", file=sys.stderr, flush=True)
     cfg = LMTrainConfig(model=model)
     tr = LMTrainer(cfg)
+    print("[roofline] measuring step", file=sys.stderr, flush=True)
     rng = np.random.default_rng(0)
     toks = jnp.asarray(rng.integers(0, 256, (batch, seq)).astype(np.int32))
     tgts = jnp.asarray(np.roll(np.asarray(toks), -1, 1).astype(np.int32))
@@ -79,59 +126,101 @@ def main():
     n_tok = batch * seq
     res = {"model": args.model, "batch": batch, "seq": seq}
 
-    # 1. the full train step (params+opt donated through the loop)
-    state = {"p": tr.params, "o": tr.opt_state}
+    # 1. the full train step FIRST, donating the trainer's own state
+    # through the loop (copies would not fit HBM at 535M: params 2.1GB
+    # + Adam 4.2GB doubled).  The evolved params then serve the other
+    # measurements; the optimizer tree is dropped to free its 4.2GB.
+    toks_np, tgts_np = np.asarray(toks), np.asarray(tgts)
 
     def full_step():
-        state["p"], state["o"], loss = tr.step_fn(state["p"], state["o"],
-                                                  toks, tgts)
-        return loss
+        # the trainer's own entry point (device_put per call), with the
+        # loss fetched EVERY step: at 535M, queueing many un-synced
+        # dispatches of multi-GB donated state makes the tunnel client
+        # mirror them host-side (observed 15GB RSS and a stalled run);
+        # the per-step sync tail is small next to a ~300 ms step and is
+        # part of what a real training loop pays anyway
+        return float(tr.train_step(toks_np, tgts_np))
 
-    res["step_ms"] = timed(full_step, lambda x: float(x), args.iters)
+    if args.skip_step:
+        res["step_ms"] = None  # bench.py's lm gate measures it
+    else:
+        res["step_ms"] = timed(full_step, lambda x: x, args.iters)
+    params = tr.params
+    tr.opt_state = None
 
-    # 2. forward only and forward+backward of the same loss
+    # 2. forward only and forward+backward of the same loss, each a
+    # dependency-chained scan (ONE dispatch per window)
     def loss_fn(params):
         logits, aux = tfm.apply(params, toks, cfg=model, dtype=dtype,
                                 return_aux=True)
         ce, n = masked_ce(logits, tgts)
         return ce / jnp.maximum(n, 1) + 0.01 * aux
 
-    fwd = jax.jit(loss_fn)
-    res["fwd_ms"] = timed(lambda: fwd(tr.params), lambda x: float(x),
-                          args.iters)
-    vg = jax.jit(jax.value_and_grad(loss_fn))
-    res["fwd_bwd_ms"] = timed(lambda: vg(tr.params),
-                              lambda x: float(x[0]), args.iters)
+    inner = args.inner
+
+    def fwd_body(c):
+        # params ride the CARRY: closing over them would bake 2.1GB of
+        # weights into the program as constants — measured minutes of
+        # extra lowering at 535M; the loss dependency is a tiny embed
+        # perturbation
+        p, lo = c
+        return (p, loss_fn(dict(p, embed=p["embed"] + lo * 1e-30)))
+
+    print("[roofline] measuring fwd", file=sys.stderr, flush=True)
+    res["fwd_ms"] = timed_scan(fwd_body, (params, jnp.float32(0.0)),
+                               inner, lambda c: float(c[1]))
+
+    print("[roofline] measuring fwd_bwd", file=sys.stderr,
+          flush=True)
+    vg = jax.value_and_grad(loss_fn)
+
+    def fwd_bwd_body(p):
+        _, g = vg(p)
+        return jax.tree.map(
+            lambda a, gg: (a - 1e-12 * gg).astype(a.dtype), p, g)
+
+    res["fwd_bwd_ms"] = timed_scan(
+        fwd_bwd_body, None, inner,
+        lambda p: float(jax.tree.leaves(p)[0].ravel()[0]),
+        carry_fn=lambda: jax.tree.map(jnp.array, params))
 
     # 3. optimizer alone (clip + AdamW + weight decay, f32 state HBM)
+    import optax
     tx = make_optimizer(cfg)
-    grads = jax.tree.map(jnp.ones_like, tr.params)
-    ostate = {"o": jax.jit(tx.init)(tr.params), "p": tr.params}
+    grads = jax.tree.map(jnp.ones_like, params)
 
-    @jax.jit
-    def opt_step(g, o, p):
+    def opt_body(c):
+        # grads ride the carry too (same closed-over-constants hazard)
+        p, o, g = c
         u, o = tx.update(g, o, p)
-        import optax
-        return optax.apply_updates(p, u), o
+        return (optax.apply_updates(p, u), o, g)
 
-    def run_opt():
-        ostate["p"], ostate["o"] = opt_step(grads, ostate["o"],
-                                            ostate["p"])
-        return ostate["p"]
+    res["opt_ms"] = timed_scan(
+        opt_body, None, inner,
+        lambda c: float(jax.tree.leaves(c[0])[0].ravel()[0]),
+        carry_fn=lambda: (jax.tree.map(jnp.array, params),
+                          jax.jit(tx.init)(params), grads))
 
-    res["opt_ms"] = timed(
-        run_opt, lambda p: float(jax.tree.leaves(p)[0][0, 0]), args.iters)
-
-    # 4. matmul-family microbenches at training shapes, each fwd+bwd,
-    # scaled by layer count.  FLOPs: 2*M*N*K fwd, x3 train.
+    # 4. matmul-family microbenches at training shapes, each fwd+bwd
+    # (grads w.r.t. EVERY operand so the backward runs the same matmul
+    # set training does), chained by a vanishing SGD step
     def micro(f, *xs):
-        # grads w.r.t. EVERY operand: the backward then runs the same
-        # matmul set training does (d-input AND d-weight products)
-        g = jax.jit(jax.grad(lambda *a: f(*a).astype(jnp.float32).sum(),
-                             argnums=tuple(range(len(xs)))))
-        return timed(lambda: g(*xs),
-                     lambda o: float(jax.tree.leaves(o)[0].ravel()[0]),
-                     args.iters)
+        # squared-sum loss: the incoming cotangent is 2*out (runtime
+        # data) — a plain .sum() feeds a LITERAL ones cotangent that
+        # XLA constant-folds parts of the backward away (measured >100%
+        # "MXU" on the matmul micros before this fix)
+        g = jax.grad(
+            lambda *a: (lambda o: (o * o).sum())(
+                f(*a).astype(jnp.float32)),
+            argnums=tuple(range(len(xs))))
+
+        def body(c):
+            gs = g(*c)
+            return tuple((a - 1e-12 * gg).astype(a.dtype)
+                         for a, gg in zip(c, gs))
+
+        return timed_scan(body, xs, inner,
+                          lambda c: float(c[0].ravel()[0]))
 
     q = jnp.asarray(rng.normal(size=(batch, h, seq, dh)), dtype)
     res["attn_ms"] = nl * micro(
@@ -166,21 +255,32 @@ def main():
     res["embed_ce_ms"] = micro(unembed, x2, emb)
     emb_flops = 3 * 2 * n_tok * d * vocab
 
+    # control: a bare fwd (n_tok, d) @ (d, d) matmul chain at the same
+    # tile shapes — the achieved-TF/s ceiling the model's K=d tiles
+    # allow, independent of autodiff (compare with calibrate 4096^3)
+    wsq = jnp.asarray(rng.normal(size=(d, d)) / np.sqrt(d), dtype)
+    res["ctl_matmul_ms"] = timed_scan(
+        lambda x: ((x @ wsq) / jnp.float32(1.0)).astype(dtype), x2,
+        inner, lambda x: float(x.ravel()[0]))
+    res["ctl_matmul_tflops"] = round(
+        2 * n_tok * d * d / (res["ctl_matmul_ms"] / 1e3) / 1e12, 1)
+
     # 5. the accounting
     matmul_ms = (res["attn_ms"] + res["qkvo_ms"] + res["ffn_ms"]
                  + res["embed_ce_ms"])
     res["matmul_sum_ms"] = round(matmul_ms, 3)
     res["elementwise_remainder_ms"] = round(
         res["fwd_bwd_ms"] - matmul_ms, 3)
-    res["step_minus_parts_ms"] = round(
+    res["step_minus_parts_ms"] = (round(
         res["step_ms"] - res["fwd_bwd_ms"] - res["opt_ms"], 3)
+        if res["step_ms"] is not None else None)
     peak = 197e12  # v5e bf16
     for k, fl in (("attn", attn_flops), ("qkvo", qkvo_flops),
                   ("ffn", ffn_flops), ("embed_ce", emb_flops)):
         key = f"{k}_ms" if f"{k}_ms" in res else "embed_ce_ms"
         res[f"{k}_mxu"] = round(fl / (res[key] / 1e3) / peak, 3)
     for k in list(res):
-        if k.endswith("_ms"):
+        if k.endswith("_ms") and res[k] is not None:
             res[k] = round(res[k], 3)
     print(json.dumps(res))
 

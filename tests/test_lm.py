@@ -23,25 +23,41 @@ def _data(b=4, s=256, vocab=1024):
     return tokens, targets
 
 
+_BASE_RUN_CACHE: dict = {}
+
+
+def _base_run(steps=3):
+    """The (1,1,1) baseline trajectory the mesh-layout tests compare
+    against, computed ONCE per suite process (ROADMAP wall-time policy:
+    consolidate same-shape LMTrainer builds — this run repeated
+    identically per parametrization before round 5)."""
+    if "traj" not in _BASE_RUN_CACHE:
+        from distributed_pytorch_tpu.models import transformer as tfm
+        model = tfm.TransformerConfig(vocab_size=256, d_model=128,
+                                      n_layers=2, n_heads=2, head_dim=64,
+                                      d_ff=256)
+        tokens, targets = _data(s=128, vocab=256)
+        tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None))
+        losses = [float(tr.train_step(tokens, targets))
+                  for _ in range(steps)]
+        _BASE_RUN_CACHE["traj"] = (
+            model, tokens, targets, losses,
+            jax.tree.map(np.asarray, tr.params))
+    return _BASE_RUN_CACHE["traj"]
+
+
 @pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (1, 4, 2)])
 def test_trajectory_invariant_to_mesh_layout(dp, sp, tp):
     # Small explicit model: the invariance property is dimension-independent
     # and VGG/LM-tiny-sized compiles dominate one-core suite time.
-    from distributed_pytorch_tpu.models import transformer as tfm
-    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
-                                  n_heads=2, head_dim=64, d_ff=256)
-    tokens, targets = _data(s=128, vocab=256)
-    runs = {}
-    for name, (d, s, t) in {"base": (1, 1, 1), "par": (dp, sp, tp)}.items():
-        cfg = LMTrainConfig(model=model, dp=d, sp=s, tp=t,
-                            compute_dtype=None)
-        tr = LMTrainer(cfg)
-        losses = [float(tr.train_step(tokens, targets)) for _ in range(3)]
-        runs[name] = (losses, jax.tree.map(np.asarray, tr.params))
-    np.testing.assert_allclose(runs["par"][0], runs["base"][0],
-                               rtol=1e-5, atol=1e-6)
-    for a, b in zip(jax.tree.leaves(runs["base"][1]),
-                    jax.tree.leaves(runs["par"][1])):
+    model, tokens, targets, base_losses, base_params = _base_run()
+    cfg = LMTrainConfig(model=model, dp=dp, sp=sp, tp=tp,
+                        compute_dtype=None)
+    tr = LMTrainer(cfg)
+    losses = [float(tr.train_step(tokens, targets)) for _ in range(3)]
+    np.testing.assert_allclose(losses, base_losses, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(base_params),
+                    jax.tree.leaves(jax.tree.map(np.asarray, tr.params))):
         # atol absorbs Adam's amplification of f32 reduction-order noise on
         # near-zero gradient entries
         np.testing.assert_allclose(b, a, rtol=1e-4, atol=5e-4)

@@ -578,7 +578,7 @@ def test_sampled_speculation_distribution_matches_target(
     prompt1 = jnp.asarray([[3, 17, 5, 9]], jnp.int32)
     want = _marginal_pos1(params, cfg, prompt1, temperature, top_k, top_p)
 
-    b, reps, s0 = 256, 4, prompt1.shape[1]
+    b, reps, s0 = 256, 3, prompt1.shape[1]
     prompt = jnp.broadcast_to(prompt1, (b, s0))
     kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
 
@@ -590,8 +590,8 @@ def test_sampled_speculation_distribution_matches_target(
         return 0.5 * np.abs(emp - want).sum()
 
     # calibration: plain sampled decode against the analytic marginal
-    # (also validates the marginal computation itself); N = 1024, V = 32
-    # puts the TV sampling noise around 0.05
+    # (also validates the marginal computation itself); N = 768, V = 32
+    # puts the TV sampling noise around 0.06
     tv_plain = tv_of(lambda k: gen.generate(
         params, prompt, k, cfg=cfg, max_new=3, **kw))
     tv_spec = tv_of(lambda k: gen.generate_speculative(
@@ -599,9 +599,9 @@ def test_sampled_speculation_distribution_matches_target(
         max_new=3, n_spec=3, **kw)[0])
     tv_lookup = tv_of(lambda k: gen.generate_lookup(
         params, prompt, k, cfg=cfg, max_new=3, n_spec=3, ngram=2, **kw)[0])
-    assert tv_plain < 0.12, tv_plain
-    assert tv_spec < 0.12, (tv_spec, tv_plain)
-    assert tv_lookup < 0.12, (tv_lookup, tv_plain)
+    assert tv_plain < 0.13, tv_plain
+    assert tv_spec < 0.13, (tv_spec, tv_plain)
+    assert tv_lookup < 0.13, (tv_lookup, tv_plain)
 
 
 def test_filter_logits_topk_out_of_range_is_noop():
