@@ -107,7 +107,7 @@ def _sharded_batch(params, state, images, labels, mask, *, mesh, model_name,
     """Mesh-sharded (ce_sum, correct, n_real) — jit-cached across epochs
     (mesh/model/dtype are hashable statics, so repeat calls reuse the
     executable instead of recompiling per evaluate_sharded call)."""
-    from jax import shard_map
+    from .utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     # The data axis may be factored (hierarchical: ('dcn', 'ici')) — shard

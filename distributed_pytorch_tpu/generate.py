@@ -169,9 +169,10 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     if dtype is not None:
         x = x.astype(dtype)
     # ``k_len`` (static) restricts attention to the first cache slots:
-    # prefill passes the prompt length, and segmented decode passes its
-    # segment's bound, so neither reads the not-yet-written (masked
-    # anyway) tail of the buffer.
+    # prefill passes the prompt length, segmented decode its segment's
+    # bound, and the paged verify window the batcher's live-depth hint,
+    # so none reads the not-yet-written (masked anyway) tail.
+    k_len_hint = k_len
     k_len = k_len or next(iter(cache.values()))["k"].shape[2]
     s = tokens.shape[1]
     ragged = pos.ndim == 2  # (B, S) per-sequence positions
@@ -200,12 +201,20 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
     # speculative decoders use (their windows always start at the
     # per-sequence frontier).
     scatter_writes = multi_ragged and write_at.ndim == 2
+    gather_cols = page_table.shape[1] if page_table is not None else 0
     if page_table is not None and multi_ragged:
-        # the gathered contiguous view spans the table's whole logical
-        # range; the per-row pos bias masks everything beyond each
-        # sequence's own depth
-        k_len = page_table.shape[1] * next(
-            iter(cache.values()))["k"].shape[2]
+        # the gathered contiguous view spans the table's logical range,
+        # BOUNDED by the caller's ``k_len`` hint when given: only the
+        # first ceil(k_len / page) table columns are gathered — O(live
+        # depth) HBM traffic instead of O(pages_per_slot * page) per
+        # layer per speculation round (the serve batcher passes the
+        # pool's deepest allocated frontier).  The per-row pos bias
+        # masks everything beyond each sequence's own depth either way;
+        # writes ride the FULL table, so the bound never clamps them.
+        page = next(iter(cache.values()))["k"].shape[2]
+        if k_len_hint:
+            gather_cols = min(-(-k_len_hint // page), gather_cols)
+        k_len = gather_cols * page
     if not kernel_path:
         # bias[j, slot]: query at global position pos[j] sees slots <= pos[j]
         slot = jax.lax.broadcasted_iota(jnp.int32, (s, k_len), 1)
@@ -272,9 +281,10 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
             # single-token kernel)
             bsz, hkv_l, page, hd = (tokens.shape[0], ck.shape[1],
                                     ck.shape[2], ck.shape[3])
-            ka = (ck[page_table].transpose(0, 2, 1, 3, 4)
+            tbl = page_table[:, :gather_cols]  # live-depth-bounded gather
+            ka = (ck[tbl].transpose(0, 2, 1, 3, 4)
                   .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
-            va = (cv[page_table].transpose(0, 2, 1, 3, 4)
+            va = (cv[tbl].transpose(0, 2, 1, 3, 4)
                   .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
             if q.shape[1] != hkv_l:
                 rep = q.shape[1] // hkv_l
@@ -371,7 +381,8 @@ def verify_step_ragged(params: PyTree, cache: PyTree, tokens: jax.Array,
                        pos: jax.Array, write_pos: jax.Array, *,
                        cfg: tfm.TransformerConfig, dtype=None,
                        tp_axis: str | None = None,
-                       page_table: jax.Array | None = None):
+                       page_table: jax.Array | None = None,
+                       k_len: int | None = None):
     """MULTI-token ragged forward: (B, W) tokens at per-sequence
     positions ``pos`` (B, W) -> ((B, W, vocab) logits, cache) — the
     verification primitive of in-batcher speculative decoding
@@ -386,10 +397,14 @@ def verify_step_ragged(params: PyTree, cache: PyTree, tokens: jax.Array,
     them: the same free-rewind property ``generate_speculative``
     documents).  Attention runs the bias path with exact per-row
     ``slot <= pos`` bounds; a paged pool is gathered into its
-    contiguous per-sequence view for the read."""
+    contiguous per-sequence view for the read, bounded to the first
+    ``ceil(k_len / page)`` table columns when the caller passes a
+    (static) ``k_len`` live-depth hint — every live row's positions must
+    stay below it (the serve batcher derives it from the deepest
+    allocated frontier, so this holds by construction)."""
     return _forward_cached(
         params, cache, tokens, pos, write_pos, cfg=cfg, dtype=dtype,
-        tp_axis=tp_axis, page_table=page_table)
+        tp_axis=tp_axis, page_table=page_table, k_len=k_len)
 
 
 def lookup_proposals(stream: jax.Array, last_i: jax.Array, n_spec: int,
@@ -476,10 +491,11 @@ def filter_per_seq(logits, temperature, top_k, top_p):
     """PER-ROW ``_filter_logits``: temperature-scale + top-k/top-p mask
     with (B,)-vector parameters — the warp behind ``sample_per_seq``,
     exposed for callers that need each row's exact warped distribution
-    (not just a draw from it).  ``temperature`` <= 0 rows are
-    scaled by 1e-6 (the caller overrides them with argmax); ``top_k`` 0
-    and ``top_p`` >= 1 disable their filters.  Threshold ties keep all
-    tied tokens, matching ``_filter_logits``."""
+    (not just a draw from it).  ``temperature`` <= 0 rows are divided
+    by 1e-6, i.e. sharpened toward argmax (the caller overrides them
+    with an exact argmax anyway); ``top_k`` 0 and ``top_p`` >= 1
+    disable their filters.  Threshold ties keep all tied tokens,
+    matching ``_filter_logits``."""
     v = logits.shape[-1]
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     sorted_desc = jnp.sort(scaled, -1)[:, ::-1]
@@ -1036,7 +1052,7 @@ def generate_tp(
     The compiled program is cached per (cfg, mesh, decode shape, specs) —
     repeated sampling calls do not retrace.
     """
-    from jax import shard_map
+    from .utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     _warn_if_expert_choice(cfg)

@@ -49,6 +49,13 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+# Exit code of chaos-harness-injected crashes.  Kept in sync with
+# utils/faults.FAULT_EXIT_CODE rather than imported: faults.py imports
+# jax, and the agent process must stay jax-free (it supervises workers;
+# it must never compete with them for chips or import time).  Pinned by
+# tests/test_faults.py::test_fault_exit_code_constants_agree.
+FAULT_EXIT_CODE = 77
+
 DEFAULT_PORT = 6585  # reference start_ddp.sh:1 / main_all_reduce.py:96
 TERM_GRACE_S = 10.0
 BARRIER_TIMEOUT_S = 600.0   # max skew between agents reaching a generation
@@ -191,12 +198,26 @@ class WorkerSpec:
 
 @dataclass
 class GangResult:
-    """Outcome of one gang attempt."""
+    """Outcome of one gang attempt.
+
+    ``injected_failures`` counts worker deaths the agent CLASSIFIED as
+    fault-injected (exit code ``faults.FAULT_EXIT_CODE`` — the chaos
+    harness's distinctive code, utils/faults.py) across all generations;
+    they feed the same ``--max-restarts`` budget as genuine failures
+    (an injected crash must exercise the REAL restart path), but the
+    classification separates "the chaos test fired" from "production
+    fell over" in logs and results."""
 
     returncode: int
     failed_rank: int | None = None
     restarts_used: int = 0
     per_rank: dict[int, int] = field(default_factory=dict)
+    injected_failures: int = 0
+
+    @property
+    def injected(self) -> bool:
+        """The FINAL failure (if any) was a classified injected fault."""
+        return self.returncode == FAULT_EXIT_CODE
 
 
 class LocalAgent:
@@ -296,14 +317,17 @@ class LocalAgent:
                 if code is None:
                     running = True
                 elif code != 0:
+                    kind = ("injected fault" if code == FAULT_EXIT_CODE
+                            else "failure")
                     self.log(f"[launch] rank {rank} FAILED with exit code "
-                             f"{code}; terminating gang")
+                             f"{code} ({kind}); terminating gang")
                     self._terminate_all()
                     return GangResult(
                         returncode=code,
                         failed_rank=rank,
                         per_rank={r: q.returncode
                                   for r, q in self._procs.items()},
+                        injected_failures=int(code == FAULT_EXIT_CODE),
                     )
             if not running:
                 return GangResult(
@@ -372,6 +396,7 @@ class LocalAgent:
 
     def _run_local(self) -> GangResult:
         attempt = 0
+        injected = 0
         while True:
             self._gen = attempt
             self._procs = {}
@@ -383,6 +408,8 @@ class LocalAgent:
                 # crash: never leave workers orphaned on the chips.
                 self._terminate_all()
                 raise
+            injected += result.injected_failures
+            result.injected_failures = injected
             result.restarts_used = attempt
             if result.returncode == 0 or attempt >= self.max_restarts:
                 return result
@@ -402,6 +429,7 @@ class LocalAgent:
                  if self.node_rank == 0 else None)
         try:
             gen = 0
+            injected = 0
             last: GangResult | None = None
             while True:
                 self._gen = gen
@@ -419,6 +447,8 @@ class LocalAgent:
                 except BaseException:
                     self._terminate_all()
                     raise
+                injected += result.injected_failures
+                result.injected_failures = injected
                 result.restarts_used = gen
                 if result.returncode == 0:
                     # No further generations for laggards — but running

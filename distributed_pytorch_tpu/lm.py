@@ -32,10 +32,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .models import transformer as tfm
+from .utils import faults
+from .utils import compat
+from .utils.compat import shard_map
 from .ops.nn import IGNORE_INDEX, masked_ce
 from .parallel import context as ctx
 from .parallel.mesh import make_mesh
@@ -146,6 +148,16 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
         if cfg.pp > 1:
             raise ValueError("dcn_size does not compose with pp (the "
                              "pipeline mesh has no factored data axis)")
+    if cfg.fsdp and cfg.dp // max(cfg.dcn_size, 1) == 1:
+        # param_specs shards ZeRO-3 leaves over the INNER 'data' axis
+        # (slice-local); at inner size 1 there is nothing to shard and
+        # the user's fsdp=True would silently buy fully replicated
+        # params/optimizer state (ADVICE r5 #3) — refuse instead
+        raise ValueError(
+            f"fsdp=True with dp={cfg.dp}, dcn_size={cfg.dcn_size} is a "
+            f"no-op: the slice-local data axis has size "
+            f"dp // dcn_size = 1, so no leaf can shard over it — raise "
+            f"dp (or drop fsdp)")
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -502,9 +514,14 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
 
 
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
-    """Compiled step: (params, opt_state, tokens, targets) ->
-    (params, opt_state, loss).  tokens/targets are (global_batch, global_seq)
-    int32, sharded (data+expert, seq).  With ``cfg.grad_accum = A > 1``
+    """Compiled step: (params, opt_state, tokens, targets[, step_no]) ->
+    (params, opt_state, loss, ok).  tokens/targets are (global_batch,
+    global_seq) int32, sharded (data+expert, seq).  ``ok`` is the
+    per-step health flag (1.0 = loss and synced grads finite — one
+    sum-of-squares pass, the training sentry's in-scan detection
+    signal); ``step_no`` (default 0) only matters to the chaos-harness
+    taps, which trace to nothing without an installed FaultPlan.
+    With ``cfg.grad_accum = A > 1``
     the batch is split into A microbatches scanned with gradient
     accumulation and ONE optimizer update — peak activation memory drops
     by ~A at the cost of A sequential forward/backward passes.  The CE
@@ -523,8 +540,9 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
                   if a > 1 and cfg.dcn_size > 1 else None)
     coef = jnp.float32(cfg.aux_coef)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens, targets):
+    @partial(jax.jit, donate_argnums=compat.donate(0, 1))
+    def step(params, opt_state, tokens, targets, step_no=0,
+             fault_arm=0.0):
         tokens = _zigzag_global(cfg, tokens)
         targets = _zigzag_global(cfg, targets)
         n_total = jnp.sum(targets != IGNORE).astype(jnp.float32)
@@ -560,9 +578,15 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 (loss, grads), _ = jax.lax.scan(
                     body, (jnp.float32(0), zeros), (micro_t, micro_y))
+        # chaos taps (trace-time no-ops unplanned) + sentry health flag
+        grads = faults.tap_grads(grads, step_no, fault_arm)
+        loss = faults.tap_loss(loss, step_no, fault_arm)
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree.leaves(grads))
+        ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, loss, ok
 
     return step
 
@@ -620,16 +644,22 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         out_specs=(P(), (stage_specs, shared_specs)),
     )
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens, targets):
+    @partial(jax.jit, donate_argnums=compat.donate(0, 1))
+    def step(params, opt_state, tokens, targets, step_no=0,
+             fault_arm=0.0):
         tokens = _zigzag_global(cfg, tokens)
         targets = _zigzag_global(cfg, targets)
         loss, grads = grad_step(params["stages"], params["shared"],
                                 tokens, targets)
         grads = {"stages": grads[0], "shared": grads[1]}
+        grads = faults.tap_grads(grads, step_no, fault_arm)
+        loss = faults.tap_loss(loss, step_no, fault_arm)
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree.leaves(grads))
+        ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, loss, ok
 
     return step
 
@@ -670,14 +700,16 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
 def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
     """Compiled K-step training loop for the (data, expert, seq, model)
     layout: ``(params, opt_state, tokens, targets) -> (params, opt_state,
-    losses)`` with tokens/targets carrying a leading scan axis of length K
-    — ONE dispatch executes K optimizer steps.  Shares ``_make_grad_step``
-    with the single-step path, so loss semantics cannot drift; see
-    LMTrainer.train_steps for when the scan actually helps (measured)."""
+    losses, oks)`` with tokens/targets carrying a leading scan axis of
+    length K — ONE dispatch executes K optimizer steps (``oks``: per-step
+    health flags, as in ``make_lm_train_step``).  Shares
+    ``_make_grad_step`` with the single-step path, so loss semantics
+    cannot drift; see LMTrainer.train_steps for when the scan actually
+    helps (measured)."""
     tx = make_optimizer(cfg)
     grad_step = _make_grad_step(cfg, mesh)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    @partial(jax.jit, donate_argnums=compat.donate(0, 1))
     def steps(params, opt_state, tokens, targets):
         tokens = jax.vmap(partial(_zigzag_global, cfg))(tokens)
         targets = jax.vmap(partial(_zigzag_global, cfg))(targets)
@@ -688,13 +720,17 @@ def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
             n_total = jnp.sum(tg != IGNORE).astype(jnp.float32)
             loss, grads = grad_step(params, tk, tg, n_total,
                                     jnp.float32(cfg.aux_coef))
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree.leaves(grads))
+            ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(
+                jnp.float32)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), loss
+            return (params, opt_state), (loss, ok)
 
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), (losses, oks) = jax.lax.scan(
             body, (params, opt_state), (tokens, targets))
-        return params, opt_state, losses
+        return params, opt_state, losses, oks
 
     return steps
 
@@ -813,9 +849,24 @@ class LMTrainer:
         self._eval_fn = None
         self._multi_fn = None
         self._step = 0
+        self.last_ok = None     # health flag(s) of the last dispatch
         self._ckptr = None
         self._ckptr_key = None
         self.restored_meta: dict = {}
+
+    def tighten_grad_clip(self, factor: float = 0.5) -> float:
+        """Multiply the gradient-clip norm by ``factor`` and rebuild the
+        compiled step — the training sentry's mid-ladder escalation
+        (utils/sentry.py: skip window -> tighten clip -> abort).  The
+        optimizer chain's clip transform is stateless, so the live
+        opt_state carries over unchanged; the recompile is a fault-path
+        cost, not a hot-path one.  Returns the new clip norm."""
+        self.cfg.grad_clip *= factor
+        self.step_fn = (make_lm_pp_train_step(self.cfg, self.mesh)
+                        if self.cfg.pp > 1
+                        else make_lm_train_step(self.cfg, self.mesh))
+        self._multi_fn = None
+        return self.cfg.grad_clip
 
     def evaluate(self, batches) -> dict[str, float]:
         """Held-out loss/perplexity over an iterable of (tokens, targets).
@@ -906,6 +957,7 @@ class LMTrainer:
         return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
+        faults.maybe_delay(self._step)  # chaos: straggler (no-op unplanned)
         shd = NamedSharding(self.mesh, self._batch_spec)
         if jax.process_count() > 1:
             tokens = jax.make_array_from_process_local_data(shd, tokens)
@@ -913,9 +965,18 @@ class LMTrainer:
         else:
             tokens = jax.device_put(tokens, shd)
             targets = jax.device_put(targets, shd)
-        self.params, self.opt_state, loss = self.step_fn(
-            self.params, self.opt_state, tokens, targets)
+        # (step_no, fault_arm) feed only the chaos taps — passed solely
+        # when a plan is installed, so the clean path's compiled
+        # signature (and any cached executable) is byte-identical to
+        # pre-sentry builds; arm_window gives step-keyed faults their
+        # one-shot semantics across sentry rollbacks
+        extra = ((jnp.int32(self._step),
+                  jnp.float32(faults.arm_window(self._step)))
+                 if faults.step_plan() is not None else ())
+        self.params, self.opt_state, loss, self.last_ok = self.step_fn(
+            self.params, self.opt_state, tokens, targets, *extra)
         self._step += 1
+        faults.maybe_crash(self._step)  # chaos: injected process death
         return loss
 
     def train_steps(self, tokens: np.ndarray, targets: np.ndarray):
@@ -949,7 +1010,8 @@ class LMTrainer:
         else:
             tokens = jax.device_put(tokens, shd)
             targets = jax.device_put(targets, shd)
-        self.params, self.opt_state, losses = self._multi_fn(
+        self.params, self.opt_state, losses, self.last_ok = self._multi_fn(
             self.params, self.opt_state, tokens, targets)
         self._step += tokens.shape[0]
+        faults.maybe_crash(self._step, tokens.shape[0])
         return losses

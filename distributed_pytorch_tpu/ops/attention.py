@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import compat
+
 Array = jax.Array
 
 # Defaults from block-size sweeps on v5e (fwd+bwd at S=1024..8192, plus
@@ -153,10 +155,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _vma(*arrays):
     """Union of the inputs' varying mesh axes: pallas_call outputs must
-    declare their vma explicitly under shard_map(check_vma=True)."""
+    declare their vma explicitly under shard_map(check_vma=True).  On
+    runtimes without vma tracking this is always empty (compat.vma_of)
+    and the out_shapes below drop the kwarg."""
     out = frozenset()
     for a in arrays:
-        out |= jax.typeof(a).vma
+        out |= compat.vma_of(a)
     return out
 
 
@@ -181,8 +185,8 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32, vma=vma),
+            compat.shape_struct((bh, sq, d), q.dtype, vma=vma),
+            compat.shape_struct((bh, 8, sq), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
@@ -321,7 +325,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, do,
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+        out_shape=compat.shape_struct((bh, sq, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -343,8 +347,8 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, do,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype, vma=vma),
+            compat.shape_struct((bh, sk, d), k.dtype, vma=vma),
+            compat.shape_struct((bh, sk, d), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -555,7 +559,7 @@ def decode_attention(
                 pltpu.VMEM((h, 128), jnp.float32),    # running sum l
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype, vma=vma),
+        out_shape=compat.shape_struct((b, h, d), q.dtype, vma=vma),
         interpret=interpret,
     )(pos_arr, qf, k_cache, v_cache)
     return o.reshape(b, h, 1, d)
@@ -714,7 +718,7 @@ def decode_attention_paged(
                 pltpu.VMEM((h, 128), jnp.float32),    # running sum l
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype, vma=vma),
+        out_shape=compat.shape_struct((b, h, d), q.dtype, vma=vma),
         interpret=interpret,
     )(pos_arr, table, qf, k_pool, v_pool)
     return o.reshape(b, h, 1, d)

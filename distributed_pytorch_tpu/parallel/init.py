@@ -18,22 +18,45 @@ configure.
 
 Failure-detection upgrade over the reference: the reference passes
 ``timeout=None`` so a missing peer hangs forever (SURVEY.md section 2.3).
-Here rendezvous has a real default timeout and raises a diagnosable
-``RendezvousError`` naming the coordinator it could not reach.
+Here rendezvous has a real default timeout, retries transient connection
+failures with EXPONENTIAL BACKOFF + seeded JITTER (a flapping/slow-to-come-up
+coordinator costs seconds, not the run; the jitter decorrelates a pod's worth
+of ranks re-dialing at once), and raises a diagnosable ``RendezvousError``
+naming the coordinator it could not reach and how many attempts were made.
+The chaos harness (utils/faults.py ``rendezvous`` plan) injects refused
+connections into exactly this path, so the backoff is tested, not assumed.
 """
 
 from __future__ import annotations
 
 import os
+import time
 
 import jax
+import numpy as np
+
+from ..utils import faults
 
 DEFAULT_PORT = 6585  # the reference's hard-coded port (main_all_reduce.py:96)
 DEFAULT_TIMEOUT_S = 300
+CONNECT_ATTEMPTS = 5     # rendezvous dials before giving up
+BACKOFF_BASE_S = 1.0     # first retry delay (doubles per attempt)
+BACKOFF_CAP_S = 30.0     # ceiling on any single delay
 
 
 class RendezvousError(RuntimeError):
     """Multi-host initialization failed (peer missing / coordinator down)."""
+
+
+def _backoff_delay(attempt: int, rank: int, *, base_s: float,
+                   cap_s: float = BACKOFF_CAP_S) -> float:
+    """Exponential backoff with deterministic per-(rank, attempt) jitter
+    in [0.5x, 1.5x): reproducible (seeded — the chaos tests pin it) yet
+    decorrelated across ranks, so a gang re-dialing a flapped
+    coordinator does not arrive as one thundering herd."""
+    delay = min(base_s * (2.0 ** attempt), cap_s)
+    jitter = np.random.default_rng(7919 * rank + attempt).random()
+    return delay * (0.5 + jitter)
 
 
 def init_distributed(
@@ -43,28 +66,60 @@ def init_distributed(
     *,
     port: int = DEFAULT_PORT,
     timeout_s: int | None = DEFAULT_TIMEOUT_S,
+    connect_attempts: int = CONNECT_ATTEMPTS,
+    backoff_base_s: float = BACKOFF_BASE_S,
+    _initialize=None,
 ) -> None:
     """Explicit-rendezvous mode (reference main_all_reduce.py:96 contract).
 
     No-op for ``num_nodes == 1`` (single-controller JAX needs no init), so the
     same entry point serves the single-process baseline (reference main.py).
-    """
+
+    Transient connection failures retry up to ``connect_attempts`` times
+    with exponential backoff + jitter; ``_initialize`` is a test seam
+    (defaults to ``jax.distributed.initialize``)."""
     if num_nodes <= 1:
         return
     if master_ip is None:
         raise ValueError("--master-ip is required when --num-nodes > 1")
     coordinator = f"{master_ip}:{port}"
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_nodes,
-            process_id=rank,
-            initialization_timeout=timeout_s if timeout_s else 86_400,
-        )
-    except Exception as e:
-        raise RendezvousError(
-            f"rendezvous with coordinator {coordinator} failed for rank "
-            f"{rank}/{num_nodes} after {timeout_s}s: {e}") from e
+    initialize = _initialize if _initialize is not None else (
+        jax.distributed.initialize)
+    # ``timeout_s`` stays the TOTAL failure-detection budget (the old
+    # single-attempt contract): retries split whatever remains of it, so
+    # a genuinely-down coordinator is diagnosed in ~timeout_s + backoff,
+    # not attempts x timeout_s.  Deterministic errors (double init, bad
+    # world size) fail each dial fast and cost only the backoff sleeps.
+    total_s = timeout_s if timeout_s else 86_400
+    deadline = time.monotonic() + total_s
+    last: Exception | None = None
+    attempts = max(connect_attempts, 1)
+    for attempt in range(attempts):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 and attempt > 0:
+            break
+        try:
+            faults.maybe_refuse_rendezvous()  # chaos: injected flap
+            initialize(
+                coordinator_address=coordinator,
+                num_processes=num_nodes,
+                process_id=rank,
+                initialization_timeout=max(int(remaining), 1),
+            )
+            return
+        except Exception as e:
+            last = e
+            if attempt + 1 >= attempts:
+                break
+            delay = _backoff_delay(attempt, rank, base_s=backoff_base_s)
+            print(f"[rendezvous] rank {rank}: attempt {attempt + 1}/"
+                  f"{attempts} to {coordinator} failed ({e}); "
+                  f"retrying in {delay:.2f}s", flush=True)
+            time.sleep(delay)
+    raise RendezvousError(
+        f"rendezvous with coordinator {coordinator} failed for rank "
+        f"{rank}/{num_nodes} after {attempts} attempts "
+        f"within the {total_s}s budget: {last}") from last
 
 
 def init_from_env(*, timeout_s: int | None = DEFAULT_TIMEOUT_S) -> None:

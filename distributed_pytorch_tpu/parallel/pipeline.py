@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models import transformer as tfm
+from ..utils.compat import pcast, vma_of
 
 PyTree = Any
 
@@ -167,10 +168,9 @@ def _chunk(chunk_layers: PyTree, x: jax.Array,
 
     # aux carry starts with x's vma so the scan carry types are stable
     aux0 = jnp.zeros((), jnp.float32)
-    missing = tuple(a for a in jax.typeof(x).vma
-                    if a not in jax.typeof(aux0).vma)
+    missing = tuple(a for a in vma_of(x) if a not in vma_of(aux0))
     if missing:
-        aux0 = lax.pcast(aux0, missing, to="varying")
+        aux0 = pcast(aux0, missing, to="varying")
     (x, aux), _ = lax.scan(body, (x, aux0), chunk_layers)
     return x, aux
 
@@ -242,11 +242,11 @@ def pipeline_loss(
     # Scan carries must be varying over every axis their updates vary over:
     # the pipe axis (stage params) plus whatever the inputs carry (e.g. a
     # 'data' axis when composed with DP).
-    want_vma = jax.typeof(x_all).vma | {axis}
+    want_vma = vma_of(x_all) | {axis}
 
     def _varying(x):
-        missing = tuple(a for a in want_vma if a not in jax.typeof(x).vma)
-        return lax.pcast(x, missing, to="varying") if missing else x
+        missing = tuple(a for a in want_vma if a not in vma_of(x))
+        return pcast(x, missing, to="varying") if missing else x
 
     zero_x = _varying(jnp.zeros((mb, s, x_all.shape[-1]), x_all.dtype))
 

@@ -39,6 +39,9 @@ from typing import Any, Callable, Protocol
 import jax
 import jax.numpy as jnp
 from jax import lax
+# load the runtime-compat shims (axis_size/pcast polyfills on
+# legacy jax) before anything in this module traces
+from ..utils import compat as _compat  # noqa: F401
 
 try:  # provable varying->invariant gather (jax 0.9: not yet re-exported)
     from jax._src.lax.parallel import all_gather_invariant as _all_gather_inv

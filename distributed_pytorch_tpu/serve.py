@@ -102,6 +102,7 @@ import numpy as np
 
 from .models import transformer as tfm
 from . import generate as gen
+from .utils import compat
 
 
 # submit() sentinel: "inherit the batcher default" — distinct from None,
@@ -126,6 +127,11 @@ class _Request:
     # chain hashes of the prompt's full pages, computed ONCE at submit
     # when prefix caching is on (lookups run per scheduling decision)
     prefix_hashes: list | None = None
+    # set once this request's full prompt pages were offered to the
+    # registry — keeps the per-block publish hook O(1) for slots whose
+    # prompt already published (batch admission, shared admission, or an
+    # earlier block)
+    pages_published: bool = False
     # latency bookkeeping (host clock; token times land at block syncs,
     # which is when the serving layer can actually hand tokens out)
     t_submit: float = 0.0
@@ -560,7 +566,7 @@ class ContinuousBatcher:
             if self.mesh is None:
                 fn = jax.jit(prefill_body)
             else:
-                from jax import shard_map
+                from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 # spec trees carry no shapes: the pool's spec tree fits
                 # the (1, hkv, bucket, d) prefill slabs too
@@ -708,20 +714,20 @@ class ContinuousBatcher:
                 return packed, c["cache"]
 
             if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=(1,))
+                fn = jax.jit(block_body, donate_argnums=compat.donate(1))
             else:
-                from jax import shard_map
+                from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
-                    donate_argnums=(1,))
+                    donate_argnums=compat.donate(1))
             self._decode_fns[n_slots] = fn
         return self._decode_fns[n_slots]
 
-    def _decode_spec_for(self, n_slots: int):
+    def _decode_spec_for(self, n_slots: int, gather_cols: int = 0):
         """SPECULATIVE decode block: ``(params, cache, cur, ref, key) ->
         (packed int32 vector, cache)`` — a device-side ``while_loop`` of
         up to ``steps_per_sync`` speculation ROUNDS.  Each round, every
@@ -750,8 +756,21 @@ class ContinuousBatcher:
         later window entries are proposals.  Writes clamp at ``cap``
         (done slots scribble on their frontier row, never on pages/rows
         they do not own); retirement hands off in place to the staged
-        refill exactly as in the lockstep block."""
-        if self._spec_fns.get(n_slots) is None:
+        refill exactly as in the lockstep block.
+
+        ``gather_cols`` (paged): the deepest allocated page frontier
+        across this dispatch's rows, rounded up to a power of two by the
+        caller — the verify forward's pool gather reads only that many
+        table columns per layer per ROUND (a static ``k_len`` hint into
+        ``gen.verify_step_ragged``) instead of the whole
+        ``pages_per_slot`` logical range, so short sequences stop
+        paying O(max_len) HBM traffic per round (ADVICE r5 #2).  Sound
+        because every row's window positions stay below its allocated
+        frontier (the host sizes allocations to the block's worst-case
+        writes, verify tail included, before dispatch); one compiled
+        block per (width, depth-bucket)."""
+        key_ = (n_slots, gather_cols)
+        if self._spec_fns.get(key_) is None:
             cfg, dtype = self.cfg, self.dtype
             r_max = self.steps_per_sync
             n_spec, ngram = self.n_spec, self.spec_ngram
@@ -761,6 +780,8 @@ class ContinuousBatcher:
             vocab = cfg.vocab_size
             tp = self.tp_axis if self.mesh is not None else None
             paged = self.paged
+            k_hint = (gather_cols * self.page
+                      if (paged and gather_cols) else None)
             rows = np.arange(n_slots)
 
             def block_body(params, cache, cur, ref, key):
@@ -814,7 +835,8 @@ class ContinuousBatcher:
                     wpos = jnp.minimum(idx, cap_eff[:, None])
                     logits, new_cache = gen.verify_step_ragged(
                         params, c["cache"], inp, idx, wpos, cfg=cfg,
-                        dtype=dtype, tp_axis=tp, page_table=table_eff)
+                        dtype=dtype, tp_axis=tp, page_table=table_eff,
+                        k_len=k_hint)
 
                     # 3. accept: greedy match or point-mass rejection
                     g = jnp.argmax(logits, -1).astype(jnp.int32)
@@ -931,18 +953,18 @@ class ContinuousBatcher:
                 return packed, c["cache"]
 
             if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=(1,))
+                fn = jax.jit(block_body, donate_argnums=compat.donate(1))
             else:
-                from jax import shard_map
+                from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
-                    donate_argnums=(1,))
-            self._spec_fns[n_slots] = fn
-        return self._spec_fns[n_slots]
+                    donate_argnums=compat.donate(1))
+            self._spec_fns[key_] = fn
+        return self._spec_fns[key_]
 
     def _prefill_chunk_fn(self, bucket: int, first: bool):
         """One prompt chunk written at cache offset ``off``, attending
@@ -978,11 +1000,11 @@ class ContinuousBatcher:
                 donate = ()
             else:
                 chunk_body = run_chunk
-                donate = (1,)
+                donate = compat.donate(1)
             if self.mesh is None:
                 fn = jax.jit(chunk_body, donate_argnums=donate)
             else:
-                from jax import shard_map
+                from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 in_specs = ((self._param_specs, P(), P()) if first else
                             (self._param_specs, self._cache_spec,
@@ -1098,6 +1120,35 @@ class ContinuousBatcher:
             self.registry[h] = pid
             self.page_hash[pid] = h
             self.page_refs[pid] = 1
+        req.pages_published = True
+
+    def _maybe_publish_prompt_pages(self, slot: int,
+                                    req: _Request | None = None, *,
+                                    prompt_done: bool | None = None
+                                    ) -> None:
+        """Publish hook for prompts prefilled INSIDE the decode block
+        (teacher-forced in-block admissions and retire->refill handoffs
+        — paths that never pass through ``_fill_free_slots``'s
+        registration, ADVICE r5 #1).  Safe once the prompt is fully
+        written: in-block writes are contiguous from position 0, garbage
+        verify-tail writes land at positions >= the determined frontier
+        (>= prompt length), and write clamps land on the LAST allocated
+        row, which allocation always places beyond the full prompt pages
+        — so a completed prompt's full pages hold exactly the K/V a
+        batched prefill would have produced.  ``prompt_done=True``
+        (retirement: an emission implies the prompt was consumed) skips
+        the host-progress check, which lags the device mid-parse."""
+        if not self.prefix_cache:
+            return
+        req = req if req is not None else self.occupant[slot]
+        if req is None or req.pages_published or not req.prefix_hashes:
+            return
+        if prompt_done is None:
+            prompt_done = self.slot_poff[slot] >= len(req.prompt)
+        if (not prompt_done
+                or len(self.slot_pages[slot]) < len(req.prefix_hashes)):
+            return
+        self._register_prompt_pages(slot, req)
 
     def _suffix_prefill(self, sbucket: int):
         """Compiled suffix prefill for shared-prefix admissions: a
@@ -1123,16 +1174,16 @@ class ContinuousBatcher:
                 return logits[0, uidx], cache
 
             if self.mesh is None:
-                fn = jax.jit(suffix_body, donate_argnums=(1,))
+                fn = jax.jit(suffix_body, donate_argnums=compat.donate(1))
             else:
-                from jax import shard_map
+                from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 fn = jax.jit(shard_map(
                     suffix_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
-                    donate_argnums=(1,))
+                    donate_argnums=compat.donate(1))
             self._suffix_fns[sbucket] = fn
         return fn
 
@@ -1211,7 +1262,7 @@ class ContinuousBatcher:
                 return jnp.stack([leaf[pids[:n]]
                                   for leaf in jax.tree.leaves(cache)])
 
-            @partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+            @partial(jax.jit, donate_argnums=compat.donate(0), static_argnums=(3,))
             def scatter(cache, stacked, pids, n):
                 leaves, td = jax.tree.flatten(cache)
                 out = [leaf.at[pids[:n]].set(stacked[i, :n]
@@ -1318,7 +1369,7 @@ class ContinuousBatcher:
         if self._insert_paged_fn is None:
             page = self.page
 
-            @partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+            @partial(jax.jit, donate_argnums=compat.donate(0), static_argnums=(3,))
             def insert(cache, slabs, pids, n):
                 def write(big, small):
                     for c in range(n):
@@ -1340,7 +1391,7 @@ class ContinuousBatcher:
         (jitted with the pool donated — an in-place slab write, not a
         whole-pool copy per admission)."""
         if self._insert_fn is None:
-            @partial(jax.jit, donate_argnums=(0,))
+            @partial(jax.jit, donate_argnums=compat.donate(0))
             def insert(cache, slabs, slot):
                 return jax.tree.map(
                     lambda big, small: jax.lax.dynamic_update_slice(
@@ -1501,6 +1552,10 @@ class ContinuousBatcher:
             jnp.int32(min(L, self.kv_len - 1)),
             jnp.asarray(self.table[slot:slot + 1]))
         self.stats["prefill_dispatches"] += 1
+        # publish any freshly prefilled full pages BEYOND the shared
+        # chain (a longer prompt extends the cached prefix; the register
+        # skips pages/hashes already in the registry) — ADVICE r5 #1
+        self._register_prompt_pages(slot, req)
         return last_logits
 
     def _advance_admissions(self) -> list[tuple[int, int]]:
@@ -1580,6 +1635,12 @@ class ContinuousBatcher:
             req.t_done = time.perf_counter()
             self.occupant[slot] = None  # slot free; stale K/V never read
             if self.paged:
+                # a prompt that completed and retired inside ONE block
+                # never hit the continuing-slot publish hook — publish
+                # before releasing (an emission proves the prompt was
+                # fully written; the registry IS the cache, refcount 0)
+                self._maybe_publish_prompt_pages(slot, req,
+                                                 prompt_done=True)
                 # the block table row is rewritten at the next admission;
                 # in-flight lockstep writes this dispatch stay within the
                 # old frontier (write_cap), so reuse is race-free
@@ -1894,7 +1955,20 @@ class ContinuousBatcher:
         ref = {k_: jnp.asarray(v) for k_, v in ref.items()}
         self.key, sub = jax.random.split(self.key)
         if self.n_spec:
-            packed, self.cache = self._decode_spec_for(w)(
+            gcols = 0
+            if self.paged:
+                # deepest allocated frontier across the dispatch's rows
+                # (occupants + staged refills), power-of-two-bucketed so
+                # a growing workload compiles O(log pages_per_slot)
+                # block variants, not one per depth
+                deep = max([len(self.slot_pages[s]) for s in live]
+                           + [len(self.refill_pages[s])
+                              for s in range(self.slots)
+                              if self.staged_refill[s] is not None]
+                           + [1])
+                gcols = min(1 << (deep - 1).bit_length(),
+                            self.pages_per_slot)
+            packed, self.cache = self._decode_spec_for(w, gcols)(
                 self.params, self.cache, cur, ref, sub)
             return self._parse_spec_block(packed, live, cols, w, out)
         packed, self.cache = self._decode_for(w)(self.params, self.cache,
@@ -1927,6 +2001,7 @@ class ContinuousBatcher:
                 if plen[s]:
                     self.slot_poff[s] = int(poff_f[j])
                 self.pos[s] = int(lw[j])
+                self._maybe_publish_prompt_pages(s)
             elif int(sw[j]) <= k_exec:
                 # the device switched this slot to its staged refill
                 req = self.staged_refill[s]
@@ -1940,6 +2015,7 @@ class ContinuousBatcher:
                 if self.occupant[s] is not None:
                     self.slot_poff[s] = int(poff_f[j])
                     self.pos[s] = int(lw[j])
+                    self._maybe_publish_prompt_pages(s)
         self._requeue_unused_refills()
         self.stats["wasted_slot_steps"] += (
             k_exec * w
@@ -1956,6 +2032,7 @@ class ContinuousBatcher:
         self.pos[s] = wr - 1
         if occ.emitted:
             self.last_tok[s] = occ.emitted[-1]
+        self._maybe_publish_prompt_pages(s)
 
     def _parse_spec_block(self, packed, live, cols, w: int, out):
         """Unpack a speculative block's results and mirror them on the

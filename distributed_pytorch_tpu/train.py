@@ -30,14 +30,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from .data import augment as aug, pipeline
 from .models import vgg
 from .ops import nn as ops
 from .parallel import strategies as strat
 from .parallel.mesh import DATA_AXIS, make_mesh, replicated
-from .utils import debug as dbg, tracing
+from .utils import compat, debug as dbg, faults, tracing
+from .utils.compat import pcast, shard_map, vma_of
 from .utils.metrics import IterTimeMeter, LossMeter
 
 PyTree = Any
@@ -89,10 +89,10 @@ def _as_varying(tree: PyTree, axis) -> PyTree:
     names = (axis,) if isinstance(axis, str) else tuple(axis)
 
     def cast(x):
-        missing = tuple(a for a in names if a not in jax.typeof(x).vma)
+        missing = tuple(a for a in names if a not in vma_of(x))
         if not missing:
             return x
-        return jax.lax.pcast(x, missing, to="varying")
+        return pcast(x, missing, to="varying")
     return jax.tree.map(cast, tree)
 
 
@@ -133,12 +133,16 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
     The three training-state arguments are DONATED: the step updates them in
     place on device and the caller must use the returned pytrees (passing a
     consumed buffer again raises "Array has been deleted").
+
+    This convenience wrapper never arms the chaos taps (fault_sig=False):
+    its fixed 8-arg signature has no fault_arm slot — use the Trainer (or
+    make_multi_step directly) to drive step-keyed fault injection.
     """
-    multi = make_multi_step(cfg, strategy, mesh)
+    multi = make_multi_step(cfg, strategy, mesh, fault_sig=False)
 
     def step(params, state, opt_state, sync_state, key, step0, images,
              labels):
-        params, state, opt_state, sync_state, losses = multi(
+        params, state, opt_state, sync_state, losses, oks = multi(
             params, state, opt_state, sync_state, key, step0,
             images[None], labels[None])
         return params, state, opt_state, sync_state, losses[0]
@@ -147,13 +151,17 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
 
 
 def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
-                    mesh: Mesh | None):
+                    mesh: Mesh | None, fault_sig: bool | None = None):
     """Build a compiled K-step training loop (``lax.scan`` over stacked
     batches): ONE dispatch executes K optimizer steps on device.
 
     Signature: ``fn(params, state, opt_state, key, step0, images, labels) ->
-    (params, state, opt_state, losses)`` with ``images``/``labels`` carrying
-    a leading scan axis of length K and ``losses`` shape (K,).
+    (params, state, opt_state, losses, oks)`` with ``images``/``labels``
+    carrying a leading scan axis of length K, ``losses`` shape (K,), and
+    ``oks`` (K,) f32 per-step health flags (1.0 = loss AND synced grads
+    finite) — the in-scan detection signal of the training sentry
+    (utils/sentry.py), one sum-of-squares pass over the gradient tree,
+    negligible next to the backward.
 
     This is the TPU-native answer to per-step dispatch overhead: the
     reference's hot loop makes one eager dispatch per op (SURVEY.md 3.1);
@@ -174,8 +182,16 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     grad_fn = jax.value_and_grad(
         partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
 
+    # Chaos-harness plumbing: with an installed STEP-KEYED FaultPlan
+    # (nan/inf grad, loss spike) the compiled step gains ONE trailing f32
+    # arg (the host's arm_window gate for the in-jit taps); the clean
+    # path's signature stays byte-identical.  The Trainer passes its
+    # build-time decision so caller and program can never disagree.
+    if fault_sig is None:
+        fault_sig = faults.step_plan() is not None
+
     def scan_steps(params, state, opt_state, sync_state, key, step0,
-                   images, labels, *, axis: str | None):
+                   images, labels, fault_arm=0.0, *, axis: str | None):
         def body(carry, batch):
             params, state, opt_state, sync_state, step = carry
             imgs, lbls = batch
@@ -189,6 +205,11 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
             else:
                 local_params = params
             (loss, state), grads = grad_fn(local_params, state, k, imgs, lbls)
+            # chaos-harness taps: trace-time no-ops unless a FaultPlan is
+            # installed (utils/faults.py) — pre-sync, so an injected bad
+            # shard propagates through the collective like a real one
+            grads = faults.tap_grads(grads, step, fault_arm)
+            loss = faults.tap_loss(loss, step, fault_arm)
             if bcast_buffers and axis is not None:
                 # torch DDP broadcast_buffers: BN running stats follow rank
                 # 0 (buffers broadcast from rank 0 every forward — reference
@@ -208,50 +229,82 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                 grads, sync_state = strategy(grads, axis, sync_state)
             else:
                 grads = strategy(grads, axis)
+            # per-step health flag (sentry): finite loss + finite synced
+            # grads, via one global sum-of-squares over the tree
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree.leaves(grads))
+            ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(
+                jnp.float32)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, state, opt_state, sync_state, step + 1), loss
+            return (params, state, opt_state, sync_state, step + 1), (loss,
+                                                                      ok)
 
-        (params, state, opt_state, sync_state, _), losses = jax.lax.scan(
-            body, (params, state, opt_state, sync_state, step0),
-            (images, labels))
-        return params, state, opt_state, sync_state, losses
+        (params, state, opt_state, sync_state, _), (losses, oks) = (
+            jax.lax.scan(
+                body, (params, state, opt_state, sync_state, step0),
+                (images, labels)))
+        return params, state, opt_state, sync_state, losses, oks
 
     if mesh is None:
         if strategy.needs_mesh:
             raise ValueError(f"strategy {strategy.name!r} requires a mesh")
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def multi_step(params, state, opt_state, sync_state, key, step0,
-                       images, labels):
-            return scan_steps(params, state, opt_state, sync_state, key,
-                              step0, images, labels, axis=None)
+        if fault_sig:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+            def multi_step(params, state, opt_state, sync_state, key,
+                           step0, images, labels, fault_arm):
+                return scan_steps(params, state, opt_state, sync_state,
+                                  key, step0, images, labels, fault_arm,
+                                  axis=None)
+        else:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+            def multi_step(params, state, opt_state, sync_state, key,
+                           step0, images, labels):
+                return scan_steps(params, state, opt_state, sync_state,
+                                  key, step0, images, labels, axis=None)
 
         return multi_step
 
-    def shard_multi_step(params, state, opt_state, sync_state, key, step0,
-                         images, labels):
+    def run_shard(params, state, opt_state, sync_state, key, step0,
+                  images, labels, fault_arm):
         local_state = jax.tree.map(lambda s: s[0], state)
         local_sync = jax.tree.map(lambda s: s[0], sync_state)
-        params, new_state, opt_state, new_sync, losses = scan_steps(
+        params, new_state, opt_state, new_sync, losses, oks = scan_steps(
             params, local_state, opt_state, local_sync, key, step0,
-            images, labels, axis=data_axes)
+            images, labels, fault_arm, axis=data_axes)
         new_state = jax.tree.map(lambda s: s[None], new_state)
         new_sync = jax.tree.map(lambda s: s[None], new_sync)
+        # oks pmean: 1.0 iff EVERY replica's step was healthy (a poisoned
+        # shard pulls the mean below 1 even before its sync spreads it)
         return (params, new_state, opt_state, new_sync,
-                jax.lax.pmean(losses, data_axes))
+                jax.lax.pmean(losses, data_axes),
+                jax.lax.pmean(oks, data_axes))
+
+    if fault_sig:
+        def shard_multi_step(params, state, opt_state, sync_state, key,
+                             step0, images, labels, fault_arm):
+            return run_shard(params, state, opt_state, sync_state, key,
+                             step0, images, labels, fault_arm)
+        extra_specs: tuple = (P(),)
+    else:
+        def shard_multi_step(params, state, opt_state, sync_state, key,
+                             step0, images, labels):
+            return run_shard(params, state, opt_state, sync_state, key,
+                             step0, images, labels, 0.0)
+        extra_specs = ()
 
     return jax.jit(shard_map(
         shard_multi_step,
         mesh=mesh,
         in_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(),
-                  P(None, data_axes), P(None, data_axes)),
-        out_specs=(P(), P(data_axes), P(), P(data_axes), P()),
+                  P(None, data_axes), P(None, data_axes)) + extra_specs,
+        out_specs=(P(), P(data_axes), P(), P(data_axes), P(), P()),
         # Ring-collective strategies assemble their result from ppermute
         # hops: bitwise replicated by construction, but not provably so to
         # the vma checker (no sanctioned varying->invariant downcast).
         check_vma=not getattr(strategy, "vma_opaque", False),
-    ), donate_argnums=(0, 1, 2, 3))
+    ), donate_argnums=compat.donate(0, 1, 2, 3))
 
 
 def replicate_state(state: PyTree, n: int) -> PyTree:
@@ -345,6 +398,11 @@ class Trainer:
         self._multi_fn = None   # jitted K-step program, built lazily
         self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
+        self.last_ok = None     # (K,) health flags of the last dispatch
+        # snapshot the chaos-tap signature decision NOW: the AOT
+        # executables are cached, so a plan installed mid-run must not
+        # change the compiled arg list (install plans before building)
+        self._fault_sig = faults.step_plan() is not None
         # vma-opaque strategies (ppermute-assembled results) compile with
         # check_vma=False — the static replication proof is off, so EVERY
         # freshly compiled executable (first step, and any later
@@ -400,13 +458,21 @@ class Trainer:
         callers (train_epoch) can keep compile time out of timed windows —
         the reference's iter-0 exclusion contract (main.py:43-48) would
         otherwise be diluted to 1/K by the scan."""
-        key = (args[-2].shape, args[-1].shape)
+        key = (args[6].shape, args[7].shape)  # (images, labels)
         exe = self._compiled.get(key)
         if exe is None:
             if self._multi_fn is None:
                 self._multi_fn = make_multi_step(self.cfg, self.strategy,
-                                                 self.mesh)
-            exe = self._multi_fn.lower(*args).compile()
+                                                 self.mesh,
+                                                 fault_sig=self._fault_sig)
+            if compat.AOT_EXECUTION_SAFE:
+                exe = self._multi_fn.lower(*args).compile()
+            else:
+                # old runtimes abort EXECUTING a cache-loaded AOT
+                # executable (utils/compat.py) — run through jit there;
+                # compile then lands inside the first timed step (a
+                # metrics skew on legacy hosts, not a correctness loss)
+                exe = self._multi_fn
             self._compiled[key] = exe
             if self._vma_opaque:
                 # new executable, no static vma proof: re-verify
@@ -414,10 +480,15 @@ class Trainer:
                 self._unverified_exes.add(key)
         return exe
 
-    def _args(self, images, labels):
+    def _args(self, images, labels, fault_arm: float = 0.0):
         step0 = jnp.asarray(self._step, jnp.int32)
-        return (self.params, self.state, self.opt_state, self.sync_state,
+        args = (self.params, self.state, self.opt_state, self.sync_state,
                 self.data_key, step0, images, labels)
+        if self._fault_sig:
+            # the compiled step carries the chaos-tap arm scalar (traced,
+            # so 0.0 vs 1.0 never recompiles); clean builds have no slot
+            args += (jnp.float32(fault_arm),)
+        return args
 
     def precompile_steps(self, images: np.ndarray, labels: np.ndarray) -> None:
         """Ensure the program for these (K, batch, ...) shapes is compiled
@@ -431,12 +502,20 @@ class Trainer:
         per-step losses.  Produces the identical parameter/RNG trajectory as
         K ``train_step`` calls — just one dispatch instead of K."""
         k = images.shape[0]
+        faults.maybe_delay(self._step, k)  # chaos: straggler (no-op unplanned)
         images, labels = self._stage(images, labels)
-        args = self._args(images, labels)
-        key = (args[-2].shape, args[-1].shape)
+        # one-shot host arming of step-keyed grad/loss faults (consumes a
+        # firing only when the plan's step falls in this dispatch window)
+        args = self._args(images, labels,
+                          faults.arm_window(self._step, k))
+        key = (args[6].shape, args[7].shape)
         (self.params, self.state, self.opt_state, self.sync_state,
-         losses) = self._executable(args)(*args)
+         losses, oks) = self._executable(args)(*args)
+        # per-step health flags for the training sentry (1.0 = loss and
+        # synced grads finite on every replica); fetched lazily by readers
+        self.last_ok = oks
         self._step += k
+        faults.maybe_crash(self._step, k)  # chaos: injected process death
         if key in self._unverified_exes:
             self._unverified_exes.discard(key)
             self.check_consistency()

@@ -1,3 +1,4 @@
-from . import checkpoint, logging, metrics
+from . import checkpoint, compat, faults, logging, metrics, sentry
 
-__all__ = ["checkpoint", "logging", "metrics"]
+__all__ = ["checkpoint", "compat", "faults", "logging", "metrics",
+           "sentry"]

@@ -28,13 +28,90 @@ import json
 import os
 import re
 import threading
+import zlib
 
 import jax
 import numpy as np
 
 from ..parallel.mesh import replicated
+from . import faults
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    truncated archive, missing leaves).  Restore paths catch this,
+    QUARANTINE the offending generation (rename to ``*.corrupt`` so it
+    never lists again) and fall back to the previous one — a bad shard
+    must cost one checkpoint interval, not the run."""
+
+
+def _crc(arr: np.ndarray) -> int:
+    """crc32 over dtype/shape/bytes — cheap (GB/s-scale) per-leaf
+    integrity tag, written into the checkpoint meta at save and verified
+    at load.  Not cryptographic; the threat is bit rot and truncation,
+    not an adversary."""
+    h = zlib.crc32(str(arr.dtype).encode())
+    h = zlib.crc32(str(arr.shape).encode(), h)
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes(), h)
+
+
+def _verify_flat(flat: dict, meta: dict, path: str) -> None:
+    """Check every leaf against the meta's checksum table.  Checkpoints
+    from before the table existed (no ``__checksums__``) pass — there is
+    nothing to verify against."""
+    sums = meta.get("__checksums__")
+    if sums is None:
+        return
+    missing = [k for k in sums if k not in flat]
+    if missing:
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is missing {len(missing)} leaves "
+            f"(e.g. {missing[:3]})")
+    bad = [k for k, want in sums.items() if _crc(flat[k]) != want]
+    if bad:
+        raise CorruptCheckpointError(
+            f"checkpoint {path} failed checksum verification at "
+            f"{len(bad)} leaves (e.g. {bad[:3]})")
+
+
+def _load_npz_verified(path: str) -> tuple[dict, dict]:
+    """Read one whole-tree npz + embedded meta, verifying integrity;
+    raises CorruptCheckpointError for unreadable/truncated archives and
+    checksum mismatches."""
+    try:
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+        meta = json.loads(bytes(flat.pop("__meta__").tobytes()).decode())
+    except CorruptCheckpointError:
+        raise
+    except Exception as e:  # zipfile/EOF/pickle/json: unreadable archive
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is unreadable: {e}") from e
+    _verify_flat(flat, meta, path)
+    return flat, meta
+
+
+def _quarantine(path: str, err: Exception, log=print) -> None:
+    """Move a corrupt checkpoint aside (``<path>.corrupt``, uniquified on
+    collision) so it stops listing as restorable; never raises (the
+    fallback restore must proceed even when the rename loses a race with
+    a concurrent prune).  Uniquifying matters for recurring corruption:
+    a generation index gets REUSED after a rollback re-saves it, and a
+    directory rename onto an existing non-empty ``*.corrupt`` would
+    ENOTEMPTY-fail and leave the bad generation listed forever."""
+    dest = path + ".corrupt"
+    for n in range(1, 100):
+        if not os.path.exists(dest):
+            break
+        dest = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        pass
+    if log:
+        log(f"[checkpoint] quarantined corrupt checkpoint {path}: {err}")
 
 
 class _AsyncWriter:
@@ -94,12 +171,18 @@ def _writer_for(directory: str) -> _AsyncWriter:
 def _fetch(leaf) -> np.ndarray:
     """Materialize a leaf on host.  Replicated/single-host arrays are a plain
     device_get; multi-host sharded arrays (per-replica BN state) need a
-    cross-host allgather, which every process must enter (collective)."""
+    cross-host allgather, which every process must enter (collective).
+
+    The result is an OWNED copy (``np.array(copy=True)``): on the CPU
+    backend ``device_get`` can return a zero-copy view of the device
+    buffer, and training steps DONATE those buffers — an aliased fetch
+    handed to the async writer would serialize whatever the runtime
+    reused the buffer for by the time the background thread runs."""
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            leaf, tiled=True))
-    return np.asarray(jax.device_get(leaf))
+        return np.array(multihost_utils.process_allgather(
+            leaf, tiled=True), copy=True)
+    return np.array(jax.device_get(leaf), copy=True)
 
 
 def _flatten(tree) -> dict[str, np.ndarray]:
@@ -151,8 +234,11 @@ def _list_ckpts(directory: str) -> list[tuple[int, str]]:
 
 def _atomic_write(directory: str, index: int, payload: dict,
                   meta: dict, keep: int) -> str:
-    """Embed meta, write ckpt_<index>.npz atomically, prune old ones."""
+    """Embed meta + per-leaf checksums, write ckpt_<index>.npz
+    atomically, prune old ones."""
     payload = dict(payload)
+    meta = dict(meta, __checksums__={k: _crc(v) for k, v in
+                                     payload.items()})
     payload["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
     path = os.path.join(directory, f"ckpt_{index}.npz")
@@ -160,6 +246,7 @@ def _atomic_write(directory: str, index: int, payload: dict,
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, path)  # atomic publish
+    faults.maybe_corrupt_checkpoint(path)  # chaos hook (no-op unplanned)
     for _, old in _list_ckpts(directory)[:-keep]:
         os.remove(old)
     return path
@@ -227,14 +314,24 @@ class Checkpointer:
         BN state needs resharding — rank 0's running stats are taken as
         authoritative and re-stacked to the new replica count (the torch
         DDP buffer-broadcast convention; exact per-replica stats are kept
-        when the topology matches)."""
-        latest = self.latest()
-        if latest is None:
+        when the topology matches).
+
+        Integrity: each candidate verifies against its embedded per-leaf
+        checksums; a corrupt/truncated generation is QUARANTINED
+        (renamed ``*.corrupt``) and restore falls back to the previous
+        one instead of crashing mid-resume."""
+        got = None
+        for epoch, path in reversed(self.list()):
+            try:
+                flat, meta = _load_npz_verified(path)
+            except CorruptCheckpointError as e:
+                _quarantine(path, e)
+                continue
+            got = (epoch, flat, meta)
+            break
+        if got is None:
             return 0
-        epoch, path = latest
-        with np.load(path) as z:
-            flat = {k: z[k] for k in z.files}
-        meta = json.loads(bytes(flat.pop("__meta__").tobytes()).decode())
+        epoch, flat, meta = got
         if meta["model"] != trainer.cfg.model:
             raise ValueError(
                 f"checkpoint is for model {meta['model']}, "
@@ -315,16 +412,19 @@ class PyTreeCheckpointer:
         return _list_ckpts(self.directory)
 
     def restore(self, like: dict) -> tuple[dict, dict] | None:
-        """Latest checkpoint restored into ``like``'s structure/shardings;
-        returns (trees, meta) or None when no checkpoint exists."""
-        ckpts = self.list()
-        if not ckpts:
-            return None
-        _, path = ckpts[-1]
-        with np.load(path) as z:
-            flat = {k: z[k] for k in z.files}
-        meta = json.loads(bytes(flat.pop("__meta__").tobytes()).decode())
-        return _place_like(like, flat), meta
+        """Latest VERIFIED checkpoint restored into ``like``'s
+        structure/shardings; returns (trees, meta) or None when none
+        exists.  Corrupt generations are quarantined and skipped —
+        restore falls back to the newest one that passes its
+        checksums."""
+        for _, path in reversed(self.list()):
+            try:
+                flat, meta = _load_npz_verified(path)
+            except CorruptCheckpointError as e:
+                _quarantine(path, e)
+                continue
+            return _place_like(like, flat), meta
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -419,18 +519,21 @@ class ShardedCheckpointer:
                         arr = np.asarray(leaf)
                         payload[f"{key}#0"] = arr
                         index[key] = [{"npz": f"{key}#0", "slices": None,
-                                       "shape": list(arr.shape)}]
+                                       "shape": list(arr.shape),
+                                       "crc": _crc(arr)}]
                     continue
                 entries = []
                 for j, shard in enumerate(leaf.addressable_shards):
                     if shard.replica_id != 0:
                         continue  # dedupe replicated copies
                     npz_key = f"{key}#{j}"
-                    payload[npz_key] = np.asarray(shard.data)
+                    data = np.asarray(shard.data)
+                    payload[npz_key] = data
                     entries.append({
                         "npz": npz_key,
                         "slices": _slices_to_json(shard.index, leaf.shape),
                         "shape": list(leaf.shape),
+                        "crc": _crc(data),
                     })
                 if entries:
                     index[key] = entries
@@ -471,15 +574,32 @@ class ShardedCheckpointer:
         return sorted(out)
 
     def restore(self, like: dict) -> tuple[dict, dict] | None:
-        """Latest complete checkpoint restored into ``like``'s structure,
-        each leaf rebuilt shard-by-shard onto the template's devices;
-        returns (trees, meta) or None when no checkpoint exists."""
-        ckpts = self.list()
-        if not ckpts:
-            return None
-        _, ckpt_dir = ckpts[-1]
-        with open(os.path.join(ckpt_dir, "meta.json")) as f:
-            meta = json.load(f)
+        """Latest complete VERIFIED checkpoint restored into ``like``'s
+        structure, each leaf rebuilt shard-by-shard onto the template's
+        devices; returns (trees, meta) or None when no checkpoint
+        exists.  A generation with a corrupt shard file (per-shard crc
+        mismatch, truncated npz) is quarantined (renamed ``*.corrupt``)
+        and restore falls back to the previous generation."""
+        for _, ckpt_dir in reversed(self.list()):
+            try:
+                return self._restore_dir(ckpt_dir, like)
+            except CorruptCheckpointError as e:
+                _quarantine(ckpt_dir, e)
+        return None
+
+    def _restore_dir(self, ckpt_dir: str, like: dict) -> tuple[dict, dict]:
+        # JSON metadata is in the same bit-rot threat model as the shard
+        # payloads: a corrupt meta/index must fail THIS generation (and
+        # fall back), not crash the resume
+        def read_json(path: str):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError, UnicodeDecodeError) as e:
+                raise CorruptCheckpointError(
+                    f"checkpoint metadata {path} is unreadable: {e}") from e
+
+        meta = read_json(os.path.join(ckpt_dir, "meta.json"))
         # Merge every process's shard index; load npz files lazily.
         index: dict[str, list] = {}
         files: dict[int, np.lib.npyio.NpzFile] = {}
@@ -487,12 +607,16 @@ class ShardedCheckpointer:
             idx_path = os.path.join(ckpt_dir, f"proc{k}.idx.json")
             if not os.path.exists(idx_path):
                 continue
-            with open(idx_path) as f:
-                for key, entries in json.load(f).items():
-                    for e in entries:
-                        e["proc"] = k
-                    index.setdefault(key, []).extend(entries)
-            files[k] = np.load(os.path.join(ckpt_dir, f"proc{k}.npz"))
+            for key, entries in read_json(idx_path).items():
+                for e in entries:
+                    e["proc"] = k
+                index.setdefault(key, []).extend(entries)
+            npz_path = os.path.join(ckpt_dir, f"proc{k}.npz")
+            try:
+                files[k] = np.load(npz_path)
+            except Exception as e:  # truncated/unreadable archive
+                raise CorruptCheckpointError(
+                    f"shard file {npz_path} is unreadable: {e}") from e
 
         def lookup(key: str):
             if key not in index:
@@ -503,10 +627,23 @@ class ShardedCheckpointer:
 
         def read(e) -> np.ndarray:
             """npz access decompresses on EVERY __getitem__; memoize so a
-            replicated leaf is not decompressed once per template shard."""
+            replicated leaf is not decompressed once per template shard.
+            First load verifies the entry's crc (written at save) — a
+            flipped bit in any consumed shard fails THIS generation."""
             k = (e["proc"], e["npz"])
             if k not in loaded:
-                loaded[k] = files[e["proc"]][e["npz"]]
+                try:
+                    arr = files[e["proc"]][e["npz"]]
+                except Exception as err:
+                    raise CorruptCheckpointError(
+                        f"shard {e['npz']} of proc{e['proc']} in "
+                        f"{ckpt_dir} is unreadable: {err}") from err
+                want = e.get("crc")
+                if want is not None and _crc(arr) != want:
+                    raise CorruptCheckpointError(
+                        f"shard {e['npz']} of proc{e['proc']} in "
+                        f"{ckpt_dir} failed checksum verification")
+                loaded[k] = arr
             return loaded[k]
 
         try:
@@ -705,18 +842,43 @@ class IncrementalCheckpointer:
         return self._manifests()
 
     def restore(self, like: dict) -> tuple[dict, dict] | None:
-        """Latest manifest restored into ``like``'s structure/shardings."""
-        ms = self.list()
-        if not ms:
-            return None
-        with open(ms[-1][1]) as f:
-            manifest = json.load(f)
+        """Latest VERIFIED manifest restored into ``like``'s
+        structure/shardings.  The manifest's per-leaf content hashes
+        double as integrity checks: a corrupt/truncated delta file fails
+        verification, the manifest is quarantined, and restore falls
+        back to the previous one."""
+        for _, mpath in reversed(self.list()):
+            try:
+                return self._restore_manifest(mpath, like)
+            except CorruptCheckpointError as e:
+                _quarantine(mpath, e)
+                self._last = None  # cached hash state may cite the bad file
+        return None
+
+    def _restore_manifest(self, mpath: str, like: dict):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError, UnicodeDecodeError) as e:
+            raise CorruptCheckpointError(
+                f"manifest {mpath} is unreadable: {e}") from e
         by_file: dict[str, list[str]] = {}
         for key, entry in manifest["leaves"].items():
             by_file.setdefault(entry["file"], []).append(key)
         flat: dict[str, np.ndarray] = {}
         for fname, keys in by_file.items():
-            with np.load(os.path.join(self.directory, fname)) as z:
-                for k in keys:
-                    flat[k] = z[k]
+            fpath = os.path.join(self.directory, fname)
+            try:
+                with np.load(fpath) as z:
+                    for k in keys:
+                        flat[k] = z[k]
+            except Exception as e:
+                raise CorruptCheckpointError(
+                    f"delta file {fpath} is unreadable: {e}") from e
+        bad = [k for k, entry in manifest["leaves"].items()
+               if self._hash(flat[k]) != entry["hash"]]
+        if bad:
+            raise CorruptCheckpointError(
+                f"manifest {mpath}: {len(bad)} leaves failed content-hash "
+                f"verification (e.g. {bad[:3]})")
         return _place_like(like, flat), manifest["meta"]

@@ -32,7 +32,9 @@ class ConsistencyError(AssertionError):
 
 
 def _leaf_paths(tree: PyTree):
-    leaves, _ = jax.tree.flatten_with_path(tree)
+    # tree_util spelling: present on every supported runtime (the
+    # jax.tree.flatten_with_path alias arrived later than 0.4.x)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
     for path, leaf in leaves:
         yield jax.tree_util.keystr(path), leaf
 

@@ -1,0 +1,292 @@
+"""Deterministic fault injection: the chaos harness behind ISSUE 1.
+
+The recovery paths this framework promises (training sentry rollback,
+checkpoint quarantine, rendezvous backoff, elastic gang restart) are only
+real if they are exercised by REAL injected faults — BAGUA's argument
+(PAPERS.md): system relaxations earn their speed only when paired with
+principled, *tested* failure handling.  This module is the single switch
+panel: a ``FaultPlan`` names one fault class, the step/generation it
+fires at, and a seed, and every layer of the stack (train step, trainer
+host loop, checkpoint writer, rendezvous dial, launcher) consults it
+through cheap hooks that are EXACT no-ops when no plan is installed.
+
+Fault classes (``FaultPlan.kind``):
+
+- ``nan_grad`` / ``inf_grad``: poison ONE gradient leaf (chosen by seed)
+  at ``step`` — inside the jitted step, pre-sync, so the collective
+  spreads it exactly like a real hardware NaN would;
+- ``loss_spike``: multiply the loss by ``magnitude`` at ``step`` (the
+  detector sees a spike; grads spike with it);
+- ``crash``: hard-exit the process (``FAULT_EXIT_CODE``) after ``step``
+  completes — the launcher classifies this exit as injected;
+- ``ckpt_corrupt``: flip bits in / truncate the next checkpoint file
+  written (also available directly as ``corrupt_file`` for tests);
+- ``rendezvous``: refuse the first ``count`` rendezvous connection
+  attempts (parallel/init.py retries with backoff + jitter);
+- ``straggler``: sleep ``delay_s`` before each step in
+  [``step``, ``step + count``) — a slow rank, not a dead one.
+
+Plans deliver either programmatically (``install``) or through the
+``FAULT_PLAN`` env var as JSON — the env path crosses the launcher's
+process boundary, so gang-level tests inject into workers they never
+import.  ``gen`` gates a plan to one restart generation (the launcher's
+``RESTART_ATTEMPT``): a crash plan fires in generation 0 and stays quiet
+after the restart, so recovery can actually be observed.  ``rank``
+(-1 = every process) scopes process-level faults to one gang member.
+
+In-jit hooks (``tap_grads`` / ``tap_loss``) decide at TRACE time whether
+to emit any fault logic: the clean path compiles byte-identical programs
+with zero overhead.  Host hooks (``maybe_crash`` / ``maybe_delay``) are
+one attribute test per dispatch when no plan is installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Exit code workers use for injected crashes; launch.py classifies it.
+FAULT_EXIT_CODE = 77
+
+ENV_VAR = "FAULT_PLAN"
+
+KINDS = ("nan_grad", "inf_grad", "loss_spike", "crash", "ckpt_corrupt",
+         "rendezvous", "straggler")
+
+
+@dataclass
+class FaultPlan:
+    """One scheduled fault.  ``step`` is the trainer's global step
+    counter for step-scoped kinds; ``gen`` the restart generation the
+    plan is live in (-1 = every generation); ``rank`` the process it
+    fires on (-1 = all)."""
+
+    kind: str
+    step: int = 0
+    seed: int = 0
+    gen: int = 0
+    rank: int = -1
+    magnitude: float = 1e4   # loss_spike multiplier
+    delay_s: float = 0.0     # straggler sleep per step
+    # rendezvous refusals / straggler steps / grad-loss firings: the
+    # default 1 models a TRANSIENT fault (fires once even if a sentry
+    # rollback re-crosses the step); > 1 models a persistent one (the
+    # escalation-ladder scenario)
+    count: int = 1
+    mode: str = "bitflip"    # ckpt_corrupt: 'bitflip' | 'truncate'
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+
+    def to_env(self) -> str:
+        return json.dumps(asdict(self))
+
+
+_PLAN: FaultPlan | None = None
+_PLAN_FROM_ENV = False
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install (or clear, with None) the process-wide fault plan.
+    Programmatic installs shadow the env var."""
+    global _PLAN, _PLAN_FROM_ENV
+    _PLAN = plan
+    _PLAN_FROM_ENV = False
+
+
+def get_plan() -> FaultPlan | None:
+    """The active plan: a programmatic install, else ``FAULT_PLAN`` from
+    the environment (parsed once), else None."""
+    global _PLAN, _PLAN_FROM_ENV
+    if _PLAN is not None:
+        return _PLAN
+    if not _PLAN_FROM_ENV:
+        raw = os.environ.get(ENV_VAR)
+        if raw:
+            _PLAN = FaultPlan(**json.loads(raw))
+        _PLAN_FROM_ENV = True
+    return _PLAN
+
+
+def _gen_live(plan: FaultPlan) -> bool:
+    if plan.gen < 0:
+        return True
+    return int(os.environ.get("RESTART_ATTEMPT", "0")) == plan.gen
+
+
+def _rank_live(plan: FaultPlan) -> bool:
+    if plan.rank < 0:
+        return True
+    try:
+        return jax.process_index() == plan.rank
+    except RuntimeError:  # pragma: no cover - uninitialized backend
+        return plan.rank == 0
+
+
+def armed(kind: str) -> FaultPlan | None:
+    """The plan, iff it matches ``kind`` and this generation/process."""
+    plan = get_plan()
+    if (plan is not None and plan.kind == kind and _gen_live(plan)
+            and _rank_live(plan)):
+        return plan
+    return None
+
+
+# -- in-jit taps (trace-time no-ops on the clean path) -----------------------
+
+_STEP_FAULTS_FIRED = 0
+
+
+def step_plan() -> FaultPlan | None:
+    """The armed plan, if it is one of the step-keyed in-jit kinds."""
+    return armed("nan_grad") or armed("inf_grad") or armed("loss_spike")
+
+
+def arm_window(step0: int, k: int = 1) -> float:
+    """Host-side one-shot arming for the in-jit taps: 1.0 iff a
+    grad/loss plan's step falls inside the dispatch window
+    [step0, step0 + k) with firings left (``plan.count``, default 1);
+    marks one firing consumed.  The host gate is what gives step-keyed
+    faults ONCE semantics: a sentry rollback rewinds the step counter
+    across the fault step, and without the gate the re-crossed step
+    would re-inject forever — the default models a transient fault (the
+    class rewind-and-skip recovers from); ``count > 1`` models a
+    persistent one (the escalation-ladder scenario)."""
+    global _STEP_FAULTS_FIRED
+    plan = step_plan()
+    if plan is None or _STEP_FAULTS_FIRED >= plan.count:
+        return 0.0
+    if step0 <= plan.step < step0 + k:
+        _STEP_FAULTS_FIRED += 1
+        return 1.0
+    return 0.0
+
+
+def tap_grads(grads, step, fault_arm=0.0):
+    """Poison one gradient leaf with NaN/Inf when ``step`` (a traced
+    scalar) hits the plan's step AND the host armed this dispatch
+    (``fault_arm`` from ``arm_window``).  Called inside the jitted train
+    step, BEFORE the gradient sync, so the collective propagates the
+    poison exactly as a real bad shard would.  No plan: returns
+    ``grads`` untouched — nothing is traced into the program."""
+    plan = armed("nan_grad") or armed("inf_grad")
+    if plan is None:
+        return grads
+    bad = jnp.float32(jnp.nan if plan.kind == "nan_grad" else jnp.inf)
+    leaves, treedef = jax.tree.flatten(grads)
+    idx = plan.seed % len(leaves)
+    hit = (step == plan.step) & (fault_arm > 0.0)
+    leaves[idx] = jnp.where(hit, (leaves[idx] + bad).astype(
+        leaves[idx].dtype), leaves[idx])
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def tap_loss(loss, step, fault_arm=0.0):
+    """Multiply the loss by ``magnitude`` at the plan's (host-armed)
+    step (traced conditional; no plan: identity at trace time)."""
+    plan = armed("loss_spike")
+    if plan is None:
+        return loss
+    return jnp.where((step == plan.step) & (fault_arm > 0.0),
+                     loss * jnp.asarray(plan.magnitude, loss.dtype), loss)
+
+
+# -- host hooks --------------------------------------------------------------
+
+def maybe_crash(step: int, window: int = 1) -> None:
+    """Hard-exit (no teardown, no final checkpoint — a real crash) once
+    the trainer's counter reaches/passes the plan's step.  ``step`` is
+    the POST-dispatch counter and ``window`` the steps that dispatch
+    executed: a K-step scan calls this once with the counter advanced by
+    K, so the trigger is the (step - window, step] interval — a plan
+    step inside the scan still fires at the dispatch boundary (the
+    finest granularity a real crash could be observed at anyway).  The
+    distinctive exit code lets the launcher classify the death as
+    injected."""
+    plan = armed("crash")
+    if plan is not None and step - window < plan.step <= step:
+        print(f"[faults] injected crash at step {plan.step} "
+              f"(dispatch boundary {step})", flush=True)
+        os._exit(FAULT_EXIT_CODE)
+
+
+def maybe_delay(step: int, window: int = 1) -> None:
+    """Straggler: sleep ``delay_s`` before any dispatch whose window
+    [step, step + window) intersects the plan's [step, step + count)."""
+    plan = armed("straggler")
+    if plan is not None and (plan.step < step + window
+                             and step < plan.step + plan.count):
+        time.sleep(plan.delay_s)
+
+
+_RDZV_FAILED = 0
+
+
+def maybe_refuse_rendezvous() -> None:
+    """Raise ConnectionRefusedError for the first ``count`` attempts —
+    the flapping-coordinator simulation parallel/init.py retries
+    through."""
+    global _RDZV_FAILED
+    plan = armed("rendezvous")
+    if plan is not None and _RDZV_FAILED < plan.count:
+        _RDZV_FAILED += 1
+        raise ConnectionRefusedError(
+            f"[faults] injected rendezvous refusal "
+            f"{_RDZV_FAILED}/{plan.count}")
+
+
+def reset() -> None:
+    """Clear all fault state (tests)."""
+    global _RDZV_FAILED, _STEP_FAULTS_FIRED
+    _RDZV_FAILED = 0
+    _STEP_FAULTS_FIRED = 0
+    install(None)
+
+
+# -- checkpoint corruption ---------------------------------------------------
+
+def corrupt_file(path: str, mode: str = "bitflip", seed: int = 0,
+                 nbytes: int = 8) -> None:
+    """Corrupt ``path`` in place: flip ``nbytes`` pseudo-random bytes
+    (``bitflip``) or cut the file to half length (``truncate``) —
+    deterministic given ``seed``.  The checkpoint layer must detect
+    either (checksums / unreadable archive) and fall back a generation."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        return
+    if mode != "bitflip":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    rng = np.random.default_rng(seed)
+    # skip the first 512 bytes: flipping zip central-directory headers
+    # tests unreadability, flipping payload bytes tests checksums — the
+    # tail region exercises the checksum path more reliably
+    lo = min(512, size - 1)
+    offs = rng.integers(lo, size, nbytes)
+    with open(path, "r+b") as f:
+        for off in offs:
+            f.seek(int(off))
+            b = f.read(1)
+            f.seek(int(off))
+            f.write(bytes([b[0] ^ 0xFF]))
+
+
+def maybe_corrupt_checkpoint(path: str) -> None:
+    """Post-write hook: corrupt the just-published checkpoint file when a
+    ``ckpt_corrupt`` plan is armed (fires ``count`` times)."""
+    plan = armed("ckpt_corrupt")
+    if plan is None or plan.count <= 0:
+        return
+    plan.count -= 1
+    corrupt_file(path, mode=plan.mode, seed=plan.seed)
+    print(f"[faults] corrupted checkpoint {path} ({plan.mode})",
+          flush=True)

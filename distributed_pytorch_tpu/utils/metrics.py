@@ -15,6 +15,8 @@ printing them.
 
 from __future__ import annotations
 
+import math
+from collections import deque
 from dataclasses import dataclass, field
 
 LOSS_WINDOW = 20
@@ -70,3 +72,56 @@ class IterTimeMeter:
             self.total = 0.0
             return rec
         return None
+
+
+class SpikeDetector:
+    """Rolling median/MAD outlier detector — the training sentry's
+    loss-spike (and step-time straggler) test (utils/sentry.py).
+
+    A value spikes when it exceeds ``median + threshold * sigma`` of the
+    trailing window, with sigma the MAD scaled to a normal-consistent
+    estimate (1.4826 * MAD) floored by ``min_sigma`` — the floor keeps a
+    converged, near-constant loss stream (MAD -> 0) from flagging
+    ordinary noise.  Median/MAD rather than mean/std because the window
+    must stay honest THROUGH a spike: one huge value barely moves the
+    median, while it would drag a mean-based threshold up enough to wave
+    the next spike through.  Non-finite values always spike.  Spiking
+    values are NOT admitted to the window (a fault must not poison the
+    baseline it is judged against); the first ``min_history`` values
+    train the baseline and never spike.
+    """
+
+    def __init__(self, window: int = 32, threshold: float = 10.0,
+                 min_history: int = 8, min_sigma: float = 1e-3):
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.window = window
+        self.threshold = threshold
+        self.min_history = max(min_history, 2)
+        self.min_sigma = min_sigma
+        self._hist: deque[float] = deque(maxlen=window)
+
+    def _median(self, values: list[float]) -> float:
+        s = sorted(values)
+        n = len(s)
+        mid = n // 2
+        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    def bound(self) -> float:
+        """Current spike threshold (+inf while the baseline trains)."""
+        if len(self._hist) < self.min_history:
+            return math.inf
+        vals = list(self._hist)
+        med = self._median(vals)
+        mad = self._median([abs(v - med) for v in vals])
+        sigma = max(1.4826 * mad, self.min_sigma)
+        return med + self.threshold * sigma
+
+    def update(self, value: float) -> bool:
+        """Feed one value; True = spike (value withheld from window)."""
+        if not math.isfinite(value):
+            return True
+        if value > self.bound():
+            return True
+        self._hist.append(value)
+        return False

@@ -1,0 +1,208 @@
+"""Training sentry: detect bad steps, roll back, skip, escalate.
+
+Long TPU runs die of NaN/Inf gradients and loss spikes far more often
+than of hardware loss — and the reference has no answer to either
+(SURVEY.md section 5).  The sentry is the host-side recovery driver over
+the per-step health signals the jitted steps already compute in-scan
+(train.py / lm.py: loss value + a grads-finite flag, negligible next to
+the backward):
+
+1. **Detect** — a step is bad when its in-jit finiteness flag trips or
+   its loss exceeds the rolling median/MAD spike bound
+   (``metrics.SpikeDetector``; median/MAD so the spike cannot poison the
+   baseline it is judged against).
+2. **Rewind and skip** (the PaLM recipe) — restore the last-good
+   snapshot (params/opt state/step counter, host-resident) and DROP the
+   data window since that snapshot: the caller simply continues with the
+   next batch, so the offending window is never replayed.  Because the
+   step counter rewinds with the state, the post-rollback trajectory is
+   bitwise-identical to an uninjected run over the same data order with
+   the skip-window excluded (tests/test_faults.py pins this).
+3. **Escalate** — triggers inside one recovery horizon climb a ladder:
+   skip the window (level <= ``skip_budget``); then also tighten the
+   gradient clip via the trainer's ``tighten_grad_clip`` hook (LM
+   trainer) by ``clip_factor`` per level; past ``max_rollbacks``, abort
+   with a full diagnostic (``SentryAbort``).  ``checkpoint_every`` clean
+   steps reset the ladder — recovery that holds is recovery.
+
+Event accounting lives in ``self.stats`` (steps, nonfinite, spikes,
+rollbacks, skipped_steps, clip_tightened, stragglers) — the train-stats
+contract of ISSUE 1.  Step wall-time runs through a second SpikeDetector
+purely for STRAGGLER accounting: a slow step is recorded, never rolled
+back (slowness is not state corruption).
+
+The sentry is trainer-agnostic: it snapshots whichever of
+``params/state/opt_state/sync_state`` the trainer owns, plus ``_step``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from .metrics import SpikeDetector
+
+
+@dataclass
+class SentryConfig:
+    checkpoint_every: int = 50   # clean steps between last-good snapshots
+    spike_window: int = 32
+    spike_threshold: float = 10.0
+    spike_min_history: int = 8
+    skip_budget: int = 1         # ladder: rollbacks at this level only skip
+    max_rollbacks: int = 3       # ladder: abort past this many per horizon
+    clip_factor: float = 0.5     # grad-clip multiplier per tighten
+    time_threshold: float = 10.0  # straggler bound (MAD multiples)
+
+
+class SentryAbort(RuntimeError):
+    """The escalation ladder ran out: repeated faults survived rollback,
+    skip, and grad-clip tightening.  Carries the full event accounting."""
+
+    def __init__(self, message: str, stats: dict):
+        super().__init__(f"{message}; events={stats}")
+        self.stats = dict(stats)
+
+
+_STATE_ATTRS = ("params", "state", "opt_state", "sync_state")
+
+
+class TrainingSentry:
+    """Guard one trainer's step loop.  Usage::
+
+        sentry = TrainingSentry(trainer)
+        for batch in batches:
+            loss = sentry.step(*batch)   # None = batch skipped (rollback)
+
+    ``step`` runs ``trainer.train_step``, judges the result, and either
+    returns the loss (clean) or rolls the trainer back and returns None
+    — the caller's only job is to keep feeding batches.
+    """
+
+    def __init__(self, trainer, cfg: SentryConfig | None = None, *,
+                 log=print):
+        self.trainer = trainer
+        self.cfg = cfg or SentryConfig()
+        self.log = log
+        self.detector = SpikeDetector(
+            window=self.cfg.spike_window,
+            threshold=self.cfg.spike_threshold,
+            min_history=self.cfg.spike_min_history)
+        self.time_detector = SpikeDetector(
+            window=self.cfg.spike_window,
+            threshold=self.cfg.time_threshold,
+            min_history=self.cfg.spike_min_history,
+            min_sigma=1e-4)
+        self.stats = dict(steps=0, nonfinite=0, spikes=0, rollbacks=0,
+                          skipped_steps=0, clip_tightened=0, stragglers=0,
+                          snapshots=0)
+        self._ladder = 0
+        self._snap = None
+        self._snap_step = 0
+        self.snapshot()
+
+    # -- last-good state ---------------------------------------------------
+    def snapshot(self) -> None:
+        """Host-copy the trainer's full training state as last-good.
+
+        The fetch is ``checkpoint._fetch``: it returns an OWNED copy
+        (on the CPU backend a host view of a jax array can be ZERO-COPY,
+        and the trainer's next step DONATES these buffers — an aliased
+        snapshot would silently rot as the runtime reuses them) and
+        allgathers cross-process-sharded leaves, so multi-host trainers
+        snapshot collectively — every process must drive the sentry in
+        step, exactly as they must for checkpoint saves."""
+        from .checkpoint import _fetch
+
+        snap = {}
+        for name in _STATE_ATTRS:
+            tree = getattr(self.trainer, name, None)
+            if tree is not None:
+                snap[name] = jax.tree.map(
+                    lambda x: (_fetch(x) if isinstance(x, jax.Array)
+                               else x), tree)
+        self._snap = snap
+        self._snap_step = self.trainer._step
+        self.stats["snapshots"] += 1
+        self._ladder = 0  # a full clean horizon: recovery held
+
+    def rollback(self) -> int:
+        """Restore the last-good snapshot (device placement taken from
+        the trainer's live arrays, so shardings survive the round-trip;
+        cross-process shardings rebuild per-shard via
+        ``make_array_from_callback``); returns the steps rewound."""
+        def put(s, l):
+            if not isinstance(l, jax.Array):
+                return s
+            if l.is_fully_addressable:
+                return jax.device_put(s, l.sharding)
+            # multi-host: each process supplies its addressable shards
+            # of the full host copy (the snapshot holds the global value)
+            return jax.make_array_from_callback(
+                l.shape, l.sharding, lambda idx, s=s: s[idx])
+
+        rewound = self.trainer._step - self._snap_step
+        for name, saved in self._snap.items():
+            live = getattr(self.trainer, name)
+            setattr(self.trainer, name, jax.tree.map(put, saved, live))
+        self.trainer._step = self._snap_step
+        self.stats["rollbacks"] += 1
+        return rewound
+
+    # -- the guarded step --------------------------------------------------
+    def _trainer_ok(self) -> bool:
+        ok = getattr(self.trainer, "last_ok", None)
+        # the flag is a pmean over replicas: ONE poisoned replica yields
+        # a fractional value (e.g. 0.875), which plain truthiness would
+        # wave through — healthy means exactly 1.0 everywhere
+        return True if ok is None else bool(np.all(np.asarray(ok) >= 1.0))
+
+    def step(self, *batch):
+        """One guarded optimizer step; returns the loss, or None when the
+        step was judged bad and the trainer was rolled back (the batch
+        window since the last snapshot is skipped — continue with the
+        NEXT batch)."""
+        t0 = time.perf_counter()
+        loss = self.trainer.train_step(*batch)
+        loss_val = float(loss)
+        elapsed = time.perf_counter() - t0
+
+        trigger = None
+        if not self._trainer_ok() or not np.isfinite(loss_val):
+            trigger = "nonfinite"
+        elif self.detector.update(loss_val):
+            trigger = "spikes"
+
+        if trigger is None:
+            self.stats["steps"] += 1
+            if self.time_detector.update(elapsed):
+                # slow, not wrong: account, never roll back
+                self.stats["stragglers"] += 1
+            if (self.trainer._step - self._snap_step
+                    >= self.cfg.checkpoint_every):
+                self.snapshot()
+            return loss_val
+
+        self.stats[trigger] += 1
+        self._ladder += 1
+        self.log(f"[sentry] step {self.trainer._step - 1}: {trigger} "
+                 f"(loss={loss_val:.6g}); escalation level {self._ladder}")
+        if self._ladder > self.cfg.max_rollbacks:
+            raise SentryAbort(
+                f"{trigger} at step {self.trainer._step - 1} after "
+                f"{self.stats['rollbacks']} rollbacks — escalation "
+                f"ladder exhausted", self.stats)
+        if self._ladder > self.cfg.skip_budget:
+            tighten = getattr(self.trainer, "tighten_grad_clip", None)
+            if tighten is not None:
+                new_clip = tighten(self.cfg.clip_factor)
+                self.stats["clip_tightened"] += 1
+                self.log(f"[sentry] grad clip tightened to {new_clip:g}")
+        rewound = self.rollback()
+        self.stats["skipped_steps"] += rewound
+        self.log(f"[sentry] rolled back {rewound} step(s) to step "
+                 f"{self._snap_step}; window skipped")
+        return None

@@ -18,7 +18,7 @@ from functools import partial  # noqa: E402
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-from jax import shard_map  # noqa: E402
+from distributed_pytorch_tpu.utils.compat import shard_map  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 from distributed_pytorch_tpu.ops.attention import attention_reference

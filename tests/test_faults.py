@@ -1,0 +1,479 @@
+"""Chaos tests: every fault class injected for real, every recovery path
+demonstrated — ISSUE 1's acceptance matrix (see README.md, fault matrix):
+
+  NaN/Inf grad   -> in-jit flag trips   -> sentry rollback-and-skip,
+                                           bitwise-equal resume
+  loss spike     -> median/MAD detector -> sentry rollback-and-skip,
+                                           escalation ladder to clip/abort
+  corrupt shard  -> checksum / archive  -> quarantine + previous-generation
+                    verification          fallback (all checkpointer kinds)
+  crash          -> launcher classifies -> gang restart (budgeted), resume
+                    FAULT_EXIT_CODE       from checkpoint (slow: end-to-end)
+  rendezvous flap-> injected refusals   -> exponential backoff + jitter
+  straggler      -> step-time detector  -> accounted, never rolled back
+
+Fast tests here run in tier-1 under the ``faults`` marker
+(``pytest -m faults``); gang-level injections carry ``slow`` too.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu import launch
+from distributed_pytorch_tpu.parallel import init as dist_init
+from distributed_pytorch_tpu.train import TrainConfig, Trainer
+from distributed_pytorch_tpu.utils import faults
+from distributed_pytorch_tpu.utils.checkpoint import (
+    Checkpointer, IncrementalCheckpointer, PyTreeCheckpointer,
+    ShardedCheckpointer)
+from distributed_pytorch_tpu.utils.metrics import SpikeDetector
+from distributed_pytorch_tpu.utils.sentry import (
+    SentryAbort, SentryConfig, TrainingSentry)
+
+pytestmark = pytest.mark.faults
+
+WORKERS = os.path.join(os.path.dirname(__file__), "workers")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _quiet(*a, **k):
+    pass
+
+
+# -- plumbing ----------------------------------------------------------------
+
+def test_fault_exit_code_constants_agree():
+    # launch.py keeps its own copy (the agent must stay jax-import-free)
+    assert launch.FAULT_EXIT_CODE == faults.FAULT_EXIT_CODE
+
+
+def test_plan_env_roundtrip_and_gen_gating(monkeypatch):
+    plan = faults.FaultPlan(kind="crash", step=5, gen=0, rank=0)
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    faults.reset()  # re-read the env
+    got = faults.get_plan()
+    assert got == plan
+    assert faults.armed("crash") is not None
+    monkeypatch.setenv("RESTART_ATTEMPT", "1")
+    assert faults.armed("crash") is None  # gen-gated off after restart
+    monkeypatch.setenv("RESTART_ATTEMPT", "0")
+    assert faults.armed("nan_grad") is None  # wrong kind never arms
+
+
+def test_spike_detector_median_mad():
+    det = SpikeDetector(window=16, threshold=6.0, min_history=4)
+    for v in [1.0, 1.1, 0.9, 1.05, 1.0, 0.95]:
+        assert not det.update(v)
+    assert det.update(float("nan"))       # non-finite always spikes
+    assert det.update(50.0)               # gross outlier
+    assert not det.update(1.02)           # window not poisoned by either
+    # near-constant stream: min_sigma floor keeps noise from flagging
+    det2 = SpikeDetector(window=16, threshold=6.0, min_history=4)
+    for _ in range(8):
+        assert not det2.update(2.0)
+    assert not det2.update(2.0 + 1e-4)
+
+
+# -- NaN/Inf gradient: inject -> detect -> rollback -> bitwise resume --------
+
+def _vgg_batches(n, bs=4):
+    rng = np.random.default_rng(1234)
+    return [(rng.integers(0, 256, (bs, 32, 32, 3)).astype(np.uint8),
+             rng.integers(0, 10, bs).astype(np.int32)) for _ in range(n)]
+
+
+@pytest.mark.parametrize("kind", ["nan_grad", "inf_grad"])
+def test_nan_grad_rollback_resumes_bitwise_equal(kind):
+    """The acceptance pin: an injected NaN/Inf gradient shard at step 4
+    trips the in-jit finiteness flag, the sentry rewinds to the last-good
+    snapshot and skips the offending window, and the resumed run's
+    parameters are BITWISE-equal to an uninjected run over the same data
+    order with the skip-window excluded (step-keyed augment RNG
+    included, because the step counter rewinds with the state)."""
+    batches = _vgg_batches(8)
+    cfg = TrainConfig(model="TINY", strategy="none", batch_size=4)
+
+    faults.install(faults.FaultPlan(kind=kind, step=4, seed=3))
+    tr_a = Trainer(cfg)
+    sentry = TrainingSentry(tr_a, SentryConfig(checkpoint_every=2),
+                            log=_quiet)
+    skipped_at = []
+    for i, b in enumerate(batches):
+        if sentry.step(*b) is None:
+            skipped_at.append(i)
+    assert skipped_at == [4]
+    assert sentry.stats["nonfinite"] == 1
+    assert sentry.stats["rollbacks"] == 1
+    assert sentry.stats["skipped_steps"] == 1  # snapshot landed at step 4
+    assert sentry.stats["steps"] == 7
+    assert tr_a._step == 7
+
+    # uninjected reference over the same data order, skip-window excluded
+    faults.reset()
+    tr_b = Trainer(cfg)
+    for i, b in enumerate(batches):
+        if i == 4:
+            continue
+        tr_b.train_step(*b)
+    la, lb = jax.tree.leaves(tr_a.params), jax.tree.leaves(tr_b.params)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- loss spike: detect -> rollback -> continue ------------------------------
+
+def _lm_trainer():
+    from distributed_pytorch_tpu import lm
+    from distributed_pytorch_tpu.models import transformer as tfm
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    return lm.LMTrainer(lm.LMTrainConfig(model=model, compute_dtype=None))
+
+
+def _lm_batches(n, bs=2, s=32, vocab=64):
+    rng = np.random.default_rng(7)
+    out = []
+    for _ in range(n):
+        t = rng.integers(0, vocab, (bs, s)).astype(np.int32)
+        out.append((t, np.roll(t, -1, 1)))
+    return out
+
+
+def test_loss_spike_rollback_resumes_bitwise_equal():
+    """A 1e6x injected loss spike at step 5 trips the median/MAD
+    detector; rollback rewinds to the step-4 snapshot (dropping step 4's
+    clean update too — that IS the skipped window) and the resumed
+    trajectory matches an uninjected run that excludes batches 4-5."""
+    batches = _lm_batches(9)
+    faults.install(faults.FaultPlan(kind="loss_spike", step=5,
+                                    magnitude=1e6))
+    tr_a = _lm_trainer()
+    sentry = TrainingSentry(
+        tr_a, SentryConfig(checkpoint_every=2, spike_window=8,
+                           spike_threshold=8.0, spike_min_history=3),
+        log=_quiet)
+    skipped_at = [i for i, b in enumerate(batches)
+                  if sentry.step(*b) is None]
+    assert skipped_at == [5]
+    assert sentry.stats["spikes"] == 1
+    assert sentry.stats["rollbacks"] == 1
+    assert sentry.stats["skipped_steps"] == 2  # batch 4 + the spiked batch
+
+    faults.reset()
+    tr_b = _lm_trainer()
+    for i, b in enumerate(batches):
+        if i in (4, 5):
+            continue
+        tr_b.train_step(*b)
+    assert tr_a._step == tr_b._step
+    for a, b in zip(jax.tree.leaves(tr_a.params),
+                    jax.tree.leaves(tr_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_escalation_ladder_tightens_clip_then_aborts():
+    """A PERSISTENT step-keyed NaN (``count`` high: re-fires every time
+    the rewound counter crosses its step) climbs the ladder: skip
+    (level 1), tighten grad clip (levels 2-3), abort with diagnostics
+    past max_rollbacks."""
+    faults.install(faults.FaultPlan(kind="nan_grad", step=2, count=99))
+    tr = _lm_trainer()
+    clip0 = tr.cfg.grad_clip
+    sentry = TrainingSentry(
+        tr, SentryConfig(checkpoint_every=100, skip_budget=1,
+                         max_rollbacks=3),
+        log=_quiet)
+    batch = _lm_batches(1)[0]
+    with pytest.raises(SentryAbort) as e:
+        for _ in range(40):
+            sentry.step(*batch)
+    assert sentry.stats["rollbacks"] == 3
+    assert sentry.stats["clip_tightened"] == 2
+    assert tr.cfg.grad_clip == pytest.approx(clip0 * 0.25)
+    assert e.value.stats["nonfinite"] == 4
+
+
+# -- corrupt checkpoint shard: quarantine + fallback -------------------------
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+def test_corrupt_checkpoint_quarantines_and_falls_back(tmp_path, mode):
+    cfg = TrainConfig(model="TINY", strategy="none", batch_size=4)
+    tr = Trainer(cfg)
+    ck = Checkpointer(str(tmp_path))
+    batches = _vgg_batches(4)
+    tr.train_step(*batches[0])
+    tr.train_step(*batches[1])
+    ck.save(tr, 1)
+    # owned copies: the next donated step reuses these device buffers,
+    # and a CPU-backend np.asarray view would rot under us
+    good = [np.array(x, copy=True) for x in jax.tree.leaves(tr.params)]
+    tr.train_step(*batches[2])
+    ck.save(tr, 2)
+
+    faults.corrupt_file(str(tmp_path / "ckpt_2.npz"), mode=mode, seed=5)
+    tr2 = Trainer(cfg)
+    epoch = ck.maybe_restore(tr2)
+    assert epoch == 1, "restore must fall back to the previous generation"
+    assert (tmp_path / "ckpt_2.npz.corrupt").exists()
+    assert not (tmp_path / "ckpt_2.npz").exists()
+    for a, b in zip(good, jax.tree.leaves(tr2.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    # training continues from the restored state
+    assert np.isfinite(float(tr2.train_step(*batches[3])))
+
+
+def test_ckpt_corrupt_env_plan_detected_at_restore(tmp_path):
+    """The harness's own ckpt_corrupt fault: the save-path hook corrupts
+    the next published checkpoint; restore must detect, quarantine, and
+    fall back — inject -> detect -> recover entirely through the
+    subsystem's production paths."""
+    cfg = TrainConfig(model="TINY", strategy="none", batch_size=4)
+    tr = Trainer(cfg)
+    ck = Checkpointer(str(tmp_path))
+    batches = _vgg_batches(3)
+    tr.train_step(*batches[0])
+    ck.save(tr, 1)
+    faults.install(faults.FaultPlan(kind="ckpt_corrupt", seed=11,
+                                    mode="bitflip"))
+    tr.train_step(*batches[1])
+    ck.save(tr, 2)  # corrupted on publish by the armed plan
+    faults.reset()
+    tr2 = Trainer(cfg)
+    assert ck.maybe_restore(tr2) == 1
+    assert (tmp_path / "ckpt_2.npz.corrupt").exists()
+
+
+def test_sharded_checkpointer_corrupt_shard_falls_back(tmp_path):
+    trees = {"t": {"w": jnp.arange(4096, dtype=jnp.float32),
+                   "b": jnp.ones((64,), jnp.float32)}}
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save(trees, 1, meta={"tag": "one"})
+    trees2 = {"t": {"w": trees["t"]["w"] * 2, "b": trees["t"]["b"] * 3}}
+    ck.save(trees2, 2, meta={"tag": "two"})
+    faults.corrupt_file(str(tmp_path / "ckpt_2" / "proc0.npz"), seed=1)
+    got, meta = ck.restore(trees)
+    assert meta["tag"] == "one"
+    np.testing.assert_array_equal(np.asarray(got["t"]["w"]),
+                                  np.asarray(trees["t"]["w"]))
+    assert os.path.exists(str(tmp_path / "ckpt_2.corrupt"))
+
+
+def test_sharded_checkpointer_corrupt_metadata_falls_back(tmp_path):
+    """JSON metadata is in the same bit-rot threat model as the shard
+    payloads: a garbled meta.json must quarantine the generation and
+    fall back, not crash the resume."""
+    trees = {"t": {"w": jnp.arange(256, dtype=jnp.float32)}}
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save(trees, 1, meta={"tag": "one"})
+    ck.save({"t": {"w": trees["t"]["w"] + 1}}, 2, meta={"tag": "two"})
+    (tmp_path / "ckpt_2" / "meta.json").write_text("{not json", "utf-8")
+    got, meta = ck.restore(trees)
+    assert meta["tag"] == "one"
+    assert (tmp_path / "ckpt_2.corrupt").exists()
+
+
+def test_sentry_fractional_health_flag_triggers():
+    """The health flag is a pmean over replicas: ONE poisoned replica
+    yields a fractional value, which must read as UNHEALTHY (numpy
+    truthiness would wave 0.875 through)."""
+
+    class _FakeTrainer:
+        _step = 0
+        params = {"w": jnp.zeros((2,))}
+
+        def train_step(self, loss):
+            self._step += 1
+            self.last_ok = np.float32(0.875)  # 7 of 8 replicas healthy
+            return jnp.float32(loss)
+
+    tr = _FakeTrainer()
+    sentry = TrainingSentry(tr, SentryConfig(max_rollbacks=5), log=_quiet)
+    assert sentry.step(1.0) is None  # fractional flag -> rollback
+    assert sentry.stats["nonfinite"] == 1
+
+
+def test_pytree_checkpointer_corrupt_falls_back(tmp_path):
+    ck = PyTreeCheckpointer(str(tmp_path))
+    trees = {"p": {"w": jnp.full((256,), 1.5)}}
+    ck.save(trees, 1)
+    ck.save({"p": {"w": jnp.full((256,), 2.5)}}, 2)
+    ck.wait()
+    faults.corrupt_file(str(tmp_path / "ckpt_2.npz"), mode="truncate")
+    got, meta = ck.restore(trees)
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(np.asarray(got["p"]["w"]),
+                                  np.full((256,), 1.5))
+
+
+def test_incremental_checkpointer_corrupt_delta_falls_back(tmp_path):
+    ck = IncrementalCheckpointer(str(tmp_path))
+    ck.save({"p": {"w": jnp.zeros((128,)), "frozen": jnp.ones((8,))}}, 1)
+    ck.save({"p": {"w": jnp.full((128,), 5.0), "frozen": jnp.ones((8,))}},
+            2)
+    faults.corrupt_file(str(tmp_path / "inc_2.npz"), seed=2)
+    got, meta = ck.restore({"p": {"w": jnp.zeros((128,)),
+                                  "frozen": jnp.zeros((8,))}})
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(np.asarray(got["p"]["w"]),
+                                  np.zeros((128,)))
+
+
+# -- rendezvous flap: backoff + jitter ---------------------------------------
+
+def test_rendezvous_flap_survived_by_backoff():
+    faults.install(faults.FaultPlan(kind="rendezvous", count=2))
+    calls = []
+    dist_init.init_distributed(
+        "127.0.0.1", num_nodes=2, rank=0, backoff_base_s=0.001,
+        _initialize=lambda **kw: calls.append(kw))
+    assert len(calls) == 1  # two injected refusals, third dial connects
+    assert calls[0]["coordinator_address"] == "127.0.0.1:6585"
+
+
+def test_rendezvous_exhausted_raises_diagnosable_error():
+    faults.install(faults.FaultPlan(kind="rendezvous", count=99))
+    calls = []
+    with pytest.raises(dist_init.RendezvousError) as e:
+        dist_init.init_distributed(
+            "10.0.0.9", num_nodes=4, rank=2, connect_attempts=3,
+            backoff_base_s=0.001,
+            _initialize=lambda **kw: calls.append(kw))
+    assert not calls
+    msg = str(e.value)
+    assert "10.0.0.9" in msg and "rank 2/4" in msg and "3 attempts" in msg
+
+
+def test_backoff_jitter_is_deterministic_and_bounded():
+    d1 = dist_init._backoff_delay(3, 5, base_s=1.0)
+    d2 = dist_init._backoff_delay(3, 5, base_s=1.0)
+    assert d1 == d2  # seeded: reproducible
+    assert 4.0 <= d1 < 12.0  # 8s nominal, jitter in [0.5x, 1.5x)
+    # decorrelated across ranks
+    assert d1 != dist_init._backoff_delay(3, 6, base_s=1.0)
+    # capped
+    assert dist_init._backoff_delay(30, 0, base_s=1.0) <= 1.5 * 30.0
+
+
+# -- straggler: detected, accounted, never rolled back -----------------------
+
+def test_straggler_accounted_without_rollback():
+    faults.install(faults.FaultPlan(kind="straggler", step=10,
+                                    delay_s=0.3, count=1))
+    cfg = TrainConfig(model="TINY", strategy="none", batch_size=4)
+    tr = Trainer(cfg)
+    sentry = TrainingSentry(tr, SentryConfig(checkpoint_every=100),
+                            log=_quiet)
+    batch = _vgg_batches(1)[0]
+    losses = [sentry.step(*batch) for _ in range(13)]
+    assert all(v is not None for v in losses)  # no rollbacks, ever
+    assert sentry.stats["rollbacks"] == 0
+    assert sentry.stats["stragglers"] >= 1  # the 0.3s step vs ~ms baseline
+
+
+# -- crash: classified by the launcher, restart recovers ---------------------
+
+def test_injected_crash_classified_and_gang_restart_recovers(tmp_path):
+    """Fast gang-level pin (no jax in workers): a generation-0 worker
+    dies with FAULT_EXIT_CODE, the agent classifies the death as
+    injected, the restart budget relaunches, and generation 1 succeeds."""
+    prog = ("import os, sys\n"
+            "sys.exit(77 if os.environ['RESTART_ATTEMPT'] == '0' else 0)\n")
+    agent = launch.LocalAgent(["-c", prog], nproc_per_node=1,
+                              max_restarts=1, monitor_interval_s=0.05,
+                              log=_quiet)
+    result = agent.run()
+    assert result.returncode == 0
+    assert result.restarts_used == 1
+    assert result.injected_failures == 1
+    assert not result.injected  # the FINAL outcome was clean
+
+
+def test_genuine_failure_not_classified_injected():
+    agent = launch.LocalAgent(["-c", "import sys; sys.exit(9)"],
+                              nproc_per_node=1, monitor_interval_s=0.05,
+                              log=_quiet)
+    result = agent.run()
+    assert result.returncode == 9
+    assert result.injected_failures == 0
+    assert not result.injected
+
+
+@pytest.mark.slow
+def test_crash_fault_end_to_end_resume_trajectory_equal(tmp_path):
+    """SLOW gang-level injection: the env-delivered crash plan kills the
+    training worker mid-run (generation 0, after a checkpoint landed,
+    with un-checkpointed steps executed); the launcher classifies the
+    FAULT_EXIT_CODE death as injected and relaunches; generation 1 —
+    plan gen-gated off — resumes from the checkpoint and finishes with
+    parameters bitwise-equal to an uninterrupted run."""
+    import subprocess
+    import sys
+
+    def run(out_dir, ckpt_dir, extra_env):
+        out_dir.mkdir(exist_ok=True)
+        return subprocess.run(
+            [sys.executable, "-m", "distributed_pytorch_tpu.launch",
+             "--max-restarts", "1", "--monitor-interval", "0.05", "--",
+             "tests/workers/fault_worker.py"],
+            cwd="/root/repo", capture_output=True, text=True, timeout=420,
+            env=dict(
+                os.environ,
+                PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH",
+                                                          ""),
+                TEST_STEPS="8", TEST_CKPT_EVERY="2",
+                TEST_CKPT_DIR=str(ckpt_dir), TEST_OUT_DIR=str(out_dir),
+                **extra_env,
+            ),
+        )
+
+    plan = faults.FaultPlan(kind="crash", step=5, gen=0)
+    faulty = run(tmp_path / "out_f", tmp_path / "ckpt_f",
+                 {faults.ENV_VAR: plan.to_env()})
+    assert faulty.returncode == 0, (faulty.stdout[-2000:],
+                                    faulty.stderr[-2000:])
+    assert "injected crash at step 5" in faulty.stdout, faulty.stdout
+    assert "(injected fault)" in faulty.stdout, faulty.stdout
+    # the relaunch resumed from the step-4 checkpoint, not from scratch
+    assert "attempt=1 start_step=4" in faulty.stdout, faulty.stdout
+
+    ctl = run(tmp_path / "out_ctl", tmp_path / "ckpt_ctl", {})
+    assert ctl.returncode == 0, (ctl.stdout[-2000:], ctl.stderr[-2000:])
+
+    final_f = np.load(tmp_path / "out_f" / "final_attempt1.npy")
+    final_ctl = np.load(tmp_path / "out_ctl" / "final_attempt0.npy")
+    np.testing.assert_array_equal(final_f, final_ctl)
+
+
+# -- in-jit flag plumbing ----------------------------------------------------
+
+def test_health_flag_clean_and_poisoned_vgg():
+    cfg = TrainConfig(model="TINY", strategy="none", batch_size=4)
+    faults.install(faults.FaultPlan(kind="nan_grad", step=1))
+    tr = Trainer(cfg)
+    b = _vgg_batches(1)[0]
+    tr.train_step(*b)
+    assert np.all(np.asarray(tr.last_ok) == 1.0)
+    tr.train_step(*b)
+    assert np.all(np.asarray(tr.last_ok) == 0.0)
+
+
+def test_fsdp_noop_config_rejected():
+    """Satellite (ADVICE r5 #3): fsdp with a size-1 slice-local data
+    axis silently no-ops — validate_lm_cfg must refuse it."""
+    from distributed_pytorch_tpu import lm
+    with pytest.raises(ValueError, match="fsdp"):
+        lm.validate_lm_cfg(lm.LMTrainConfig(dp=1, fsdp=True))
+    with pytest.raises(ValueError, match="fsdp"):
+        lm.validate_lm_cfg(lm.LMTrainConfig(dp=2, dcn_size=2, fsdp=True))

@@ -908,7 +908,13 @@ def test_spec_serving_sampled_distribution(spec_params):
     distribution: the serve block's OWN point-mass rejection sampler
     (independent of generate.py's) is pinned against the analytic
     marginal of generated position 1, with plain (speculate=0) sampled
-    serving as the calibration at the same sample count."""
+    serving as the calibration at the same sample count.
+
+    768 samples (4 reps x 192 queued requests through 8 slots — the
+    refill paths reuse the compiled block, so extra requests are cheap)
+    put the TV sampling noise near 0.085 over vocab 64, making the 0.13
+    absolute tolerance comparable to the generate.py pin rather than the
+    old 72-sample ~0.45-noise gross-bias check (ADVICE r5 #4)."""
     from tests.test_lm_data_gen import _marginal_pos1
     prompt = np.asarray([3, 17, 5, 9], np.int32)
     temperature = 1.0
@@ -916,7 +922,7 @@ def test_spec_serving_sampled_distribution(spec_params):
                           jnp.asarray(prompt)[None], temperature, None,
                           None)
 
-    def harvest(speculate, reps=9, slots=8):
+    def harvest(speculate, reps=4, slots=8, requests=192):
         toks = []
         for rep in range(reps):
             cb = ContinuousBatcher(spec_params, SPEC_CFG, slots=slots,
@@ -924,7 +930,8 @@ def test_spec_serving_sampled_distribution(spec_params):
                                    steps_per_sync=2,
                                    prompt_buckets=(32,),
                                    speculate=speculate, seed=100 + rep)
-            rids = [cb.submit(prompt, max_new=3) for _ in range(slots)]
+            rids = [cb.submit(prompt, max_new=2)
+                    for _ in range(requests)]
             while cb.pending():
                 cb.step()
             toks += [cb.result(r)[len(prompt) + 1] for r in rids]
@@ -932,11 +939,9 @@ def test_spec_serving_sampled_distribution(spec_params):
         return 0.5 * np.abs(emp / len(toks) - want).sum()
 
     tv_spec = harvest(speculate=3)
-    tv_plain = harvest(speculate=0)
-    # 72 samples over vocab 64: noise TV ~0.45 — catches gross bias
-    # (always-accept / never-resample), not fine error; the fine-grained
-    # pin is the generate.py marginal test sharing filter_per_seq
-    assert tv_spec < tv_plain + 0.15, (tv_spec, tv_plain)
+    tv_plain = harvest(speculate=0)  # calibrates the harness itself
+    assert tv_plain < 0.13, tv_plain
+    assert tv_spec < 0.13, (tv_spec, tv_plain)
 
 
 def test_spec_serving_stats_identity(spec_params):

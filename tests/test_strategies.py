@@ -197,7 +197,7 @@ class TestStrategyUnits:
     def test_bucketed_packing_many_buckets(self, mesh):
         """Force multiple buckets with a tiny cap and check correctness."""
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from distributed_pytorch_tpu.utils.compat import shard_map
 
         s = strat.Bucketed(bucket_mb=1)
         grads = {
@@ -229,7 +229,7 @@ def test_quantized_allreduce_close_to_exact_and_trains():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from distributed_pytorch_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from distributed_pytorch_tpu.parallel import strategies as strat
@@ -269,7 +269,7 @@ def test_quantized_ring_matches_mean_within_tolerance():
     int8 precision (noise accumulates over reduce-scatter hops)."""
     from functools import partial
 
-    from jax import shard_map
+    from distributed_pytorch_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
@@ -300,7 +300,7 @@ def test_quantized_ring_moves_int8_on_the_wire():
     (its psum operand is int32)."""
     from functools import partial
 
-    from jax import shard_map
+    from distributed_pytorch_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
@@ -324,7 +324,7 @@ def test_gather_scatter_routes_all_traffic_through_rank0():
     import re
     from functools import partial
 
-    from jax import shard_map
+    from distributed_pytorch_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
@@ -383,7 +383,7 @@ class TestHierarchical:
     def test_exact_global_mean(self):
         from functools import partial
 
-        from jax import shard_map
+        from distributed_pytorch_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         rng = np.random.default_rng(3)
@@ -431,7 +431,7 @@ class TestHierarchical:
         import re
         from functools import partial
 
-        from jax import shard_map
+        from distributed_pytorch_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         grads = {"w": jnp.ones((8, 64, 16))}  # 1024 f32 per replica
@@ -462,7 +462,7 @@ class TestQuantizedRingEF:
     def test_residual_bookkeeping_is_exact(self):
         """n*mean + psum(residuals) == exact gradient sum, to f32 noise:
         the residuals hold PRECISELY what the int8 wire dropped."""
-        from jax import shard_map
+        from distributed_pytorch_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         n = 4
