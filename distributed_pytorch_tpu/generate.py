@@ -70,6 +70,23 @@ def pad_cache_len(n: int) -> int:
     return -(-n // 512) * 512
 
 
+def force_fetch_last(tokens: jax.Array) -> int:
+    """Force completion of a ``generate`` dispatch with a ONE-ELEMENT
+    device fetch (row 0's final token) and return it.
+
+    The hardened bench-window convention (BASELINE.md round-6
+    methodology): through a tunneled device ``block_until_ready`` can
+    return before compute finishes, so timed windows must end on a value
+    fetch — but ``np.asarray(out)`` over the whole (B, S) buffer pays a
+    size-dependent transfer ON TOP of the 60-130 ms round-trip, and that
+    single fetch was most of the historical decode-gate noise (the
+    round-5 +52% ``decode_ms_per_token`` move bisected to exactly this:
+    the compiled program was bitwise-unchanged).  Slicing one element
+    still forces the whole dependency chain while making the transfer
+    payload constant."""
+    return int(jax.device_get(tokens[0, -1]))
+
+
 def default_decode_kernel(flag: bool | None) -> bool:
     """Resolve a decode_kernel tri-state: None = kernel on TPU, XLA path
     elsewhere (the kernel runs in interpret mode off-TPU but is slower

@@ -86,7 +86,36 @@ TPU-first design constraints drive the shape:
   attending the shared prefix).  Sharing is read-only by construction
   (decode writes always land in the slot's own fresh tail pages);
   unreferenced cached pages are reclaimed LRU under pool pressure
-  before any occupant is preempted.
+  before any occupant is preempted;
+- **overlapped dispatch** (round 6, ``overlap=True``, default): the
+  sequential loop — plan, dispatch, FETCH, parse, plan ... — leaves the
+  device idle for a full host round-trip (60-130 ms through a tunneled
+  chip) plus all host planning between blocks, and BASELINE.md measures
+  sustained serving as ~95-98% host-RTT-bound.  The decode block's
+  per-slot state machine (token, write position, prompt offset,
+  remaining budget, done/active flags) is therefore threaded through
+  the compiled block as an explicit device-side CARRY: when the host
+  can prove the next block needs no intervention (every live slot
+  either cannot retire within the next two blocks or hands off to an
+  already-staged refill; no admissions are possible; pages cover the
+  worst case — ``_try_chain``), block N+1 is dispatched DIRECTLY from
+  block N's carry, BEFORE block N's packed results are fetched — the
+  fetch RTT and the host-side parse then overlap block N+1's device
+  compute instead of serializing with it.  Outputs are oracle-exact by
+  construction: a chained block is the same compiled program the
+  serial path would have dispatched (the carry holds exactly the state
+  the host would have re-staged), host-visible emissions just arrive
+  one ``step()`` later.  When the conditions fail (admission wanted,
+  retirement without a staged successor, pool pressure, speculation,
+  drained-tail compaction), the loop falls back to the serial
+  plan→dispatch→fetch→parse order for that block.  Per-phase wall
+  clock (plan / dispatch / fetch / parse) is accounted by a
+  ``utils.tracing.PhaseTimer`` (``timing_stats()``), so ms/token
+  decomposes instead of being one opaque number.  Buffer DONATION
+  (cache + carry, plus the speculative block's staging dict) is gated
+  behind ``utils/compat.py`` — legacy runtimes heap-corrupt executing
+  persistently-cached donated executables, so ``compat.donate`` yields
+  no donation there at the cost of transient HBM copies.
 """
 
 from __future__ import annotations
@@ -103,6 +132,7 @@ import numpy as np
 from .models import transformer as tfm
 from . import generate as gen
 from .utils import compat
+from .utils.tracing import PhaseTimer
 
 
 # submit() sentinel: "inherit the batcher default" — distinct from None,
@@ -167,6 +197,29 @@ class _Swapped:
     last_tok: int
 
 
+@dataclass
+class _InFlight:
+    """A dispatched-but-not-yet-fetched decode block (``overlap=True``):
+    everything ``_collect`` needs to parse its packed results, plus the
+    device-side carry and staging dicts a chained successor dispatch
+    reuses (``_try_chain``)."""
+    packed: object                # device (P,) int32; fetched at collect
+    carry: dict                   # device per-slot machine state at block end
+    cur: dict                     # device staging (reusable by a chained block)
+    ref: dict                     # device refill staging (ditto)
+    live: list                    # slots live at dispatch
+    cols: dict                    # slot -> packed column
+    w: int                        # compiled row count
+    compact: bool
+    npad: int
+    plen: np.ndarray              # dispatch-time per-slot prompt lengths
+    active0: np.ndarray           # rows already switched to their refill
+    headroom: np.ndarray          # per-slot prompt-left + budget at dispatch
+    upto: np.ndarray              # per-slot worst-case write frontier (paged)
+    chainable: bool               # block flavor admits a chained successor
+    refs_held: bool = False       # a chained successor reuses the staged refs
+
+
 class ContinuousBatcher:
     """Fixed-slot continuous batching over one model.
 
@@ -198,6 +251,7 @@ class ContinuousBatcher:
                  compact_tail: bool = True,
                  speculate: int = 0, spec_ngram: int = 2,
                  prefix_cache: bool = False,
+                 overlap: bool = True,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
@@ -405,6 +459,20 @@ class ContinuousBatcher:
         # when seeded reproducibility matters; f32 greedy is exact
         # either way.
         self.compact_tail = compact_tail
+        # Overlapped dispatch (module docstring): when the host can prove
+        # the next block needs no intervention, it is dispatched from the
+        # previous block's device-side carry BEFORE that block's results
+        # are fetched — the fetch RTT and host parse hide under device
+        # compute.  Emissions then arrive one step() later; oracle
+        # exactness is unchanged (a chained block is the same program the
+        # serial path would have dispatched).  The speculative block
+        # keeps the serial order (its host parse is round-structured).
+        self.overlap = overlap
+        self._inflight: _InFlight | None = None
+        self._break_chain = False
+        # per-phase wall-clock attribution (host_plan / dispatch / fetch /
+        # host_parse / prefill): timing_stats() summarizes
+        self.timers = PhaseTimer()
         self.slot_poff = np.zeros(slots, np.int32)
         self.staged_refill: list[_Request | None] = [None] * slots
         self._staged_order: list[int] = []
@@ -430,6 +498,10 @@ class ContinuousBatcher:
                       "inblock_prefill_steps": 0, "inblock_refills": 0,
                       "evictions": 0, "swap_ins": 0,
                       "compact_dispatches": 0,
+                      # overlap: blocks dispatched from the previous
+                      # block's device carry, before its results were
+                      # fetched (the fetch RTT hid under device compute)
+                      "chained_dispatches": 0,
                       # speculation accounting (speculate > 0):
                       # slot_steps then counts dispatched VERIFY
                       # POSITIONS (rounds x slots x window) — the
@@ -491,7 +563,19 @@ class ContinuousBatcher:
     def pending(self) -> bool:
         return (bool(self.queue) or bool(self.admitting)
                 or (self.paged and bool(self.swapped))
+                or self._inflight is not None
                 or any(o is not None for o in self.occupant))
+
+    def timing_stats(self) -> dict:
+        """Per-phase wall-clock summary (count / total / p50 / p95 per
+        phase) over every ``step()`` so far: ``host_plan`` (admission +
+        staging), ``dispatch`` (program enqueue), ``fetch`` (the blocking
+        device->host transfer of a block's packed results), ``host_parse``
+        (emission bookkeeping), ``prefill`` (admission dispatches).  With
+        ``overlap`` on, ``fetch`` time is wall clock that ran CONCURRENTLY
+        with the chained successor's device compute — compare against the
+        serial (``overlap=False``) breakdown to see the hidden cost."""
+        return self.timers.summary()
 
     def result(self, rid: int) -> np.ndarray:
         req = self.requests[rid]
@@ -578,13 +662,22 @@ class ContinuousBatcher:
         return fn
 
     def _decode_for(self, n_slots: int):
-        """(params, cache, cur, ref, key) -> ((K, slots) sampled tokens,
-        (K, slots) emit mask, steps_executed, switch step, last write,
-        prompt offset, prefill-step count, cache) — ONE program runs up
-        to ``steps_per_sync`` lockstep steps for the whole pool per
-        dispatch.  Sampling parameters are per-slot vectors
-        (gen.sample_per_seq), so requests with different settings share
-        the dispatch.
+        """(params, cache, cur, ref, carry, key) -> (packed int32 vector,
+        cache, carry) — ONE program runs up to ``steps_per_sync``
+        lockstep steps for the whole pool per dispatch.  Sampling
+        parameters are per-slot vectors (gen.sample_per_seq), so requests
+        with different settings share the dispatch.
+
+        ``carry`` is the per-slot machine state (input token, write
+        position, prompt offset, remaining budget, done/active flags,
+        last meaningful write): the serial path stages it from host
+        mirrors each dispatch exactly as before; the OVERLAPPED path
+        feeds one block's carry output straight into the next dispatch
+        (``_try_chain``) so the previous block's results need not be
+        fetched first.  Same compiled program either way — chaining adds
+        zero compiles.  The cache and carry are donated
+        (``compat.donate``: no-op on legacy runtimes, which heap-corrupt
+        executing persistently-cached donated executables).
 
         Each slot is a little state machine driven by ``cur`` (the
         current request: input token, write position, prompt buffer +
@@ -628,17 +721,21 @@ class ContinuousBatcher:
             paged = self.paged
             rows = np.arange(n_slots)
 
-            def block_body(params, cache, cur, ref, key):
+            def block_body(params, cache, cur, ref, carry, key):
                 buf0 = jnp.zeros((k_steps, n_slots), jnp.int32)
                 mask0 = jnp.zeros((k_steps, n_slots), jnp.bool_)
-                done0 = cur["rem"] <= 0
-                c0 = dict(i=jnp.int32(0), cache=cache, tok=cur["tokens"],
-                          pos=cur["pos"], poff=cur["poff"],
-                          active=jnp.zeros((n_slots,), jnp.bool_),
-                          rem=cur["rem"], done=done0, key=key, buf=buf0,
+                # done folds the carried flag (a slot retired in an
+                # earlier chained block) with budget exhaustion (empty
+                # slots enter with rem=0); active carries so a refill
+                # consumed by an earlier block cannot switch in twice
+                done0 = carry["done"] | (carry["rem"] <= 0)
+                c0 = dict(i=jnp.int32(0), cache=cache, tok=carry["tok"],
+                          pos=carry["pos"], poff=carry["poff"],
+                          active=carry["active"],
+                          rem=carry["rem"], done=done0, key=key, buf=buf0,
                           mask=mask0,
                           sw=jnp.full((n_slots,), k_steps + 1, jnp.int32),
-                          lw=cur["pos"],
+                          lw=carry["lw"],
                           pf=jnp.zeros((n_slots,), jnp.int32))
 
                 def cond(c):
@@ -711,19 +808,25 @@ class ContinuousBatcher:
                     c["mask"].astype(jnp.int32).reshape(-1),
                     c["sw"], c["lw"], c["poff"], c["pf"],
                     c["i"][None]])
-                return packed, c["cache"]
+                # the carry never crosses to the host: a chained dispatch
+                # consumes it directly on device (_try_chain)
+                carry_out = dict(tok=c["tok"], pos=c["pos"],
+                                 poff=c["poff"], rem=c["rem"],
+                                 done=c["done"], active=c["active"],
+                                 lw=c["lw"])
+                return packed, c["cache"], carry_out
 
             if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=compat.donate(1))
+                fn = jax.jit(block_body, donate_argnums=compat.donate(1, 4))
             else:
                 from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
                 fn = jax.jit(shard_map(
                     block_body, mesh=self.mesh,
                     in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P()),
-                    out_specs=(P(), self._cache_spec)),
-                    donate_argnums=compat.donate(1))
+                              P(), P(), P(), P()),
+                    out_specs=(P(), self._cache_spec, P())),
+                    donate_argnums=compat.donate(1, 4))
             self._decode_fns[n_slots] = fn
         return self._decode_fns[n_slots]
 
@@ -952,8 +1055,12 @@ class ContinuousBatcher:
                     c["i"][None]])
                 return packed, c["cache"]
 
+            # donate the cache AND the staging dict (argnum 2): its
+            # (slots, kv_len) stream buffer is rebuilt host-side every
+            # dispatch, so aliasing its storage into the loop's updates
+            # saves an HBM copy per round (compat-gated, as ever)
             if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=compat.donate(1))
+                fn = jax.jit(block_body, donate_argnums=compat.donate(1, 2))
             else:
                 from .utils.compat import shard_map
                 from jax.sharding import PartitionSpec as P
@@ -962,7 +1069,7 @@ class ContinuousBatcher:
                     in_specs=(self._param_specs, self._cache_spec,
                               P(), P(), P()),
                     out_specs=(P(), self._cache_spec)),
-                    donate_argnums=compat.donate(1))
+                    donate_argnums=compat.donate(1, 2))
             self._spec_fns[key_] = fn
         return self._spec_fns[key_]
 
@@ -1734,10 +1841,148 @@ class ContinuousBatcher:
         chunked) prefill serves an idle pool and prompts wider than the
         in-block prompt buffer.
 
+        With ``overlap`` (default), a block's results are fetched on the
+        NEXT ``step()`` call, and when the host can prove the next block
+        needs no intervention it is dispatched from the in-flight
+        block's device-side carry BEFORE the fetch — the fetch RTT and
+        host parse then hide under device compute (module docstring).
+        Emissions therefore arrive one call later than the dispatch that
+        computed them; streams and stats totals are unchanged.
+
         Returns (rid, token) pairs emitted this call, in per-slot
         sampling order.
         """
         out: list[tuple[int, int]] = []
+        fl, self._inflight = self._inflight, None
+        if fl is not None:
+            nfl = self._try_chain(fl)
+            if nfl is not None:
+                # block N+1 is already computing: N's fetch RTT + parse
+                # run concurrently with it
+                fl.refs_held = True
+                self._break_chain = False
+                out += self._collect(fl)
+                if self._break_chain:
+                    # N's parse revealed an occupancy change (a refill
+                    # handoff or a retirement): N+1 was dispatched with
+                    # exact device state and stays valid, but its
+                    # metadata (headroom, page frontiers) is stale for
+                    # deciding a FURTHER chain — go serial after it
+                    nfl.chainable = False
+                self._inflight = nfl
+                return out
+            out += self._collect(fl)
+        nfl = self._plan_dispatch(out)
+        if nfl is not None:
+            if self.overlap:
+                self._inflight = nfl  # collected (and maybe chained) next call
+            else:
+                out += self._collect(nfl)
+        return out
+
+    def _try_chain(self, fl: _InFlight) -> _InFlight | None:
+        """Dispatch the successor of the in-flight block ``fl`` directly
+        from its device-side carry — valid only when the host provably
+        has no intervention to make between the two blocks:
+
+        - no admission could happen (no chunked admissions or swapped
+          requests waiting; no empty slot while the queue holds work);
+        - every live slot either cannot retire within fl plus the
+          chained block (``headroom > 2K``) or retires into an
+          already-staged refill, whose device-side in-place handoff is
+          exact without the host (the refill's reserved cap must cover
+          its writes across both blocks: a parsed handoff BREAKS the
+          chain — ``step`` — so a refill never runs more than one
+          chained block past its switch, bounding them at ``2K - 1``);
+        - under paging, the pool can cover one more block's worst-case
+          writes for every continuing row without evicting anyone.
+
+        A slot that retires on an ARMED EOS mid-chain simply idles for
+        the rest of that chain (done is carried; the parsed retirement
+        then breaks the chain) — exact, and accounted as waste.
+        Returns the new in-flight record, or None to fall back to the
+        serial plan→fetch→parse order."""
+        if not (self.overlap and fl.chainable):
+            return None
+        if self.admitting or (self.paged and self.swapped):
+            return None
+        if self.queue and any(self.occupant[s] is None and s not in fl.live
+                              for s in range(self.slots)):
+            return None  # an empty slot could admit queued work
+        k = self.steps_per_sync
+        staged = np.zeros(self.slots, bool)
+        for s in fl.live:
+            if fl.headroom[s] > 2 * k:
+                continue
+            if self.staged_refill[s] is None:
+                # could retire with nothing staged: the host will want
+                # to admit into (or compact away) the slot
+                return None
+            staged[s] = True
+            # the refill switches in during fl at the earliest at step 1
+            # and the chain breaks once the switch is parsed, so it
+            # lockstep-writes at most 2K - 1 positions from 0 before a
+            # serial plan re-extends its pages
+            if self.paged and 2 * k - 1 > \
+                    len(self.refill_pages[s]) * self.page - 1:
+                return None
+        upto = fl.upto.copy()
+        if self.paged and not self._chain_pages(fl, staged, upto):
+            return None
+        with self.timers.phase("dispatch"):
+            cur = fl.cur
+            if self.paged:
+                # tables/caps may have grown in _chain_pages
+                cur = dict(fl.cur)
+                cur["table"] = jnp.asarray(self.table.copy())
+                cur["cap"] = jnp.asarray(self._write_caps())
+            self.key, sub = jax.random.split(self.key)
+            packed, self.cache, carry = self._decode_for(fl.w)(
+                self.params, self.cache, cur, fl.ref, fl.carry, sub)
+        self.stats["chained_dispatches"] += 1
+        return _InFlight(
+            packed=packed, carry=carry, cur=cur, ref=fl.ref,
+            live=fl.live, cols=fl.cols, w=fl.w, compact=False, npad=0,
+            plen=fl.plen, active0=fl.active0 | staged,
+            headroom=np.maximum(fl.headroom - k, 0), upto=upto,
+            chainable=True)
+
+    def _chain_pages(self, fl: _InFlight, staged: np.ndarray,
+                     upto: np.ndarray) -> bool:
+        """Extend continuing rows' page tables to cover one more block's
+        worst-case writes WITHOUT evicting (eviction is an intervention
+        — the chain declines instead).  Rows handing off to a staged
+        refill are skipped: their writes land in the refill's reserved
+        pages (checked by the caller); the dead occupant's pages are
+        released at parse."""
+        plans = []
+        need = 0
+        for s in fl.live:
+            if staged[s]:
+                continue
+            up = min(int(fl.upto[s]) + self.steps_per_sync,
+                     self.kv_len - 1)
+            short = self._pages_short(up, len(self.slot_pages[s]))
+            if short > 0:
+                plans.append((s, up))
+                need += short
+            upto[s] = up
+        if need > self._avail_pages():
+            return False
+        for s, up in plans:
+            self._alloc_pages(s, up)
+        return True
+
+    def _plan_dispatch(self, out: list) -> _InFlight | None:
+        """Admit queued work from the CURRENT (fully parsed) host state,
+        stage the pool, and dispatch one decode block — without fetching
+        its results (``_collect`` does that; the serial path calls it
+        immediately, the overlapped path on the next ``step()``).
+        Admission first-tokens are appended to ``out``.  Speculative
+        blocks (``n_spec > 0``) dispatch AND parse here — their
+        round-structured parse is not pipelined.  Returns None when
+        nothing is live after admission."""
+        t_plan = time.perf_counter()
         if (self.schedule == "longest_first" and self._queue_dirty
                 and len(self.queue) > 1):
             # stable sort once per batch of submissions (dirty flag), not
@@ -1767,6 +2012,8 @@ class ContinuousBatcher:
                 if not self._occupy_prefilling(slot, req):
                     self.queue.appendleft(req)  # page pool full: wait
                     break
+        self.timers.add("host_plan", time.perf_counter() - t_plan)
+        t_pf = time.perf_counter()
         if self.prefill_chunk is None:
             if not use_inblock or (
                     self.queue and len(self.queue[0].prompt)
@@ -1774,9 +2021,12 @@ class ContinuousBatcher:
                 out += self._fill_free_slots()
         else:
             out += self._advance_admissions()
+        self.timers.add("prefill", time.perf_counter() - t_pf)
+        t_plan = time.perf_counter()
         live = [s for s in range(self.slots) if self.occupant[s] is not None]
         if not live:
-            return out
+            self.timers.add("host_plan", time.perf_counter() - t_plan)
+            return None
         k = self.steps_per_sync
         # per-slot staging: remaining budgets drive the device-side early
         # exit (empty slots: 0 — they never extend the block); mid-prefill
@@ -1797,6 +2047,7 @@ class ContinuousBatcher:
             else:
                 # established: advance to the new token's write position
                 pos[s] = min(pos[s] + 1, self.max_len - 1)
+        upto = np.zeros(self.slots, np.int32)
         if self.paged:
             # pre-allocate pages covering this dispatch's write frontier:
             # min(K, prompt-left + min(K, budget)) writes from pos — a
@@ -1809,17 +2060,24 @@ class ContinuousBatcher:
                     continue  # evicted as an earlier slot's victim
                 pr = int(plen[s]) - int(poff[s]) if plen[s] else 0
                 writes = self._block_writes(pr, int(budget[s]))
-                self._ensure_pages_or_evict(
-                    s, min(int(pos[s]) + writes - 1, self.kv_len - 1))
+                upto[s] = min(int(pos[s]) + writes - 1, self.kv_len - 1)
+                self._ensure_pages_or_evict(s, int(upto[s]))
             for s in list(live):
                 if self.occupant[s] is None:  # evicted: out of the block
                     live.remove(s)
                     budget[s] = 0
                     plen[s] = 0
             if not live:
-                return out
+                self.timers.add("host_plan", time.perf_counter() - t_plan)
+                return None
         if use_inblock:
             self._stage_refills()
+        # per-slot prompt-left + budget at dispatch: _try_chain's bound on
+        # whether this block (or its chained successor) could retire it
+        headroom = np.zeros(self.slots, np.int32)
+        for s in live:
+            pr = int(plen[s]) - int(poff[s]) if plen[s] else 0
+            headroom[s] = pr + int(budget[s])
         table = (self.table if self.paged
                  else np.zeros((self.slots, 1), np.int32))
         caps = self._write_caps()
@@ -1881,23 +2139,31 @@ class ContinuousBatcher:
                 pos_c[-npad:] = 0
                 plen_c[-npad:] = 0
                 poff_c[-npad:] = 0
-            # the seven staging fields both block flavors share, then
-            # the mode-specific state (ONE place defines the common set;
-            # the full-width branch below builds the same shape uncut)
+            # the staging fields both block flavors share, then the
+            # mode-specific state (ONE place defines the common set; the
+            # full-width branch below builds the same shape uncut).  The
+            # lockstep block's per-slot machine state lives in ``carry``
+            # (tok/pos/poff/rem/done/active/lw): staged from host
+            # mirrors here, fed back device-to-device by _try_chain.
             cur = dict(plen=plen_c, temp=cut_cur(self.slot_temp),
                        top_k=cut_cur(self.slot_topk),
                        top_p=cut_cur(self.slot_topp),
                        eos=cut_cur(self.slot_eos),
-                       rem=budget_c, cap=caps_c, table=table_c)
+                       cap=caps_c, table=table_c)
+            carry = None
             if self.n_spec:
                 det_c, wr_c = cut_cur(det), cut_cur(wr)
                 if npad:
                     det_c[-npad:] = 1  # pad rows: rem 0 -> done at round 0
                     wr_c[-npad:] = 0
-                cur.update(stream=cut_cur(stream), det=det_c, wr=wr_c)
+                cur.update(stream=cut_cur(stream), det=det_c, wr=wr_c,
+                           rem=budget_c)
             else:
-                cur.update(tokens=cut_cur(self.last_tok), pos=pos_c,
-                           poff=poff_c, prompt=cut_cur(prompt))
+                cur.update(prompt=cut_cur(prompt))
+                carry = dict(tok=cut_cur(self.last_tok), pos=pos_c,
+                             poff=poff_c, rem=budget_c,
+                             done=np.zeros(w, bool),
+                             active=np.zeros(w, bool), lw=pos_c.copy())
             ref = dict(valid=np.zeros(w, bool),
                        plen=np.zeros(w, np.int32),
                        prompt=np.zeros((w, self.refill_width), np.int32),
@@ -1937,23 +2203,35 @@ class ContinuousBatcher:
                 r_cap = np.full(self.slots, self.kv_len - 1, np.int32)
                 r_table = np.zeros((self.slots, 1), np.int32)
             w = self.slots
-            cur = dict(plen=plen, temp=self.slot_temp,
-                       top_k=self.slot_topk, top_p=self.slot_topp,
-                       eos=self.slot_eos, rem=budget, cap=caps,
-                       table=table)
+            # live mirrors are COPIED into the staging arrays: with a
+            # block in flight the host mutates them at parse, and a
+            # host->device transfer may alias host memory on some
+            # backends (the CPU zero-copy hazard utils/compat.py
+            # documents for the reverse direction)
+            cur = dict(plen=plen, temp=self.slot_temp.copy(),
+                       top_k=self.slot_topk.copy(),
+                       top_p=self.slot_topp.copy(),
+                       eos=self.slot_eos.copy(), cap=caps,
+                       table=table.copy())
+            carry = None
             if self.n_spec:
-                cur.update(stream=stream, det=det, wr=wr)
+                cur.update(stream=stream, det=det, wr=wr, rem=budget)
             else:
-                cur.update(tokens=self.last_tok, pos=pos, poff=poff,
-                           prompt=prompt)
+                cur.update(prompt=prompt)
+                carry = dict(tok=self.last_tok.copy(), pos=pos,
+                             poff=poff, rem=budget,
+                             done=np.zeros(self.slots, bool),
+                             active=np.zeros(self.slots, bool),
+                             lw=pos.copy())
             ref = dict(valid=r_valid, plen=r_plen, prompt=r_prompt,
                        temp=r_temp, top_k=r_topk, top_p=r_topp,
                        eos=r_eos, budget=r_budget, cap=r_cap,
-                       table=r_table)
+                       table=r_table.copy())
             cols = {s: s for s in live}
         cur = {k_: jnp.asarray(v) for k_, v in cur.items()}
         ref = {k_: jnp.asarray(v) for k_, v in ref.items()}
         self.key, sub = jax.random.split(self.key)
+        self.timers.add("host_plan", time.perf_counter() - t_plan)
         if self.n_spec:
             gcols = 0
             if self.paged:
@@ -1968,12 +2246,40 @@ class ContinuousBatcher:
                            + [1])
                 gcols = min(1 << (deep - 1).bit_length(),
                             self.pages_per_slot)
-            packed, self.cache = self._decode_spec_for(w, gcols)(
-                self.params, self.cache, cur, ref, sub)
-            return self._parse_spec_block(packed, live, cols, w, out)
-        packed, self.cache = self._decode_for(w)(self.params, self.cache,
-                                                 cur, ref, sub)
-        flat = np.asarray(packed)  # ONE device->host transfer per block
+            with self.timers.phase("dispatch"):
+                packed, self.cache = self._decode_spec_for(w, gcols)(
+                    self.params, self.cache, cur, ref, sub)
+            with self.timers.phase("fetch"):
+                flat = np.asarray(packed)
+            with self.timers.phase("host_parse"):
+                self._parse_spec_block(flat, live, cols, w, out)
+            return None
+        with self.timers.phase("dispatch"):
+            carry = {k_: jnp.asarray(v) for k_, v in carry.items()}
+            packed, self.cache, carry = self._decode_for(w)(
+                self.params, self.cache, cur, ref, carry, sub)
+        return _InFlight(
+            packed=packed, carry=carry, cur=cur, ref=ref, live=live,
+            cols=cols, w=w, compact=compact,
+            npad=(npad if compact else 0), plen=plen,
+            active0=np.zeros(self.slots, bool), headroom=headroom,
+            upto=upto, chainable=not compact)
+
+    def _collect(self, fl: _InFlight) -> list[tuple[int, int]]:
+        """Fetch an in-flight block's packed results (ONE device->host
+        transfer — with a chained successor already dispatched, this
+        transfer's RTT runs concurrently with the successor's device
+        compute) and mirror them on the host: emissions, retire/refill
+        handoffs, frontier sync, prefix publication, accounting.  With
+        ``refs_held`` (a chained successor references the staged
+        refills), unused refills stay staged instead of requeueing."""
+        out: list[tuple[int, int]] = []
+        k, w, live, cols = self.steps_per_sync, fl.w, fl.live, fl.cols
+        plen, compact, npad = fl.plen, fl.compact, fl.npad
+        with self.timers.phase("fetch"):
+            flat = np.asarray(fl.packed)
+        t0 = time.perf_counter()
+        occ_before = [self.occupant[s] for s in live]
         kn = k * w
         toks = flat[:kn].reshape(k, w)  # rows >= steps_exec unused
         mask = flat[kn:2 * kn].reshape(k, w).astype(bool)
@@ -1997,8 +2303,10 @@ class ContinuousBatcher:
             if self.occupant[s] is not None:
                 # current request continues; carry prefill progress only
                 # for slots staged mid-prefill (the device's poff is 0,
-                # not len(prompt), for established slots)
-                if plen[s]:
+                # not len(prompt), for established slots) — or whose row
+                # switched to a refill in an earlier chained block
+                # (active0: the device's poff then tracks the refill)
+                if plen[s] or fl.active0[s]:
                     self.slot_poff[s] = int(poff_f[j])
                 self.pos[s] = int(lw[j])
                 self._maybe_publish_prompt_pages(s)
@@ -2016,11 +2324,19 @@ class ContinuousBatcher:
                     self.slot_poff[s] = int(poff_f[j])
                     self.pos[s] = int(lw[j])
                     self._maybe_publish_prompt_pages(s)
-        self._requeue_unused_refills()
+        if not fl.refs_held:
+            self._requeue_unused_refills()
+        # any occupancy change (retirement or refill handoff) makes a
+        # chained successor's scheduling metadata stale: flag the chain
+        # to break after the in-flight block (step())
+        for idx, s in enumerate(live):
+            if self.occupant[s] is not occ_before[idx]:
+                self._break_chain = True
         self.stats["wasted_slot_steps"] += (
             k_exec * w
             - (self.stats["emitted_tokens"] - emitted_before)
             - int(np.sum(pf)))
+        self.timers.add("host_parse", time.perf_counter() - t0)
         return out
 
     def _sync_spec_slot(self, s: int, wr: int) -> None:

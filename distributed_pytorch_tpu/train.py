@@ -505,9 +505,14 @@ class Trainer:
         faults.maybe_delay(self._step, k)  # chaos: straggler (no-op unplanned)
         images, labels = self._stage(images, labels)
         # one-shot host arming of step-keyed grad/loss faults (consumes a
-        # firing only when the plan's step falls in this dispatch window)
+        # firing only when the plan's step falls in this dispatch window).
+        # Gated on the build-time signature snapshot: a plan installed
+        # AFTER construction has no arm slot in the compiled step, and
+        # arming would silently consume its firing without injecting
+        # (plans must be installed before building — _fault_sig note)
         args = self._args(images, labels,
-                          faults.arm_window(self._step, k))
+                          faults.arm_window(self._step, k)
+                          if self._fault_sig else 0.0)
         key = (args[6].shape, args[7].shape)
         (self.params, self.state, self.opt_state, self.sync_state,
          losses, oks) = self._executable(args)(*args)

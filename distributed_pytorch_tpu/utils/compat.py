@@ -22,6 +22,8 @@ What degrades where:
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 try:  # modern: top-level shard_map with check_vma
@@ -42,8 +44,25 @@ HAS_VMA = hasattr(jax, "typeof")
 # of-AOT moves compile time into the first timed step; neither costs
 # correctness, and the persistent cache stays on for the compile-bound
 # test suite.
+#
+# Donation sites that consult DONATION_SAFE (via ``donate``): the train
+# steps (train.py, lm.py), and serve.py's whole decode hot path — the
+# lockstep block (KV cache + the device-side carry the overlapped
+# dispatch chains on), the speculative block (cache + its staging dict,
+# whose (slots, kv_len) stream buffer is rebuilt every dispatch), the
+# suffix-prefill/chunk/insert/scatter cache writers.  Without donation,
+# each of those dispatches copies the full paged pool per call.
+#
+# JAX_GRAFT_FORCE_DONATION=1/0 overrides the runtime detection — for
+# A/B-measuring donation's effect on hardware, or re-testing the legacy
+# corruption after a runtime upgrade.  When forcing ON where
+# DONATION_SAFE would be False, disable the persistent compilation
+# cache first (that combination IS the corruption).
 AOT_EXECUTION_SAFE = _MODERN_SHARD_MAP
 DONATION_SAFE = _MODERN_SHARD_MAP
+_force = os.environ.get("JAX_GRAFT_FORCE_DONATION")
+if _force is not None:  # pragma: no cover - operator escape hatch
+    DONATION_SAFE = _force.strip().lower() not in ("0", "", "false")
 
 
 def donate(*argnums: int) -> tuple:

@@ -9,13 +9,18 @@ utils/metrics.py; this module adds what a real framework provides on top:
 - ``annotate_step(n)``: mark one training step in the trace so device time
   groups by step (the profiler's step-boundary convention);
 - ``StepTimer``: cheap wall-clock step timing with percentile summary, for
-  when a full profile is overkill.
+  when a full profile is overkill;
+- ``PhaseTimer``: named-phase wall-clock accumulation (serve.py's
+  plan / dispatch / fetch / parse attribution) — so a serving ms/token
+  number decomposes into where the time actually went instead of being
+  one opaque wall-clock scalar (``scripts/profile_decode.py`` prints it).
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -69,3 +74,76 @@ class StepTimer:
             "p90_s": ts[min(n - 1, int(n * 0.9))],
             "max_s": ts[-1],
         }
+
+
+@dataclass
+class PhaseTimer:
+    """Wall-clock accumulation by NAMED PHASE.
+
+    Two entry points — ``phase(name)`` as a context manager around a code
+    region, or ``add(name, seconds)`` for callers that already hold a
+    ``perf_counter`` delta (hot loops that cannot afford a context-manager
+    frame per segment).  A phase may receive several segments per outer
+    iteration (serve.py's ``host_plan`` spans the admission machinery in
+    two pieces); ``summary`` aggregates whatever landed.
+
+    Overhead is one ``perf_counter`` pair and a deque append per segment
+    — cheap enough to stay always-on in the serving loop
+    (``enabled=False`` turns even that off).  Memory is BOUNDED for
+    long-lived servers: exact running aggregates (count / total / max)
+    are kept per phase, while the percentile window holds only the most
+    recent ``window`` segments (a month-long serving process must not
+    accumulate one float per block forever)."""
+
+    enabled: bool = True
+    window: int = 4096
+    _recent: dict = field(default_factory=dict)   # phase -> deque[float]
+    _agg: dict = field(default_factory=dict)      # phase -> [n, total, max]
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        agg = self._agg.get(name)
+        if agg is None:
+            agg = self._agg[name] = [0, 0.0, 0.0]
+            self._recent[name] = deque(maxlen=self.window)
+        agg[0] += 1
+        agg[1] += seconds
+        agg[2] = max(agg[2], seconds)
+        self._recent[name].append(seconds)
+
+    def reset(self) -> None:
+        self._recent.clear()
+        self._agg.clear()
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """phase -> {segments, total_s, p50_s, p95_s, max_s}, plus a
+        ``"_total_s"`` key summing every phase (the attributable wall).
+        ``segments``/``total_s``/``max_s`` are exact over the full run;
+        the percentiles come from the last ``window`` segments."""
+        out: dict = {}
+        total = 0.0
+        for name, (n, tot, mx) in self._agg.items():
+            s = sorted(self._recent[name])
+            m = len(s)
+            total += tot
+            out[name] = {
+                "segments": n,
+                "total_s": tot,
+                "p50_s": s[m // 2],
+                "p95_s": s[min(m - 1, int(m * 0.95))],
+                "max_s": mx,
+            }
+        out["_total_s"] = total
+        return out

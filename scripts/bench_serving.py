@@ -67,6 +67,7 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
             "inblock_prefill": s["inblock_prefill_steps"],
             "inblock_refills": s["inblock_refills"],
             "compact_dispatches": s["compact_dispatches"],
+            "chained_dispatches": s["chained_dispatches"],
             "wasted": s["wasted_slot_steps"],
             "utilization": round(util, 4),
             "decode_dispatches": s["decode_dispatches"],
@@ -80,7 +81,12 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
                 "prefix_hits") else None,
             "waste_when": waste,
             "latency": {k: (round(v, 3) if isinstance(v, float) else v)
-                        for k, v in cb.latency_stats().items()}}
+                        for k, v in cb.latency_stats().items()},
+            # per-phase wall attribution (utils/tracing.PhaseTimer):
+            # plan / dispatch / fetch / parse / prefill totals
+            "phases": {k: round(v["total_s"], 4)
+                       for k, v in cb.timing_stats().items()
+                       if isinstance(v, dict)}}
 
 
 def main():
@@ -93,6 +99,10 @@ def main():
     ap.add_argument("--no-refill", action="store_true",
                     help="disable in-block refill (the round-3 "
                     "behavior), for the contrast")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="serial plan->dispatch->fetch->parse loop "
+                    "(the round-5 behavior), for the A/B against the "
+                    "overlapped dispatch pipeline")
     ap.add_argument("--schedule", default="fifo",
                     choices=("fifo", "longest_first"))
     ap.add_argument("--paged", action="store_true",
@@ -127,7 +137,7 @@ def main():
             prefill_chunk=args.prefill_chunk, schedule=args.schedule,
             paged=args.paged, speculate=args.speculate,
             spec_ngram=args.spec_ngram, prefix_cache=args.prefix_cache,
-            **kw)
+            overlap=not args.no_overlap, **kw)
 
     # cold pass compiles; the reported (timed) pass reuses its compiled
     # fns through a fresh batcher, so tok/s is warm and stats are clean

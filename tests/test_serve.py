@@ -1091,3 +1091,192 @@ def test_lpt_delays_short_requests(spec_params):
     lpt, _ = first_emit_step("longest_first")
     assert fifo[short] == 1, fifo       # fifo serves the head immediately
     assert lpt[short] > fifo[short], (lpt, fifo)
+
+
+# -- overlapped dispatch (round 6) --------------------------------------------
+
+def _ragged_workload(seed, n, lens=(5, 17, 40, 9, 23)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, (lens[i % len(lens)],)).astype(np.int32)
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("kw", [dict(), dict(paged=True),
+                                dict(schedule="longest_first")])
+def test_overlap_oracle_exact(params, kw):
+    """The tentpole's oracle: overlapped dispatch (device-carried block
+    chaining, deferred fetch/parse) emits EXACTLY the serial greedy
+    streams across slot recycling, in-block refill handoffs riding
+    chained blocks, dense and paged pools — and the pipeline actually
+    chained (the stats prove the fetch RTT had something to hide
+    under)."""
+    prompts = _ragged_workload(30, 5)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           steps_per_sync=8, overlap=True, **kw)
+    results = cb.run(prompts, max_new=24)
+    assert cb.stats["chained_dispatches"] > 0, cb.stats
+    for rid, prompt in enumerate(prompts):
+        np.testing.assert_array_equal(
+            results[rid], _greedy_oracle(params, prompt, 24))
+
+
+def test_overlap_interleaved_submission_exact(params):
+    """Submissions landing while a chained block is in flight still come
+    out oracle-exact: the chain breaks for admission at the next
+    eligible step, never mid-request."""
+    rng = np.random.default_rng(31)
+    pa = rng.integers(0, 256, (6,)).astype(np.int32)
+    pb = rng.integers(0, 256, (14,)).astype(np.int32)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32,),
+                           steps_per_sync=4, overlap=True)
+    ra = cb.submit(pa, max_new=20)
+    cb.step()
+    cb.step()
+    rb = cb.submit(pb, max_new=10)  # lands mid-pipeline
+    while cb.pending():
+        cb.step()
+    np.testing.assert_array_equal(cb.result(ra),
+                                  _greedy_oracle(params, pa, 20))
+    np.testing.assert_array_equal(cb.result(rb),
+                                  _greedy_oracle(params, pb, 10))
+
+
+def test_overlap_eos_mid_chain_exact(params):
+    """An armed EOS firing inside a chained block retires the request
+    exactly (the slot idles out the chain; the parsed retirement then
+    breaks it) — stream identical to the serial run."""
+    rng = np.random.default_rng(32)
+    p = rng.integers(0, 256, (8,)).astype(np.int32)
+    oracle = _greedy_oracle(params, p, 40)
+    eos = int(oracle[len(p) + 9])  # fires a few blocks in
+    first_hit = next(i for i in range(40)
+                     if int(oracle[len(p) + i]) == eos)
+
+    def run(overlap):
+        cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                               temperature=0.0, prompt_buckets=(32,),
+                               steps_per_sync=4, overlap=overlap)
+        r = cb.submit(p, max_new=40, eos_id=eos)
+        while cb.pending():
+            cb.step()
+        return cb, cb.result(r)
+
+    cb_on, out_on = run(True)
+    cb_off, out_off = run(False)
+    np.testing.assert_array_equal(out_on, out_off)
+    assert out_on[-1] == eos and len(out_on) == len(p) + first_hit + 1
+
+
+def test_overlap_accounting_matches_serial(params):
+    """Satellite pin: on a pure-decode workload (budgets >> K, no
+    retirement boundary mid-chain) the overlapped pipeline dispatches
+    the IDENTICAL block sequence — decode_dispatches, slot_steps, and
+    the whole accounting identity equal the serial run, with
+    chained_dispatches > 0 proving the pipeline engaged (and == 0
+    serial)."""
+    prompts = _ragged_workload(33, 2, lens=(7, 11))
+
+    def run(overlap):
+        cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                               temperature=0.0, prompt_buckets=(32,),
+                               steps_per_sync=4, overlap=overlap)
+        res = cb.run(prompts, max_new=30)
+        return cb, res
+
+    cb_on, r_on = run(True)
+    cb_off, r_off = run(False)
+    for rid in r_off:
+        np.testing.assert_array_equal(r_on[rid], r_off[rid])
+    for key in ("decode_dispatches", "slot_steps", "emitted_tokens",
+                "inblock_prefill_steps", "wasted_slot_steps",
+                "batch_admissions", "prefill_dispatches"):
+        assert cb_on.stats[key] == cb_off.stats[key], (
+            key, cb_on.stats, cb_off.stats)
+    assert cb_on.stats["chained_dispatches"] > 0
+    assert cb_off.stats["chained_dispatches"] == 0
+    s = cb_on.stats
+    assert s["slot_steps"] == (s["emitted_tokens"] - s["batch_admissions"]
+                               + s["inblock_prefill_steps"]
+                               + s["wasted_slot_steps"]), s
+
+
+def test_overlap_zero_recompiles(params):
+    """Compile-counter pin: chaining reuses the ONE compiled block
+    program (the carry is an ordinary input — serial staging and
+    device-fed chaining share shapes/dtypes), so an overlapped run adds
+    zero executable cache entries beyond the serial run's."""
+    prompts = _ragged_workload(34, 4)
+
+    def make(overlap):
+        return ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                                 temperature=0.0, prompt_buckets=(32, 64),
+                                 steps_per_sync=8, overlap=overlap)
+
+    cb_off = make(False)
+    cb_off.run(prompts, max_new=20)
+
+    def sizes(cb):
+        return {k: f._cache_size() for k, f in cb._decode_fns.items()}
+
+    before = sizes(cb_off)
+    cb_on = make(True)
+    # share every compiled fn (scripts/bench_serving.warm_clone's list)
+    for attr in ("_prefill_fns", "_chunk_fns", "_decode_fns", "_spec_fns",
+                 "_suffix_fns", "_insert_fn", "_insert_paged_fn"):
+        if hasattr(cb_off, attr):
+            setattr(cb_on, attr, getattr(cb_off, attr))
+    cb_on.run(prompts, max_new=20)
+    assert cb_on.stats["chained_dispatches"] > 0
+    assert sizes(cb_on) == before, (sizes(cb_on), before)
+
+
+def test_overlap_donation_on_off_bitwise(params, monkeypatch):
+    """Satellite pin: the clean (greedy f32) serving path is bitwise
+    identical with buffer donation forced ON vs OFF — donation is a
+    memory optimization, never a numerics change.  The persistent
+    compilation cache is disabled while donation is forced: legacy
+    runtimes heap-corrupt EXECUTING cache-loaded donated executables
+    (utils/compat.py), and this test must be safe everywhere."""
+    from distributed_pytorch_tpu.utils import compat
+
+    prompts = _ragged_workload(35, 3)
+
+    def run():
+        cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                               temperature=0.0, prompt_buckets=(32, 64),
+                               steps_per_sync=4, paged=True, overlap=True)
+        return cb.run(prompts, max_new=10)
+
+    monkeypatch.setattr(compat, "DONATION_SAFE", False)
+    off = run()
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        monkeypatch.setattr(compat, "DONATION_SAFE", True)
+        on = run()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    assert set(on) == set(off)
+    for rid in off:
+        np.testing.assert_array_equal(on[rid], off[rid])
+
+
+def test_timing_stats_phases(params):
+    """The per-phase timer layer: a serving run attributes wall clock to
+    host_plan / dispatch / fetch / host_parse (+ prefill), every block
+    lands one fetch segment, and the summary carries p50/p95."""
+    prompts = _ragged_workload(36, 3)
+    cb = ContinuousBatcher(params, CFG, slots=2, max_len=512,
+                           temperature=0.0, prompt_buckets=(32, 64),
+                           steps_per_sync=8, overlap=True)
+    cb.run(prompts, max_new=12)
+    ts = cb.timing_stats()
+    for phase in ("host_plan", "dispatch", "fetch", "host_parse"):
+        assert phase in ts, (phase, ts.keys())
+        assert ts[phase]["segments"] > 0
+        assert ts[phase]["total_s"] >= 0
+        assert {"p50_s", "p95_s", "max_s"} <= set(ts[phase])
+    assert ts["fetch"]["segments"] == cb.stats["decode_dispatches"]
+    assert ts["_total_s"] > 0
