@@ -249,14 +249,22 @@ def bench_lm_large(iters: int = 12, batch: int = 4,
 
 
 def bench_decode(max_new: int = 4096, base: int = 256,
-                 reps: int = 5) -> tuple[float, float]:
-    """(p50, p95) ms per decode step (B=2, prompt 64, bf16, Pallas decode
-    kernel) — the BASELINE.md warm-decode config, HARDENED (round 6,
-    VERDICT r5 #1).  The old window divided ONE ~100-150 ms wall-clock
-    (prefill scan included) ended by a full-output tunnel fetch (60-130 ms
-    RTT) by ``max_new`` — up to ~50% noise, which is exactly what made
-    the round-5 +52% move unreadable (the compiled program was bitwise
-    identical; BASELINE.md bisect note).  Now:
+                 reps: int = 5,
+                 kv_dtype: str | None = None
+                 ) -> tuple[float, float, int]:
+    """(p50, p95, est. KV bytes/step) ms per decode step (B=2, prompt 64,
+    bf16, Pallas decode kernel) — the BASELINE.md warm-decode config,
+    HARDENED (round 6, VERDICT r5 #1).  ``kv_dtype="int8"`` runs the
+    quantized KV cache (per-row scales, in-kernel dequant) — decode is
+    HBM-bound on cache reads, so the third return value is the analytic
+    per-step cache-read estimate (B x kv_bytes_per_token x mean attended
+    length over the differenced window) the JSON carries: the knob's
+    predicted effect, next to its measured one.  The old window divided
+    ONE ~100-150 ms wall-clock (prefill scan included) ended by a
+    full-output tunnel fetch (60-130 ms RTT) by ``max_new`` — up to ~50%
+    noise, which is exactly what made the round-5 +52% move unreadable
+    (the compiled program was bitwise identical; BASELINE.md bisect
+    note).  Now:
 
     - PAIRED WINDOWS: each rep times ``generate`` at ``max_new`` and at a
       short ``base`` window; ms/token = (T_long - T_base)/(max_new -
@@ -283,7 +291,8 @@ def bench_decode(max_new: int = 4096, base: int = 256,
     def run(n):
         out = gen.generate(params, prompt, jax.random.key(1), cfg=cfg,
                            max_new=n, temperature=0.0,
-                           dtype=jnp.bfloat16, decode_kernel=True)
+                           dtype=jnp.bfloat16, decode_kernel=True,
+                           kv_dtype=kv_dtype)
         return gen.force_fetch_last(out)
 
     run(base)
@@ -300,13 +309,19 @@ def bench_decode(max_new: int = 4096, base: int = 256,
     ds.sort()
     p50 = ds[len(ds) // 2]
     p95 = ds[min(len(ds) - 1, int(len(ds) * 0.95))]
+    # per-step cache-read estimate over the differenced (base, max_new]
+    # steps: the mean attended length times bytes per cached token
+    mean_len = prompt.shape[1] + (base + max_new) // 2
+    kv_bytes = int(prompt.shape[0] * mean_len * gen.kv_bytes_per_token(
+        cfg, dtype=jnp.bfloat16, kv_dtype=kv_dtype))
     _log(f"[bench] decode: {p50:.4f} ms/token p50, {p95:.4f} p95 "
-         f"({reps} paired reps of {max_new}-vs-{base} new, B=2, bf16; "
+         f"({reps} paired reps of {max_new}-vs-{base} new, B=2, "
+         f"kv={kv_dtype or 'bf16'}, ~{kv_bytes / 1e6:.1f} MB KV/step; "
          f"spread {(ds[-1] - ds[0]) / max(p50, 1e-9):.1%})")
-    return p50, p95
+    return p50, p95, kv_bytes
 
 
-def bench_serving(reps: int = 5) -> dict:
+def bench_serving(reps: int = 5, kv_dtype: str | None = None) -> dict:
     """Serving throughput on the BASELINE.md workload (16 ragged requests
     over 4 slots, K=32, chunked prefill, in-block refill, longest_first),
     HARDENED (round 6): >=``reps`` warm timed passes per variant with
@@ -340,7 +355,8 @@ def bench_serving(reps: int = 5) -> dict:
             dtype=jnp.bfloat16 if on_tpu else None,
             prompt_buckets=(32, 128),
             steps_per_sync=32, prefill_chunk=32,
-            schedule="longest_first", overlap=overlap)
+            schedule="longest_first", overlap=overlap,
+            kv_dtype=kv_dtype)
 
     cold = make()
     bs.run(cold, prompts, budgets)
@@ -361,14 +377,16 @@ def bench_serving(reps: int = 5) -> dict:
     p50_on, p95_on, lo_on, hi_on = stats(on)
     p50_off, _, _, _ = stats(off)
     util = float(on[0]["utilization"])
+    eps = float(on[0]["emitted_per_slot_step"])
     _log(f"[bench] serving: {p50_on:.1f} tok/s p50 overlap on "
          f"(range {lo_on:.1f}-{hi_on:.1f}, {reps} reps), "
          f"{p50_off:.1f} off -> {p50_on / max(p50_off, 1e-9):.2f}x; "
-         f"util {util:.1%} (16 req / 4 slots, LPT)")
+         f"util {util:.1%}, emitted/slot-step {eps:.1%} "
+         f"(16 req / 4 slots, LPT, kv={kv_dtype or 'default'})")
     return {"tok_per_s": p50_on, "tok_per_s_p95": p95_on,
             "tok_per_s_no_overlap": p50_off,
             "overlap_speedup": p50_on / max(p50_off, 1e-9),
-            "utilization": util}
+            "utilization": util, "emitted_per_slot_step": eps}
 
 
 # Reference-semantics torch-CPU throughput: fallback constant for when torch
@@ -432,6 +450,16 @@ def bench_torch_cpu(batch: int, window: int = 39) -> float:
 
 
 def main() -> None:
+    # KV-cache storage knob for the inference gates: unset = the
+    # historical bf16 cache; BENCH_KV_DTYPE=int8 measures the quantized
+    # cache (same hardened windows, so the win is a clean A/B).  A typo
+    # must fail HERE, before any measurement — inside the benches it
+    # would be swallowed by their catch-alls while the JSON stamps the
+    # bogus value as the measured format.
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE") or None
+    if kv_dtype is not None:
+        from distributed_pytorch_tpu import generate as _gen
+        _gen.canon_kv_dtype(kv_dtype)
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -452,7 +480,7 @@ def main() -> None:
     # invisible to the driver.  Each is optional (the VGG headline must
     # survive any of them failing) and skippable for quick runs.
     lm_tps = lm_mfu = decode_ms = decode_p95 = serve = None
-    lml_tps = lml_mfu = None
+    lml_tps = lml_mfu = decode_kv_bytes = None
     if not os.environ.get("BENCH_SKIP_LM"):
         try:
             lm_tps, lm_mfu = bench_lm()
@@ -463,11 +491,12 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] lm-large bench failed ({e}); omitting")
         try:
-            decode_ms, decode_p95 = bench_decode()
+            decode_ms, decode_p95, decode_kv_bytes = bench_decode(
+                kv_dtype=kv_dtype)
         except Exception as e:
             _log(f"[bench] decode bench failed ({e}); omitting")
         try:
-            serve = bench_serving()
+            serve = bench_serving(kv_dtype=kv_dtype)
         except Exception as e:
             _log(f"[bench] serving bench failed ({e}); omitting")
 
@@ -509,6 +538,12 @@ def main() -> None:
                                 if decode_ms is not None else None),
         "decode_ms_per_token_p95": (round(decode_p95, 4)
                                     if decode_p95 is not None else None),
+        # KV-cache storage knob (BENCH_KV_DTYPE): which cache format the
+        # inference gates above measured, plus the analytic cache-read
+        # bytes one decode step costs at the bench shape — int8 should
+        # roughly halve it vs bf16 (gen.kv_bytes_per_token)
+        "kv_dtype": kv_dtype or "bf16",
+        "decode_kv_bytes_per_step": decode_kv_bytes,
         # hardened serving gate (round 6): median-of-reps, overlap A/B
         # in-session (serving_overlap_speedup is the tentpole's win)
         "serving_tokens_per_sec": (round(serve["tok_per_s"], 1)
@@ -523,6 +558,13 @@ def main() -> None:
         "serving_slot_step_utilization": (round(serve["utilization"], 4)
                                           if serve is not None
                                           else None),
+        # acceptance-adjusted utilization (VERDICT r5 weak #4): emitted
+        # tokens per dispatched slot-step — the number that stays
+        # meaningful under speculation, where raw utilization counts
+        # rejected verify positions as dispatched work
+        "serving_emitted_per_slot_step": (
+            round(serve["emitted_per_slot_step"], 4)
+            if serve is not None else None),
     }), flush=True)
 
 

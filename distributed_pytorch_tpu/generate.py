@@ -34,33 +34,106 @@ from .ops.attention import (NEG_INF, attention_reference,
 
 PyTree = Any
 
+# int8 KV quantization floor: a zero row quantizes against this scale
+# instead of dividing by zero (dequantized zeros stay exactly zero).
+KV_SCALE_EPS = 1e-8
+
+
+def canon_kv_dtype(kv_dtype):
+    """Normalize a ``kv_dtype`` knob: None (store K/V in the compute
+    ``dtype``, the historical behavior) or int8 (quantized cache with
+    per-row scales — see ``quantize_kv``).  Accepts the string "int8"
+    so CLI/bench surfaces need no jnp import."""
+    if kv_dtype is None:
+        return None
+    try:
+        ok = jnp.dtype(kv_dtype) == jnp.dtype(jnp.int8)
+    except TypeError:
+        ok = False
+    if ok:
+        return jnp.int8
+    raise ValueError(f"unsupported kv_dtype {kv_dtype!r}: expected None "
+                     f"or int8")
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 row quantization of K/V: ``x`` (..., head_dim) ->
+    (int8 values, float32 scales (..., 1)) with scale = absmax/127 per
+    row.  One scale per (cache position, kv head) — the granularity
+    incremental decode writes require: a whole-page scalar would force
+    requantizing every already-written row of the page on each new
+    token's write, and per-row is strictly more accurate anyway.  The
+    scales array keeps a trailing length-1 lane dim so every cache leaf
+    is rank-4 and rides the existing page-table/insert/swap machinery
+    (and the Pallas (block, 1) scale-tile layout) unchanged."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, KV_SCALE_EPS)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype=None) -> jax.Array:
+    """Inverse of ``quantize_kv``: int8 rows x their (..., 1) scales."""
+    x = q.astype(jnp.float32) * scale
+    return x.astype(dtype) if dtype is not None else x
+
+
+def kv_bytes_per_token(cfg: tfm.TransformerConfig, dtype=jnp.float32,
+                       kv_dtype=None, kv_heads: int | None = None) -> int:
+    """HBM bytes one cached token position costs across all layers (K +
+    V + scales) — the per-step decode cache-read estimate the bench JSON
+    carries and the PagePool byte-budget accounting uses.  int8 halves
+    the K/V bytes and adds one f32 scale per row (~2x net at head_dim
+    128: 2x(128+4) vs 2x(128x2) bytes per head per layer)."""
+    hk = kv_heads or cfg.kv_heads
+    if canon_kv_dtype(kv_dtype) is not None:
+        per_head = 2 * (cfg.head_dim + 4)  # int8 row + f32 scale, K and V
+    else:
+        per_head = 2 * cfg.head_dim * jnp.dtype(dtype or jnp.float32).itemsize
+    return per_head * hk * cfg.n_layers
+
+
+def _kv_leaves(shape, dtype, kv_dtype):
+    if canon_kv_dtype(kv_dtype) is not None:
+        sshape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(sshape, jnp.float32),
+                "vs": jnp.zeros(sshape, jnp.float32)}
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
 
 def init_cache(cfg: tfm.TransformerConfig, batch: int, max_len: int,
-               dtype=jnp.float32, kv_heads: int | None = None) -> PyTree:
+               dtype=jnp.float32, kv_heads: int | None = None,
+               kv_dtype=None) -> PyTree:
     """Zeroed per-layer K/V buffers, (B, kv_heads, max_len, head_dim) —
     GQA models cache only the kv heads.  ``kv_heads`` overrides the config
-    count (tensor-parallel decode caches only this shard's heads)."""
+    count (tensor-parallel decode caches only this shard's heads).  With
+    ``kv_dtype=int8`` each layer stores int8 K/V plus per-row float32
+    scales ("ks"/"vs", (..., max_len, 1)): writes quantize, the decode
+    kernels dequantize inside their tiles (ops/attention.py)."""
     shape = (batch, kv_heads or cfg.kv_heads, max_len, cfg.head_dim)
-    return {
-        f"layer{i}": {"k": jnp.zeros(shape, dtype),
-                      "v": jnp.zeros(shape, dtype)}
-        for i in range(cfg.n_layers)
-    }
+    return {f"layer{i}": _kv_leaves(shape, dtype, kv_dtype)
+            for i in range(cfg.n_layers)}
 
 
 def init_paged_cache(cfg: tfm.TransformerConfig, n_pages: int,
                      page: int = 512, dtype=jnp.float32,
-                     kv_heads: int | None = None) -> PyTree:
+                     kv_heads: int | None = None,
+                     kv_dtype=None) -> PyTree:
     """Zeroed per-layer PAGED K/V pools, (n_pages, kv_heads, page,
     head_dim): sequences own pages via a block table instead of a
     contiguous per-sequence buffer (serve.py paged mode), so cache memory
-    scales with pages actually allocated, not slots x max_len."""
+    scales with pages actually allocated, not slots x max_len.  With
+    ``kv_dtype=int8`` the pools are int8 with per-row scale pools
+    ("ks"/"vs", (n_pages, kv_heads, page, 1)) that ride the SAME block
+    tables — shared (prefix-cache) pages share their scales by
+    construction, and host-swap moves them with the page."""
     shape = (n_pages, kv_heads or cfg.kv_heads, page, cfg.head_dim)
-    return {
-        f"layer{i}": {"k": jnp.zeros(shape, dtype),
-                      "v": jnp.zeros(shape, dtype)}
-        for i in range(cfg.n_layers)
-    }
+    return {f"layer{i}": _kv_leaves(shape, dtype, kv_dtype)
+            for i in range(cfg.n_layers)}
 
 
 def pad_cache_len(n: int) -> int:
@@ -241,6 +314,10 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         else:
             bias = jnp.where(slot <= pos[:, None], 0.0, NEG_INF)[None, None]
 
+    # int8 KV cache: inferred from the cache pytree (scale leaves), so
+    # every caller — prefill, lockstep decode, the spec verify window,
+    # suffix prefill — quantizes/dequantizes without API changes.
+    quant = "ks" in next(iter(cache.values()))
     for i in range(cfg.n_layers):
         lp = params[f"layer{i}"]
         c = cache[f"layer{i}"]
@@ -250,24 +327,37 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
         q = tfm.rotary(q, pos, cfg.rope_theta)
         k = tfm.rotary(k, pos, cfg.rope_theta)
+        # each branch below writes the same (leaf name, update) pairs
+        # through one ``put``: K/V (quantized at WRITE time under int8,
+        # their per-row scales riding the identical scatter/slice) in
+        # the cache's (B|P, hkv, S|page, D[|1]) layout.
+        if quant:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            pairs = (("k", kq), ("v", vq), ("ks", ksc), ("vs", vsc))
+        else:
+            pairs = (("k", k.astype(c["k"].dtype)),
+                     ("v", v.astype(c["v"].dtype)))
         if scatter_writes:
             # speculative verify window: one scatter writes each token
             # at its own (caller-clamped) position — through the page
             # table under paging, straight into the (B, hkv, L, D)
             # buffers otherwise.  Colliding clamped rows (retired
             # slots) resolve arbitrarily; those rows are never read.
-            kv_t = k.transpose(0, 2, 1, 3).astype(c["k"].dtype)
-            vv_t = v.transpose(0, 2, 1, 3).astype(c["v"].dtype)
             if page_table is not None:
                 page = c["k"].shape[2]
                 pids = jnp.take_along_axis(page_table, write_at // page, 1)
                 offs = write_at % page
-                ck = c["k"].at[pids, :, offs].set(kv_t)
-                cv = c["v"].at[pids, :, offs].set(vv_t)
+
+                def put(leaf, u):
+                    return leaf.at[pids, :, offs].set(
+                        u.transpose(0, 2, 1, 3))
             else:
                 bidx = jnp.arange(tokens.shape[0])[:, None]
-                ck = c["k"].at[bidx, :, write_at].set(kv_t)
-                cv = c["v"].at[bidx, :, write_at].set(vv_t)
+
+                def put(leaf, u):
+                    return leaf.at[bidx, :, write_at].set(
+                        u.transpose(0, 2, 1, 3))
         elif page_table is not None:
             # paged write: token at position p lands in pool page
             # table[b, p // page] at row p % page
@@ -276,22 +366,24 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
             pids = jnp.take_along_axis(page_table,
                                        (p_now // page)[:, None], 1)[:, 0]
             offs = p_now % page
-            ck = c["k"].at[pids, :, offs].set(
-                k[:, :, 0].astype(c["k"].dtype))
-            cv = c["v"].at[pids, :, offs].set(
-                v[:, :, 0].astype(c["v"].dtype))
+
+            def put(leaf, u):
+                return leaf.at[pids, :, offs].set(u[:, :, 0])
         elif ragged:
             # per-sequence write offsets (vmapped update -> scatter)
-            upd = jax.vmap(lambda c, u, w: lax.dynamic_update_slice(
-                c, u, (0, w, 0)))
-            ck = upd(c["k"], k.astype(c["k"].dtype), write_at)
-            cv = upd(c["v"], v.astype(c["v"].dtype), write_at)
+            def put(leaf, u):
+                return jax.vmap(
+                    lambda c_, u_, w_: lax.dynamic_update_slice(
+                        c_, u_, (0, w_, 0)))(leaf, u, write_at)
         else:
-            ck = lax.dynamic_update_slice(
-                c["k"], k.astype(c["k"].dtype), (0, 0, write_at, 0))
-            cv = lax.dynamic_update_slice(
-                c["v"], v.astype(c["v"].dtype), (0, 0, write_at, 0))
-        cache[f"layer{i}"] = {"k": ck, "v": cv}
+            def put(leaf, u):
+                return lax.dynamic_update_slice(
+                    leaf, u, (0, 0, write_at, 0))
+        new_c = dict(c)
+        for name, u in pairs:
+            new_c[name] = put(c[name], u)
+        cache[f"layer{i}"] = new_c
+        ck, cv = new_c["k"], new_c["v"]
         if multi_ragged and page_table is not None:
             # contiguous per-sequence view of the owned pages (reads the
             # pool once; the verify is a fallback XLA path, not the hot
@@ -299,27 +391,42 @@ def _forward_cached(params: PyTree, cache: PyTree, tokens: jax.Array,
             bsz, hkv_l, page, hd = (tokens.shape[0], ck.shape[1],
                                     ck.shape[2], ck.shape[3])
             tbl = page_table[:, :gather_cols]  # live-depth-bounded gather
-            ka = (ck[tbl].transpose(0, 2, 1, 3, 4)
-                  .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
-            va = (cv[tbl].transpose(0, 2, 1, 3, 4)
-                  .reshape(bsz, hkv_l, k_len, hd).astype(q.dtype))
+
+            def gat(leaf):
+                w_ = leaf.shape[3]
+                return (leaf[tbl].transpose(0, 2, 1, 3, 4)
+                        .reshape(bsz, hkv_l, k_len, w_))
+
+            ka, va = gat(ck), gat(cv)
+            if quant:  # dequantize the gathered rows with their scales
+                ka = dequantize_kv(ka, gat(new_c["ks"]))
+                va = dequantize_kv(va, gat(new_c["vs"]))
+            ka, va = ka.astype(q.dtype), va.astype(q.dtype)
             if q.shape[1] != hkv_l:
                 rep = q.shape[1] // hkv_l
                 ka = jnp.repeat(ka, rep, axis=1)
                 va = jnp.repeat(va, rep, axis=1)
             o = attention_reference(q, ka, va, bias=bias)
         elif page_table is not None:
-            o = decode_attention_paged(q, ck, cv, page_table, pos[:, 0])
+            o = decode_attention_paged(
+                q, ck, cv, page_table, pos[:, 0],
+                k_scale=new_c.get("ks"), v_scale=new_c.get("vs"))
         elif kernel_path:
             # Pallas decode kernel: exact pos+1 cache-read bound (dead
             # blocks neither fetched nor computed), GQA head groups folded
             # into MXU rows — no repeated cache reads, no k_len segmenting.
             # Ragged: pos[:, 0] gives each sequence its own bound.
             o = decode_attention(q, ck, cv,
-                                 pos[:, 0] if ragged else pos[0])
+                                 pos[:, 0] if ragged else pos[0],
+                                 k_scale=new_c.get("ks"),
+                                 v_scale=new_c.get("vs"))
         else:
-            ka = ck[:, :, :k_len].astype(q.dtype)
-            va = cv[:, :, :k_len].astype(q.dtype)
+            ka = ck[:, :, :k_len]
+            va = cv[:, :, :k_len]
+            if quant:
+                ka = dequantize_kv(ka, new_c["ks"][:, :, :k_len])
+                va = dequantize_kv(va, new_c["vs"][:, :, :k_len])
+            ka, va = ka.astype(q.dtype), va.astype(q.dtype)
             if cfg.kv_heads != cfg.n_heads:
                 # local head counts (identical ratio under TP sharding)
                 rep = q.shape[1] // ka.shape[1]
@@ -561,6 +668,7 @@ def _generate_impl(
     decode_segments: int = 8,
     tp_axis: str | None = None,
     decode_kernel: bool | None = None,
+    kv_dtype=None,
 ) -> jax.Array:
     b, s0 = prompt.shape
     # Pallas decode kernel by default on TPU: exact dynamic pos+1 cache-read
@@ -576,7 +684,8 @@ def _generate_impl(
         max_len = pad_cache_len(max_len)
     cache = init_cache(cfg, b, max_len,
                        dtype=dtype or jnp.float32,
-                       kv_heads=params["layer0"]["wk"].shape[1])
+                       kv_heads=params["layer0"]["wk"].shape[1],
+                       kv_dtype=kv_dtype)
 
     # Prefill: ONE batched causal forward over the whole prompt (matmul-bound
     # MXU work) through the cache-backed path — not a per-token scan of tiny
@@ -625,7 +734,8 @@ def _generate_impl(
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k",
                                    "top_p", "dtype", "eos_id",
-                                   "decode_segments", "decode_kernel"))
+                                   "decode_segments", "decode_kernel",
+                                   "kv_dtype"))
 def generate(
     params: PyTree,
     prompt: jax.Array,       # (B, S0) int32
@@ -640,6 +750,7 @@ def generate(
     eos_id: int | None = None,
     decode_segments: int = 8,
     decode_kernel: bool | None = None,
+    kv_dtype=None,
 ) -> jax.Array:
     """Sample ``max_new`` tokens after ``prompt``; returns (B, S0+max_new).
 
@@ -647,8 +758,12 @@ def generate(
     then a sampling scan emits tokens (each step's sample feeds the next).
     ``dtype`` selects the compute AND KV-cache dtype (bf16 decode is ~2x
     faster — cache reads are the bandwidth bottleneck); sampling logits
-    stay float32.  With ``eos_id``, a sequence that samples it keeps
-    emitting it (per-sequence stop with static shapes).
+    stay float32.  ``kv_dtype="int8"`` stores the cache quantized with
+    per-row scales instead — HALF the cache-read bytes of bf16 again
+    (decode at long cache is HBM-bound on exactly those reads), with
+    writes quantizing and the decode kernel dequantizing in its tiles.
+    With ``eos_id``, a sequence that samples it keeps emitting it
+    (per-sequence stop with static shapes).
     """
     # generate is jitted, so this runs at trace time: once per compiled
     # config, not per call.
@@ -657,7 +772,7 @@ def generate(
                           temperature=temperature, top_k=top_k, top_p=top_p,
                           dtype=dtype, eos_id=eos_id,
                           decode_segments=decode_segments,
-                          decode_kernel=decode_kernel)
+                          decode_kernel=decode_kernel, kv_dtype=kv_dtype)
 
 
 def _spec_prefill(params, prompt, cfg, dtype, max_len_pad):
@@ -1051,6 +1166,7 @@ def generate_tp(
     eos_id: int | None = None,
     decode_segments: int = 8,
     decode_kernel: bool | None = None,
+    kv_dtype=None,
     specs: PyTree | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: ``generate`` inside shard_map over ``axis``.
@@ -1087,6 +1203,7 @@ def generate_tp(
     cache_key = (cfg, mesh, axis, max_new, temperature, top_k, top_p,
                  jnp.dtype(dtype).name if dtype is not None else None,
                  eos_id, decode_segments, decode_kernel,
+                 jnp.dtype(kv_dtype).name if kv_dtype is not None else None,
                  tuple(spec_leaves), spec_def)
     fn = _TP_JIT_CACHE.get(cache_key)
     if fn is None:
@@ -1106,7 +1223,7 @@ def generate_tp(
                                  eos_id=eos_id,
                                  decode_segments=decode_segments,
                                  decode_kernel=decode_kernel,
-                                 tp_axis=axis)
+                                 kv_dtype=kv_dtype, tp_axis=axis)
             # Certify replication for the P() out_spec: gathered ZeRO-3
             # leaves are still *marked* varying over their gather axes, so
             # the sampled tokens inherit that mark — a pmax over identical

@@ -123,6 +123,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-p", type=float, default=None,
                    help="nucleus sampling: smallest token set with "
                         "cumulative probability >= p")
+    p.add_argument("--kv-dtype", default=None, choices=("int8",),
+                   help="KV-cache storage for sampling: int8 = quantized "
+                        "cache with per-row scales (half the HBM cache "
+                        "read per decode step)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace (TensorBoard-loadable) "
                         "covering steps 2-11 (step 1 excluded: compile)")
@@ -305,6 +309,7 @@ def main(argv: list[str] | None = None) -> int:
                     mesh=trainer.mesh, max_new=args.max_new,
                     temperature=args.temperature, top_k=args.top_k,
                     top_p=args.top_p, dtype=cfg.dtype,
+                    kv_dtype=args.kv_dtype,
                     specs=param_specs(cfg) if cfg.fsdp else None)
             else:
                 from .utils.checkpoint import _fetch
@@ -315,7 +320,8 @@ def main(argv: list[str] | None = None) -> int:
                     prompt.astype(np.int32), jax.random.key(args.seed),
                     cfg=cfg.model, max_new=args.max_new,
                     temperature=args.temperature, top_k=args.top_k,
-                    top_p=args.top_p, dtype=cfg.dtype)
+                    top_p=args.top_p, dtype=cfg.dtype,
+                    kv_dtype=args.kv_dtype)
             text = lm_corpus.decode(np.asarray(out[0]))
             print(text)
 

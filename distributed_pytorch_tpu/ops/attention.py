@@ -412,9 +412,9 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 # Decode attention kernel (single-token query over a KV cache)
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, sm_scale: float, block_k: int, hkv: int,
-                   g: int):
+def _decode_kernel_body(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                        m_ref, l_ref, *, sm_scale: float, block_k: int,
+                        hkv: int, g: int, ks_ref=None, vs_ref=None):
     """Grid (B, num_k_blocks), k innermost — ONE batch element per step.
 
     The query tile is all H = hkv*g heads at once, (H, D); the cache tile is
@@ -429,6 +429,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
     Pallas elides the copy when the block index repeats).  Keeping the whole
     batch element's heads in one grid step keeps the grid coarse — per-step
     overhead, not bandwidth, dominates a fine decode grid.
+
+    INT8 KV (``ks_ref``/``vs_ref`` given): the cache tiles arrive as int8
+    with per-row scale tiles (hkv, block_k, 1) on the SAME index maps, so
+    the HBM read per step is ~half the bf16 cache's — dequantization
+    (int8 row x its scale, cast back to the query dtype so the MXU dots
+    stay in the compute dtype) happens HERE, in VMEM, never as a dense
+    bf16 materialization on the hot path.
     """
     j = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -449,8 +456,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         rows = []
         for t in range(hkv):
             qg = q_ref[0, t * g:(t + 1) * g]           # (g, D)
+            kt = k_ref[0, t]                           # (bk, D)
+            if ks_ref is not None:
+                kt = (kt.astype(jnp.float32)
+                      * ks_ref[0, t]).astype(qg.dtype)
             rows.append(jax.lax.dot_general(
-                qg, k_ref[0, t], (((1,), (1,)), ((), ())),
+                qg, kt, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))   # (g, bk)
         s = jnp.concatenate(rows, axis=0) * sm_scale   # (H, bk)
         # exact pos+1 read bound: slots beyond pos are invalid (zero-filled
@@ -469,9 +480,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
         pv = []
         for t in range(hkv):
-            pg = p[t * g:(t + 1) * g].astype(v_ref.dtype)
+            vt = v_ref[0, t]                           # (bk, D)
+            if vs_ref is not None:
+                vt = (vt.astype(jnp.float32)
+                      * vs_ref[0, t]).astype(q_ref.dtype)
+            pg = p[t * g:(t + 1) * g].astype(vt.dtype)
             pv.append(jax.lax.dot_general(
-                pg, v_ref[0, t], (((1,), (0,)), ((), ())),
+                pg, vt, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))   # (g, D)
         acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(pv, axis=0)
 
@@ -481,8 +496,27 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
                     / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, sm_scale: float, block_k: int, hkv: int,
+                   g: int):
+    _decode_kernel_body(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                        m_ref, l_ref, sm_scale=sm_scale, block_k=block_k,
+                        hkv=hkv, g=g)
+
+
+def _decode_kernel_q(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                     acc_ref, m_ref, l_ref, *, sm_scale: float,
+                     block_k: int, hkv: int, g: int):
+    """int8 twin of ``_decode_kernel``: two extra scale-tile operands."""
+    _decode_kernel_body(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                        m_ref, l_ref, sm_scale=sm_scale, block_k=block_k,
+                        hkv=hkv, g=g, ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def decode_attention(
     q: Array, k_cache: Array, v_cache: Array, pos: Array, *,
+    k_scale: Array | None = None,
+    v_scale: Array | None = None,
     sm_scale: float | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -495,6 +529,13 @@ def decode_attention(
     cache slots ``[0, pos[b]]`` exactly (per-sequence read bounds: a short
     sequence in the batch reads only its own prefix, the continuous-
     batching primitive).  Returns (B, H, 1, D).
+
+    INT8 KV cache: with ``k_scale``/``v_scale`` (B, Hkv, S, 1) float32
+    per-row scales, the caches are int8 and each tile dequantizes INSIDE
+    the kernel (``_decode_kernel_body``) — the HBM cache read per step is
+    ~half the bf16 cache's, with no dense dequantized buffer ever
+    materialized.  The scale tiles ride the same clamped index maps, so
+    dead blocks' scale DMAs are elided exactly like the cache's.
 
     TPU-first design (the fix for the segmented-decode workaround the
     round-1 ROADMAP documented): decode at long cache is HBM-bound on cache
@@ -510,6 +551,8 @@ def decode_attention(
     if sq != 1:
         raise ValueError(f"decode_attention takes single-token queries, "
                          f"got sq={sq}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     hkv, s = k_cache.shape[1], k_cache.shape[2]
     g = h // hkv
     if h % hkv:
@@ -527,30 +570,31 @@ def decode_attention(
     # (B, H, D) queries with each kv-head group's g queries contiguous rows
     qf = q.reshape(b, h, d)
     pos_arr = jnp.broadcast_to(jnp.atleast_1d(pos), (b,)).astype(jnp.int32)
-    vma = _vma(q, k_cache, v_cache)
+    quant = k_scale is not None
+    vma = (_vma(q, k_cache, v_cache, k_scale, v_scale) if quant
+           else _vma(q, k_cache, v_cache))
 
     def live_block(bb, j, pos_ref):
         return jnp.minimum(j, pos_ref[bb] // block_k)
 
+    def cache_spec(width):
+        return pl.BlockSpec(
+            (1, hkv, block_k, width),
+            lambda bb, j, pos_ref: (bb, 0, live_block(bb, j, pos_ref), 0))
+
+    in_specs = [pl.BlockSpec((1, h, d), lambda bb, j, pos_ref: (bb, 0, 0)),
+                cache_spec(d), cache_spec(d)]
+    inputs = [qf, k_cache, v_cache]
+    if quant:
+        in_specs += [cache_spec(1), cache_spec(1)]
+        inputs += [k_scale, v_scale]
     o = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=sm_scale,
-                          block_k=block_k, hkv=hkv, g=g),
+        functools.partial(_decode_kernel_q if quant else _decode_kernel,
+                          sm_scale=sm_scale, block_k=block_k, hkv=hkv, g=g),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nk),
-            in_specs=[
-                pl.BlockSpec((1, h, d), lambda bb, j, pos_ref: (bb, 0, 0)),
-                pl.BlockSpec(
-                    (1, hkv, block_k, d),
-                    lambda bb, j, pos_ref: (bb, 0,
-                                            live_block(bb, j, pos_ref),
-                                            0)),
-                pl.BlockSpec(
-                    (1, hkv, block_k, d),
-                    lambda bb, j, pos_ref: (bb, 0,
-                                            live_block(bb, j, pos_ref),
-                                            0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, h, d),
                                    lambda bb, j, pos_ref: (bb, 0, 0)),
             scratch_shapes=[
@@ -561,7 +605,7 @@ def decode_attention(
         ),
         out_shape=compat.shape_struct((b, h, d), q.dtype, vma=vma),
         interpret=interpret,
-    )(pos_arr, qf, k_cache, v_cache)
+    )(pos_arr, *inputs)
     return o.reshape(b, h, 1, d)
 
 
@@ -641,8 +685,22 @@ def _decode_kernel_paged(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
                    l_ref, sm_scale=sm_scale, block_k=block_k, hkv=hkv, g=g)
 
 
+def _decode_kernel_paged_q(pos_ref, table_ref, q_ref, k_ref, v_ref,
+                           ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                           *, sm_scale: float, block_k: int, hkv: int,
+                           g: int):
+    """Paged int8 twin: the per-row scale tiles ride the block table the
+    way the page gather already does (same live_page index map)."""
+    del table_ref
+    _decode_kernel_body(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                        m_ref, l_ref, sm_scale=sm_scale, block_k=block_k,
+                        hkv=hkv, g=g, ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def decode_attention_paged(
     q: Array, k_pool: Array, v_pool: Array, table: Array, pos: Array, *,
+    k_scale: Array | None = None,
+    v_scale: Array | None = None,
     sm_scale: float | None = None,
     interpret: bool | None = None,
 ) -> Array:
@@ -663,11 +721,19 @@ def decode_attention_paged(
     exactly one live page and dead pages' copies are elided (repeated
     index).  Entries past a sequence's allocated pages may be garbage; the
     clamp means they are never dereferenced.  Returns (B, H, 1, D).
+
+    INT8 KV pool: with ``k_scale``/``v_scale`` (P, Hkv, page, 1) float32
+    per-row scale POOLS, the caches are int8 and the scale tiles ride the
+    identical live_page lookup — a shared (prefix-cached) page carries
+    its scales with it, and each tile dequantizes inside the kernel
+    (see ``decode_attention``).
     """
     b, h, sq, d = q.shape
     if sq != 1:
         raise ValueError(f"decode_attention_paged takes single-token "
                          f"queries, got sq={sq}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     p_blocks, hkv, page, _ = k_pool.shape
     g = h // hkv
     if h % hkv:
@@ -687,29 +753,34 @@ def decode_attention_paged(
     qf = q.reshape(b, h, d)
     pos_arr = jnp.broadcast_to(jnp.atleast_1d(pos), (b,)).astype(jnp.int32)
     table = table.astype(jnp.int32)
-    vma = _vma(q, k_pool, v_pool)
+    quant = k_scale is not None
+    vma = (_vma(q, k_pool, v_pool, k_scale, v_scale) if quant
+           else _vma(q, k_pool, v_pool))
 
     def live_page(bb, j, pos_ref, table_ref):
         return table_ref[bb, jnp.minimum(j, pos_ref[bb] // page)]
 
+    def pool_spec(width):
+        return pl.BlockSpec(
+            (1, hkv, page, width),
+            lambda bb, j, pos_ref, table_ref: (
+                live_page(bb, j, pos_ref, table_ref), 0, 0, 0))
+
+    in_specs = [pl.BlockSpec((1, h, d),
+                             lambda bb, j, pos_ref, table_ref: (bb, 0, 0)),
+                pool_spec(d), pool_spec(d)]
+    inputs = [qf, k_pool, v_pool]
+    if quant:
+        in_specs += [pool_spec(1), pool_spec(1)]
+        inputs += [k_scale, v_scale]
     o = pl.pallas_call(
-        functools.partial(_decode_kernel_paged, sm_scale=sm_scale,
-                          block_k=page, hkv=hkv, g=g),
+        functools.partial(
+            _decode_kernel_paged_q if quant else _decode_kernel_paged,
+            sm_scale=sm_scale, block_k=page, hkv=hkv, g=g),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_pages),
-            in_specs=[
-                pl.BlockSpec((1, h, d),
-                             lambda bb, j, pos_ref, table_ref: (bb, 0, 0)),
-                pl.BlockSpec(
-                    (1, hkv, page, d),
-                    lambda bb, j, pos_ref, table_ref: (
-                        live_page(bb, j, pos_ref, table_ref), 0, 0, 0)),
-                pl.BlockSpec(
-                    (1, hkv, page, d),
-                    lambda bb, j, pos_ref, table_ref: (
-                        live_page(bb, j, pos_ref, table_ref), 0, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, h, d), lambda bb, j, pos_ref, table_ref: (bb, 0, 0)),
             scratch_shapes=[
@@ -720,5 +791,5 @@ def decode_attention_paged(
         ),
         out_shape=compat.shape_struct((b, h, d), q.dtype, vma=vma),
         interpret=interpret,
-    )(pos_arr, table, qf, k_pool, v_pool)
+    )(pos_arr, table, *inputs)
     return o.reshape(b, h, 1, d)

@@ -190,7 +190,7 @@ class _Swapped:
     compiled prompt bucket — restoring the pages bitwise keeps the
     request exactly where it was, mid-generation."""
     req: _Request
-    kv: np.ndarray                # (n_leaves, n_pages, hkv, page, d)
+    kv: list                      # per cache leaf: (n_pages, hkv, page, *)
     n_pages: int
     pos: int                      # last written position
     poff: int                     # prompt progress (mid-prefill victims)
@@ -252,10 +252,22 @@ class ContinuousBatcher:
                  speculate: int = 0, spec_ngram: int = 2,
                  prefix_cache: bool = False,
                  overlap: bool = True,
+                 kv_dtype=None,
                  mesh=None, tp_axis: str = "model"):
         self.params = params
         self.cfg = cfg
         self.slots = slots
+        # INT8 KV cache (``kv_dtype="int8"``): the pool stores int8 K/V
+        # with per-row float32 scales as extra rank-4 cache leaves
+        # ("ks"/"vs") — writes quantize inside the SAME compiled blocks
+        # (gen._forward_cached infers it from the pytree), the decode
+        # kernels dequantize in their tiles, and the scales ride the
+        # block tables / host-swap / prefix-sharing machinery because
+        # they are just more pool leaves indexed by page id.  Halves the
+        # HBM cache read per decode step vs bf16 AND roughly doubles the
+        # sequences a byte-budgeted page pool admits (gen.kv_bytes_per_
+        # token), which is the admission/preemption-pressure lever.
+        self.kv_dtype = gen.canon_kv_dtype(kv_dtype)
         # whole 512-slot blocks keep the decode kernel's tiles MXU-friendly
         self.max_len = gen.pad_cache_len(max_len)
         # IN-BATCHER SPECULATION (``speculate`` = n_spec > 0): each
@@ -354,14 +366,16 @@ class ContinuousBatcher:
             self.cache = gen.init_paged_cache(cfg, self.pool_pages,
                                               self.page,
                                               dtype=dtype or jnp.float32,
-                                              kv_heads=self.kv_heads)
+                                              kv_heads=self.kv_heads,
+                                              kv_dtype=self.kv_dtype)
             self.table = np.zeros((slots, self.pages_per_slot), np.int32)
             self.free_pages = deque(range(1, self.pool_pages))
             self.slot_pages: list[list[int]] = [[] for _ in range(slots)]
         else:
             self.cache = gen.init_cache(cfg, slots, self.kv_len,
                                         dtype=dtype or jnp.float32,
-                                        kv_heads=self.kv_heads)
+                                        kv_heads=self.kv_heads,
+                                        kv_dtype=self.kv_dtype)
         # PREFIX CACHING (paged only): full 512-token pages of prompt K/V
         # are content-addressed by a per-page CHAIN hash (page i's key
         # commits to every token before it, so matching hash == matching
@@ -613,14 +627,33 @@ class ContinuousBatcher:
                 "total_p95": float(np.percentile(total, 95))}
 
     def utilization(self) -> float:
-        """Slot-step utilization: (sampled emissions from decode
-        dispatches + in-block teacher-forced prefill steps) / dispatched
-        slot-steps.  Each batch-prefilled admission's first token came
-        from its prefill dispatch, not a slot-step — the single source
-        of truth for the BASELINE.md serving tables."""
+        """RAW DISPATCH slot-step utilization: (sampled emissions from
+        decode dispatches + in-block teacher-forced prefill steps) /
+        dispatched slot-steps.  Each batch-prefilled admission's first
+        token came from its prefill dispatch, not a slot-step — the
+        single source of truth for the BASELINE.md serving tables.
+
+        Under speculation (``speculate > 0``) ``slot_steps`` counts
+        dispatched VERIFY POSITIONS, so rejected proposals count as
+        dispatched work and this reads low BY DESIGN (0.18-0.28 on the
+        round-5 workloads) — use ``emitted_per_slot_step`` for the
+        acceptance-adjusted number (VERDICT r5 weak #4)."""
         s = self.stats
         return ((s["emitted_tokens"] - s["batch_admissions"]
                  + s["inblock_prefill_steps"])
+                / max(s["slot_steps"], 1))
+
+    def emitted_per_slot_step(self) -> float:
+        """ACCEPTANCE-ADJUSTED utilization: sampled emissions actually
+        delivered per dispatched slot-step.  Identical denominator to
+        ``utilization`` but the numerator counts only emitted tokens
+        (useful-positions accounting): under speculation this is
+        emissions per verify position — the number that stays meaningful
+        when rejected proposals inflate ``slot_steps`` — and without
+        speculation it differs from ``utilization`` only by the teacher-
+        forced in-block prefill steps."""
+        s = self.stats
+        return ((s["emitted_tokens"] - s["batch_admissions"])
                 / max(s["slot_steps"], 1))
 
     # -- compiled pieces --------------------------------------------------
@@ -630,6 +663,7 @@ class ContinuousBatcher:
         fn = self._prefill_fns.get(bucket)
         if fn is None:
             cfg, dtype = self.cfg, self.dtype
+            kv_dtype = self.kv_dtype
             tp = self.tp_axis if self.mesh is not None else None
 
             def prefill_body(params, prompt, true_len):
@@ -638,7 +672,8 @@ class ContinuousBatcher:
                 cache = gen.init_cache(cfg, 1, bucket,
                                        dtype=dtype or jnp.float32,
                                        kv_heads=params["layer0"]
-                                       ["wk"].shape[1])
+                                       ["wk"].shape[1],
+                                       kv_dtype=kv_dtype)
                 # single-row unembed at the last VALID prompt position —
                 # no (bucket, vocab) logits buffer for padded rows
                 logits, cache = gen._forward_cached(
@@ -1085,6 +1120,7 @@ class ContinuousBatcher:
         fn = self._chunk_fns.get((bucket, first))
         if fn is None:
             cfg, dtype = self.cfg, self.dtype
+            kv_dtype = self.kv_dtype
             c = self.prefill_chunk
             tp = self.tp_axis if self.mesh is not None else None
 
@@ -1101,7 +1137,8 @@ class ContinuousBatcher:
                     cache = gen.init_cache(cfg, 1, bucket,
                                            dtype=dtype or jnp.float32,
                                            kv_heads=params["layer0"]
-                                           ["wk"].shape[1])
+                                           ["wk"].shape[1],
+                                           kv_dtype=kv_dtype)
                     return run_chunk(params, cache, chunk, jnp.int32(0),
                                      unembed_idx)
                 donate = ()
@@ -1360,20 +1397,22 @@ class ContinuousBatcher:
 
     def _page_io_fns(self):
         """Compiled page gather/scatter for host-swap: the victim's pages
-        come back as ONE stacked array (one tunnel fetch), and restore
-        writes them into freshly allocated pages.  ``pids`` is padded to
+        come back as ONE dispatch whose per-leaf outputs land in a single
+        tuple fetch, and restore writes them into freshly allocated
+        pages.  Per-LEAF arrays rather than one ``jnp.stack``: the int8
+        pool's scale leaves ((P, hkv, page, 1) f32) share neither shape
+        nor dtype with the K/V leaves, and stacking would silently upcast
+        the non-quantized pool's leaves anyway.  ``pids`` is padded to
         ``pages_per_slot``; rows past ``n`` are ignored."""
         if self._gather_fn is None:
             @partial(jax.jit, static_argnums=(2,))
             def gather(cache, pids, n):
-                return jnp.stack([leaf[pids[:n]]
-                                  for leaf in jax.tree.leaves(cache)])
+                return [leaf[pids[:n]] for leaf in jax.tree.leaves(cache)]
 
             @partial(jax.jit, donate_argnums=compat.donate(0), static_argnums=(3,))
-            def scatter(cache, stacked, pids, n):
+            def scatter(cache, kv, pids, n):
                 leaves, td = jax.tree.flatten(cache)
-                out = [leaf.at[pids[:n]].set(stacked[i, :n]
-                                             .astype(leaf.dtype))
+                out = [leaf.at[pids[:n]].set(kv[i][:n].astype(leaf.dtype))
                        for i, leaf in enumerate(leaves)]
                 return jax.tree.unflatten(td, out)
 
@@ -1396,7 +1435,11 @@ class ContinuousBatcher:
         # while fetching at most 2x the owned pages (pad rows hit the
         # scratch page)
         n2 = min(self._pow2(n), self.pages_per_slot)
-        kv = np.asarray(gather(self.cache, jnp.asarray(pids), n2))[:, :n]
+        # ONE awaited fetch for all leaves (device_get starts every host
+        # copy before blocking — the per-leaf list must not degrade to
+        # one round-trip per leaf through the tunnel)
+        kv = [x[:n] for x in jax.device_get(
+            gather(self.cache, jnp.asarray(pids), n2))]
         self.swapped.append(_Swapped(
             req=occ, kv=kv, n_pages=n, pos=int(self.pos[victim]),
             poff=int(self.slot_poff[victim]),
@@ -1452,10 +1495,11 @@ class ContinuousBatcher:
             n2 = min(self._pow2(sw.n_pages), self.pages_per_slot)
             kv = sw.kv
             if n2 > sw.n_pages:
-                pad = np.zeros((kv.shape[0], n2 - sw.n_pages)
-                               + kv.shape[2:], kv.dtype)
-                kv = np.concatenate([kv, pad], axis=1)
-            self.cache = scatter(self.cache, jnp.asarray(kv),
+                kv = [np.concatenate(
+                    [x, np.zeros((n2 - sw.n_pages,) + x.shape[1:],
+                                 x.dtype)]) for x in kv]
+            self.cache = scatter(self.cache,
+                                 [jnp.asarray(x) for x in kv],
                                  jnp.asarray(pids), n2)
             self.occupant[slot] = sw.req
             self._set_slot_params(slot, sw.req)

@@ -30,21 +30,26 @@ from distributed_pytorch_tpu.models import transformer as tfm
 
 
 def teacher_forced_argmax(params, cfg, tokens, *, dtype, kernel: bool,
-                          paged: bool, page: int = 512):
+                          paged: bool, page: int = 512, kv_dtype=None):
     """(B, T) reference tokens -> (B, T-1) per-position next-token argmax
     through the DECODE path (every position fed one token at a time, the
-    path under measurement), plus the top1-top2 margin per position."""
+    path under measurement), plus the top1-top2 margin per position.
+    ``kv_dtype="int8"`` measures the quantized-cache path — the same
+    teacher-forcing isolates its per-position flip rate vs the bf16
+    cache exactly as for the kernel/XLA/paged path pairs."""
     b, t = tokens.shape
     max_len = gen.pad_cache_len(t)
     if paged:
         per = max_len // page
-        pool = gen.init_paged_cache(cfg, b * per + 1, page, dtype=dtype)
+        pool = gen.init_paged_cache(cfg, b * per + 1, page, dtype=dtype,
+                                    kv_dtype=kv_dtype)
         # contiguous pages per sequence; page 0 reserved scratch
         table = jnp.asarray(
             np.arange(1, b * per + 1, dtype=np.int32).reshape(b, per))
         cache = pool
     else:
-        cache = gen.init_cache(cfg, b, max_len, dtype=dtype)
+        cache = gen.init_cache(cfg, b, max_len, dtype=dtype,
+                               kv_dtype=kv_dtype)
         table = None
 
     toks = jnp.asarray(tokens)
@@ -69,6 +74,11 @@ def main():
     ap.add_argument("--tokens", type=int, default=10240)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--train-steps", type=int, default=60)
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="also measure the int8 KV-cache paths (dense + "
+                    "paged) against the bf16-cache reference — the "
+                    "numerics cost of kv_dtype=int8 as a flip RATE, "
+                    "same methodology")
     args = ap.parse_args()
 
     cfg = tfm.TransformerConfig(vocab_size=256, d_model=512, n_layers=4,
@@ -106,6 +116,19 @@ def main():
         "xla_dense": dict(kernel=False, paged=False),
         "kernel_paged": dict(kernel=True, paged=True),
     }
+    pairs = [("kernel_dense", "xla_dense"),
+             ("kernel_dense", "kernel_paged"),
+             ("xla_dense", "kernel_paged")]
+    if args.kv_int8:
+        paths["kernel_dense_int8"] = dict(kernel=True, paged=False,
+                                          kv_dtype="int8")
+        paths["kernel_paged_int8"] = dict(kernel=True, paged=True,
+                                          kv_dtype="int8")
+        # the quantization cost (int8 vs the bf16 cache, same kernel
+        # path) and the layout invariance within int8 (dense vs paged
+        # share the quantized rows, so this pair should be ~0)
+        pairs += [("kernel_dense", "kernel_dense_int8"),
+                  ("kernel_dense_int8", "kernel_paged_int8")]
     ams, margins = {}, {}
     for name, kw in paths.items():
         ams[name], margins[name] = teacher_forced_argmax(
@@ -117,9 +140,7 @@ def main():
            "near_tie_rate_lt_2e-2": float(np.mean(m < 2e-2)),
            "margin_p50": float(np.median(m)),
            "margin_p1": float(np.percentile(m, 1))}
-    for a, bname in (("kernel_dense", "xla_dense"),
-                     ("kernel_dense", "kernel_paged"),
-                     ("xla_dense", "kernel_paged")):
+    for a, bname in pairs:
         flips = int(np.sum(ams[a] != ams[bname]))
         out[f"flips_{a}_vs_{bname}"] = flips
         out[f"fliprate_{a}_vs_{bname}"] = flips / total

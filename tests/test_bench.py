@@ -49,7 +49,9 @@ def test_lm_flops_per_token_hand_count():
 def test_bench_json_keys_include_transformer_gates():
     """The driver-recorded JSON line must carry the round-4 gate keys
     (VERDICT round-3 #3) plus the round-6 hardened-window keys (p95
-    companions and the overlap A/B) — pin the schema without running
+    companions and the overlap A/B) and the round-7 int8-KV keys (the
+    kv_dtype knob, the per-step KV-bytes estimate, and the acceptance-
+    adjusted serving utilization) — pin the schema without running
     hardware."""
     import inspect
     src = inspect.getsource(bench.main)
@@ -58,8 +60,33 @@ def test_bench_json_keys_include_transformer_gates():
                 "serving_tokens_per_sec", "serving_tokens_per_sec_p95",
                 "serving_tokens_per_sec_no_overlap",
                 "serving_overlap_speedup",
-                "serving_slot_step_utilization"):
+                "serving_slot_step_utilization",
+                "kv_dtype", "decode_kv_bytes_per_step",
+                "serving_emitted_per_slot_step"):
         assert key in src, key
+    # the knob reaches both inference gates
+    assert "BENCH_KV_DTYPE" in src
+
+
+def test_bench_decode_kv_dtype_knob_and_bytes_estimate():
+    """The decode gate accepts kv_dtype and its analytic KV-bytes
+    estimate halves (modulo the scale overhead) from bf16 to int8 —
+    the predicted HBM effect the JSON carries next to the measured
+    ms/token."""
+    import inspect
+    import jax.numpy as jnp
+    from distributed_pytorch_tpu import generate as gen
+    sig = inspect.signature(bench.bench_decode)
+    assert "kv_dtype" in sig.parameters
+    assert "kv_dtype" in inspect.signature(
+        bench.bench_serving).parameters
+    cfg = bench._lm_cfg()
+    bf16 = gen.kv_bytes_per_token(cfg, dtype=jnp.bfloat16)
+    int8 = gen.kv_bytes_per_token(cfg, kv_dtype="int8")
+    assert 1.9 <= bf16 / int8 <= 2.0
+    # the estimate in bench_decode is B x mean_len x per-token bytes
+    src = inspect.getsource(bench.bench_decode)
+    assert "kv_bytes_per_token" in src
 
 
 def test_bench_decode_uses_hardened_window():

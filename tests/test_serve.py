@@ -961,6 +961,51 @@ def test_spec_serving_stats_identity(spec_params):
                - useful / s["slot_steps"]) < 1e-9
 
 
+def test_spec_acceptance_adjusted_utilization_pinned(spec_params):
+    """Acceptance-adjusted utilization under speculation (VERDICT r5
+    weak #4): the batcher reports BOTH raw dispatch utilization (verify
+    positions in the denominator — reads low by design when proposals
+    are rejected) and emitted-tokens-per-slot-step, and for a greedy
+    ``speculate>0`` workload both are deterministic — two identical runs
+    pin identical values satisfying the accounting identities."""
+    prompts, budgets = _spec_workload()
+
+    def make():
+        return ContinuousBatcher(spec_params, SPEC_CFG, slots=2,
+                                 max_len=512, temperature=0.0,
+                                 steps_per_sync=4, prompt_buckets=(32,),
+                                 speculate=4)
+
+    def run():
+        cb = make()
+        cb.run(prompts, max_new=8)
+        return cb
+
+    a, b = run(), run()
+    assert a.stats == b.stats  # greedy: fully deterministic
+    assert a.utilization() == b.utilization()
+    assert a.emitted_per_slot_step() == b.emitted_per_slot_step()
+    s = a.stats
+    assert a.emitted_per_slot_step() == (
+        (s["emitted_tokens"] - s["batch_admissions"])
+        / s["slot_steps"])
+    assert abs(a.utilization() - a.emitted_per_slot_step()
+               - s["inblock_prefill_steps"] / s["slot_steps"]) < 1e-12
+    # both live in (0, 1]; the adjusted metric never exceeds the raw one
+    assert 0.0 < a.emitted_per_slot_step() <= a.utilization() <= 1.0
+    # and the bench_serving JSON carries both keys
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import bench_serving as bs
+    rep = bs.run(make(), prompts, [8] * len(prompts))
+    assert rep["utilization"] == round(a.utilization(), 4)
+    assert rep["emitted_per_slot_step"] == round(
+        a.emitted_per_slot_step(), 4)
+
+
 # -- prefix caching -----------------------------------------------------------
 
 def _prefix_oracle(spec_params, p, b):
@@ -1202,17 +1247,21 @@ def test_overlap_accounting_matches_serial(params):
                                + s["wasted_slot_steps"]), s
 
 
-def test_overlap_zero_recompiles(params):
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_overlap_zero_recompiles(params, kv_dtype):
     """Compile-counter pin: chaining reuses the ONE compiled block
     program (the carry is an ordinary input — serial staging and
     device-fed chaining share shapes/dtypes), so an overlapped run adds
-    zero executable cache entries beyond the serial run's."""
+    zero executable cache entries beyond the serial run's — on the int8
+    KV path too (quantize/dequantize live INSIDE the block program;
+    the scale leaves are ordinary donated cache inputs)."""
     prompts = _ragged_workload(34, 4)
 
     def make(overlap):
         return ContinuousBatcher(params, CFG, slots=2, max_len=512,
                                  temperature=0.0, prompt_buckets=(32, 64),
-                                 steps_per_sync=8, overlap=overlap)
+                                 steps_per_sync=8, overlap=overlap,
+                                 kv_dtype=kv_dtype)
 
     cb_off = make(False)
     cb_off.run(prompts, max_new=20)

@@ -505,7 +505,8 @@ class TestQuantizedRingEF:
         round K), while the plain ring's bias accumulates ~linearly.  At
         K=50 the plain ring's cumulative error is ~50x EF's — this is why
         EF converges like exact sync."""
-        from jax import lax, shard_map
+        from jax import lax
+        from distributed_pytorch_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         n, K = 8, 50
@@ -553,7 +554,8 @@ class TestQuantizedRingEF:
         This is the 'converges like exact sync' claim on an objective where
         convergence distance is well-defined (VGG trajectories are chaotic
         amplifiers — any inexact sync diverges in trajectory there)."""
-        from jax import lax, shard_map
+        from jax import lax
+        from distributed_pytorch_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         n = 8
