@@ -164,6 +164,83 @@ def bench_tpu(batch_per_replica: int, warmup: int,
     return sps_chip, mfu
 
 
+def canon_overlap_env(value: str | None) -> bool:
+    """Validate the BENCH_OVERLAP knob ('1' = run the overlap A/B, the
+    default; '0' = skip it).  A typo must fail HERE, before any
+    measurement — inside the benches it would be swallowed by their
+    catch-alls while the JSON silently omitted the A/B (same contract as
+    BENCH_KV_DTYPE's pre-bench canonicalization)."""
+    if value is None or value == "" or value == "1":
+        return True
+    if value == "0":
+        return False
+    raise ValueError(
+        f"BENCH_OVERLAP must be '0' or '1', got {value!r} — refusing to "
+        f"guess which A/B you meant")
+
+
+def bench_train_overlap(batch_per_replica: int = 64, iters: int = 30,
+                        reps: int = 5) -> dict | None:
+    """In-session A/B of backward-overlapped gradient sync (round 8):
+    the SAME bucketed strategy (torch DDP's engine semantics) with the
+    bucket collectives emitted inside the backward graph (overlap=True)
+    vs after it (the historical post-backward path), VGG-11 bf16 on all
+    devices, >= ``reps`` alternating timed windows per mode with
+    median-of-reps (the hardened-window discipline of the serving
+    gates).  Needs >= 2 devices (there is no collective to overlap on
+    one chip) — returns None there, and the JSON carries nulls.
+
+    The two programs are bitwise-identical in results (test-pinned), so
+    the delta is pure schedule: on CPU meshes expect ~1.0x (XLA's CPU
+    backend runs thunks serially — the schedule proof lives in the
+    utils/debug.py inspector instead); on real ICI/DCN the collective
+    time hides under backward compute.
+    """
+    import jax
+
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _log("[bench] train-overlap A/B needs >= 2 devices "
+             f"(have {n_dev}); omitting")
+        return None
+    mesh = make_mesh(n_dev)
+
+    def build(overlap: bool) -> Trainer:
+        cfg = TrainConfig(strategy="bucketed", batch_size=batch_per_replica,
+                          steps_per_loop=iters, compute_dtype="bfloat16",
+                          overlap=overlap)
+        return Trainer(cfg, mesh=mesh)
+
+    trainers = {False: build(False), True: build(True)}
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        tr.precompile_steps(images, labels)
+        float(tr.train_steps(images, labels)[-1])
+
+    times: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            losses = tr.train_steps(images, labels)
+            float(losses[-1])  # fetch forces the whole donated chain
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[False] / max(med[True], 1e-9)
+    _log(f"[bench] train-overlap A/B (bucketed, VGG-11, {n_dev} dev): "
+         f"{med[True]:.2f} ms/step overlapped vs {med[False]:.2f} "
+         f"post-backward -> {speedup:.3f}x ({reps} reps median)")
+    return {"speedup": speedup, "ms_overlap": med[True],
+            "ms_post_backward": med[False]}
+
+
 def _lm_cfg():
     """The BASELINE.md LM measurement config: byte-vocab d512/4L
     transformer, flash attention, bf16."""
@@ -460,6 +537,9 @@ def main() -> None:
     if kv_dtype is not None:
         from distributed_pytorch_tpu import generate as _gen
         _gen.canon_kv_dtype(kv_dtype)
+    # Overlap A/B knob: validated pre-bench for the same reason (a typo'd
+    # BENCH_OVERLAP must not silently skip or force the A/B).
+    run_overlap = canon_overlap_env(os.environ.get("BENCH_OVERLAP"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -473,6 +553,16 @@ def main() -> None:
     except Exception as e:  # tiny-memory devices etc. — control is optional
         _log(f"[bench] calibration failed ({e}); omitting")
         calib = None
+
+    # Backward-overlap A/B (round 8): same strategy, collectives inside vs
+    # after the backward; optional like the other gates (the VGG headline
+    # must survive it failing).
+    overlap_ab = None
+    if run_overlap:
+        try:
+            overlap_ab = bench_train_overlap()
+        except Exception as e:
+            _log(f"[bench] train-overlap A/B failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -521,6 +611,17 @@ def main() -> None:
         # matmul chain — stable ±0.3%, so a genuine device/toolchain
         # change moves it while measurement noise does not (BASELINE.md)
         "calib_tflops": round(calib, 1) if calib is not None else None,
+        # backward-overlapped gradient sync A/B (round 8): median ms/step
+        # with the bucket collectives emitted inside vs after the backward
+        # (bitwise-identical programs otherwise); null on 1-device hosts
+        # or with BENCH_OVERLAP=0
+        "train_overlap_speedup": (round(overlap_ab["speedup"], 3)
+                                  if overlap_ab is not None else None),
+        "train_step_ms_overlap": (round(overlap_ab["ms_overlap"], 3)
+                                  if overlap_ab is not None else None),
+        "train_step_ms_post_backward": (
+            round(overlap_ab["ms_post_backward"], 3)
+            if overlap_ab is not None else None),
         # transformer-stack gates (BASELINE.md is the prose companion;
         # these keys are the regression source of truth since round 4)
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)

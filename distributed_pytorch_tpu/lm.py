@@ -98,6 +98,19 @@ class LMTrainConfig:
     # tick count.
     pp_remat_block: int | None = 0
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
+    # Backward-overlapped ZeRO-3 (round 8): gather each layer group's
+    # fsdp-sharded weights AT ITS LAYER BOUNDARY (transformer.apply
+    # boundary hook) instead of all-at-once before the stack — the
+    # forward's all_gathers stream layer by layer (peak weight memory
+    # drops from all-layers-resident to one group ahead) and, because the
+    # transpose of each gather is that layer's gradient reduce-scatter,
+    # the backward's reduce-scatters are emitted interleaved between the
+    # layers' backward matmuls for XLA's scheduler to overlap.  Bitwise-
+    # identical trajectories (same ops, moved).  Requires fsdp=True: the
+    # plain data-axis cotangent psums are synthesized by shard_map's
+    # transpose at each param's use site already, so without fsdp there
+    # is no post-backward cluster to dissolve.
+    overlap: bool = False
     # Gradient accumulation: split each global batch into grad_accum
     # microbatches, scan them accumulating gradients, apply ONE optimizer
     # step.  The CE gradient is EXACT (grads normalize by the full batch's
@@ -158,6 +171,23 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
             f"no-op: the slice-local data axis has size "
             f"dp // dcn_size = 1, so no leaf can shard over it — raise "
             f"dp (or drop fsdp)")
+    if cfg.overlap:
+        if not cfg.fsdp:
+            raise ValueError(
+                "lm overlap=True streams the ZeRO-3 (fsdp) weight gathers "
+                "and their reduce-scatter transposes through the layer "
+                "boundaries; without fsdp the data-axis cotangent psums "
+                "are already emitted at each param's use site by "
+                "shard_map's transpose — there is no post-backward "
+                "cluster to dissolve (BASELINE.md round 8).  Enable fsdp "
+                "or drop overlap (the VGG trainer's overlap=True covers "
+                "the explicit-strategy case)")
+        if cfg.dcn_size > 1:
+            raise ValueError(
+                "overlap does not compose with the factored (dcn) mesh: "
+                "its two-level sync point is a whole-tree custom-vjp "
+                "(_dcn_sync_point); streaming it per bucket is an open "
+                "item (ROADMAP.md)")
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -415,6 +445,31 @@ def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
     return jax.tree.unflatten(td, out)
 
 
+def _fsdp_group_boundary(cfg: LMTrainConfig, specs):
+    """The streaming ZeRO-3 hook (``cfg.overlap``): gather each layer
+    group's fsdp-sharded leaves at the group's boundary in
+    ``transformer.apply`` instead of all-at-once before the stack.  The
+    gathers are the SAME per-leaf ``all_gather`` ops as ``_fsdp_gather``
+    — only their position moves — so trajectories are bitwise-identical;
+    their transposes (the per-leaf gradient reduce-scatters) land
+    interleaved between the layers' backward matmuls, which is the whole
+    point (utils/debug.py op_schedule pins it)."""
+    # one source of truth for the boundary numbering: the model's own
+    # group schedule (transformer.sync_group_index), inverted to
+    # group-index -> top-level param key
+    keys = {v: k for k, v in tfm.sync_group_index(cfg.model).items()}
+
+    def boundary(group: int, params):
+        k = keys.get(group)
+        if k is None:
+            return params
+        p = dict(params)
+        p[k] = _fsdp_gather(params[k], specs[k])
+        return p
+
+    return boundary
+
+
 def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
     """The per-shard loss shared by every grad path.  ``dcn_sync``
     injects the custom-VJP two-level sync point on params (the a=1
@@ -433,14 +488,18 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
             # route the data-axis cotangent sync through the explicit
             # two-level reduction (shard-sized DCN payload)
             params = _dcn_sync_point(params, specs)
+        boundary = None
         if cfg.fsdp:
-            params = _fsdp_gather(params, specs)
+            if cfg.overlap:
+                boundary = _fsdp_group_boundary(cfg, specs)
+            else:
+                params = _fsdp_gather(params, specs)
         pos = _shard_positions(cfg, tokens.shape[1])
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                                 seq_axis=seq_axis, seq_layout=cfg.seq_layout,
                                 tp_axis=tp_axis, pos=pos,
                                 ep_axis=EXPERT if cfg.ep > 1 else None,
-                                return_aux=True)
+                                return_aux=True, boundary=boundary)
         ce_sum, _ = masked_ce(logits, targets)
         # Global mean over every shard's tokens; the batch shards over
         # (data, expert), so 'expert' reduces like a data axis ('model'

@@ -179,6 +179,21 @@ def shard_specs(cfg: TransformerConfig, *, tp_axis: str = "model",
     return specs
 
 
+def sync_group_index(cfg: TransformerConfig) -> dict[str, int]:
+    """Top-level param key -> forward layer-group index, the boundary
+    schedule ``apply(boundary=...)`` walks: the tied embedding first
+    (group 0 — it is consumed at BOTH ends of the stack, so its cotangent
+    completes only at the very end of the backward pass and any gradient
+    bucket holding it must fire at the earliest boundary), then the layers
+    in forward order, then final_norm.  Used by the overlap gradient-sync
+    machinery (parallel/strategies.OverlapSync via train-side wiring) and
+    by lm.py's streaming ZeRO-3 gather placement."""
+    idx = {"embed": 0, "final_norm": cfg.n_layers + 1}
+    for i in range(cfg.n_layers):
+        idx[f"layer{i}"] = i + 1
+    return idx
+
+
 def rms_norm(x: Array, scale: Array, eps: float) -> Array:
     x32 = x.astype(jnp.float32)
     rms = lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
@@ -328,6 +343,7 @@ def apply(
     pos0: Array | int = 0,         # absolute position of tokens[:, 0]
     pos: Array | None = None,      # explicit absolute positions (S,)
     return_aux: bool = False,
+    boundary=None,                 # layer-group hook (sync_group_index)
 ) -> Array | tuple[Array, Array]:
     """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
 
@@ -342,7 +358,16 @@ def apply(
     With ``return_aux`` the result is the tuple ``(logits, aux)`` where aux
     is this device's summed MoE load-balance loss (0.0 for dense models);
     callers average it across their mesh axes.
+
+    ``boundary``: a hook ``params = boundary(group, params)`` called at
+    every layer-group boundary of :func:`sync_group_index` in forward
+    order — value-identity, used to place per-group gradient-sync markers
+    or streaming ZeRO-3 gathers exactly where each group's params are
+    first consumed (lm.py overlap=True).  ``None`` traces the historical
+    graph.
     """
+    if boundary is not None:
+        params = boundary(0, params)  # the tied embedding's group
     x = params["embed"][tokens]  # (B, S, D)
     if dtype is not None:
         x = x.astype(dtype)
@@ -351,12 +376,16 @@ def apply(
     aux_total = jnp.zeros((), jnp.float32)
 
     for i in range(cfg.n_layers):
+        if boundary is not None:
+            params = boundary(i + 1, params)
         x, aux = block(
             params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
             pos=pos, attn_impl=attn_impl, seq_axis=seq_axis,
             seq_layout=seq_layout, tp_axis=tp_axis, ep_axis=ep_axis)
         aux_total = aux_total + aux
 
+    if boundary is not None:
+        params = boundary(cfg.n_layers + 1, params)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     if return_aux:

@@ -54,6 +54,22 @@ def _flatten_features(cfg: list) -> int:
     return [c for c in cfg if c != "M"][-1]
 
 
+def sync_group_index(name: str = "VGG11") -> dict[str, int]:
+    """Top-level param key -> forward layer-group index: conv+BN pairs in
+    forward order (group i = conv{i}/bn{i}), then the fc head as the last
+    group.  This is the boundary schedule ``apply(boundary=...)`` walks —
+    the overlap gradient-sync markers (parallel/strategies.OverlapSync) use
+    it to place each bucket's in-backward collective at the bucket's
+    earliest layer group, i.e. right where the bucket's last cotangent is
+    produced during the backward pass."""
+    n_conv = sum(1 for c in CFG[name] if c != "M")
+    idx = {"fc": n_conv}
+    for i in range(n_conv):
+        idx[f"conv{i}"] = i
+        idx[f"bn{i}"] = i
+    return idx
+
+
 def init(key: Array, name: str = "VGG11") -> tuple[PyTree, PyTree]:
     """Build (params, state) for a VGG variant.
 
@@ -90,6 +106,7 @@ def apply(
     dtype: jnp.dtype | None = None,
     bn_axis_name: str | None = None,
     fused_bn: bool | None = None,
+    boundary=None,
 ) -> tuple[Array, PyTree]:
     """Forward pass; returns (logits[B,10], new_state).
 
@@ -104,6 +121,14 @@ def apply(
     measured e2e slower and is a documented negative result; pass
     ``fused_bn=True`` to run the experiment.  The forward is
     bitwise-identical either way.
+
+    ``boundary`` (overlap gradient sync, train.py overlap=True): a hook
+    ``params = boundary(group, params)`` called at every layer-group
+    boundary in forward order — the groups of :func:`sync_group_index` —
+    letting parallel/strategies.OverlapSync wrap each gradient bucket's
+    params in a custom_vjp sync point exactly where the bucket's last
+    cotangent is produced in the backward pass.  The hook is an identity
+    on values; ``None`` (the default) traces the historical graph.
     """
     if dtype is not None:
         x = x.astype(dtype)
@@ -113,12 +138,16 @@ def apply(
         if layer_cfg == "M":
             x = ops.max_pool(x)
         else:
+            if boundary is not None:
+                params = boundary(idx, params)
             x = ops.conv2d(params[f"conv{idx}"], x)
             x, new_state[f"bn{idx}"] = ops.batchnorm_relu(
                 params[f"bn{idx}"], state[f"bn{idx}"], x,
                 train=train, axis_name=bn_axis_name, fused=fused_bn,
             )
             idx += 1
+    if boundary is not None:
+        params = boundary(idx, params)  # the fc head's group
     x = x.reshape(x.shape[0], -1)  # (B, 512); reference model.py:44
     logits = ops.dense(params["fc"], x)
     return logits.astype(jnp.float32), new_state

@@ -54,10 +54,78 @@ BUCKET_CAP_MB = 25  # torch DDP default bucket size
 
 
 class Strategy(Protocol):
+    """A stateless gradient-sync strategy: a pure grad-pytree transform.
+
+    Calling convention (the train step's contract, train.py scan body):
+    ``synced = strategy(grads, axis)`` with ``axis`` the mesh axis name the
+    collective runs over (a TUPLE of names for factored-axis strategies —
+    see ``Hierarchical.axes``), or None outside a mesh ('none' only).
+
+    Optional attributes the trainer consults:
+
+    - ``vma_opaque``: result is replicated by construction but not provably
+      so (ppermute-assembled) — the step compiles with ``check_vma=False``
+      and re-verifies replication dynamically after each fresh compile.
+    - ``axes``: factored mesh axis names this strategy needs.
+    - ``supports_overlap`` + ``sync_bucket``: the strategy can run as
+      in-backward bucket collectives (``OverlapSync``; train.py
+      ``overlap=True``).
+    """
+
     name: str
     needs_mesh: bool
 
     def __call__(self, grads: PyTree, axis: str) -> PyTree: ...
+
+
+class StatefulStrategy(Protocol):
+    """A gradient-sync strategy carrying per-device state between steps
+    (error-feedback residuals).  The train step calls it as
+
+        ``synced, new_state = strategy(grads, axis, sync_state)``
+
+    (train.py scan body), threading ``sync_state`` through the K-step scan
+    carry next to BN state; ``init_state(params, n_axis)`` builds the
+    per-device zero state (the Trainer stacks it with a leading device
+    axis).  Stateless strategies thread a zero-size dummy through the same
+    carry slot and are called with the two-argument form above — the
+    ``stateful`` attribute (True here, absent/False on ``Strategy``) is
+    what selects the calling convention.
+    """
+
+    name: str
+    needs_mesh: bool
+    stateful: bool
+
+    def init_state(self, params: PyTree, n_axis: int) -> jax.Array: ...
+
+    def __call__(self, grads: PyTree, axis: str,
+                 sync_state: jax.Array) -> tuple[PyTree, jax.Array]: ...
+
+
+def make_bucket_plan(leaves: list, bucket_bytes: int) -> list[list[int]]:
+    """Pack leaf indices into ~``bucket_bytes`` buckets in REVERSE flatten
+    order (torch DDP's Reducer packing, reference main_ddp.py:137's engine:
+    late-backward/output-side grads fill the first-reduced bucket), the one
+    packing shared by ``Bucketed``, the int8 ring strategies, and the
+    in-backward overlap markers (``OverlapSync``) — so overlap=True and the
+    post-backward path always agree on bucket membership.
+
+    Indices within each bucket are returned ASCENDING (tree order): packing
+    order decides membership only, concatenation layout stays the flatten
+    order — which keeps the single-bucket case (trees under the cap)
+    byte-identical to the historical whole-tree flattening.
+    """
+    buckets: list[list[int]] = [[]]
+    size = 0
+    for i in reversed(range(len(leaves))):
+        nbytes = leaves[i].size * leaves[i].dtype.itemsize
+        if size + nbytes > bucket_bytes and buckets[-1]:
+            buckets.append([])
+            size = 0
+        buckets[-1].append(i)
+        size += nbytes
+    return [sorted(b) for b in buckets]
 
 
 def _chain(leaf: jax.Array, token: jax.Array) -> jax.Array:
@@ -209,9 +277,16 @@ class DDP:
 
     name = "ddp"
     needs_mesh = True
+    supports_overlap = True
+    bucket_bytes = BUCKET_CAP_MB * 1024 * 1024  # overlap marker grouping only
 
     def __call__(self, grads: PyTree, axis: str) -> PyTree:
         return jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+
+    def sync_bucket(self, leaves: list, axis: str) -> list:
+        # per-leaf pmean: identical ops to __call__, so overlap=True is
+        # bitwise-equal to the post-backward path regardless of bucketing
+        return [lax.pmean(g, axis) for g in leaves]
 
 
 class Bucketed:
@@ -221,33 +296,32 @@ class Bucketed:
 
     name = "bucketed"
     needs_mesh = True
+    supports_overlap = True
 
-    def __init__(self, bucket_mb: int = BUCKET_CAP_MB):
-        self.bucket_bytes = bucket_mb * 1024 * 1024
+    def __init__(self, bucket_mb: float = BUCKET_CAP_MB):
+        self.bucket_bytes = int(bucket_mb * 1024 * 1024)
+
+    def sync_bucket(self, leaves: list, axis: str) -> list:
+        """One packed psum-mean over these leaves (a single bucket).  The
+        psum is elementwise over devices, so the result is independent of
+        how leaves are packed into buckets — post-backward and overlap
+        bucketing agree bitwise whatever the bucket boundaries."""
+        n = lax.axis_size(axis)
+        flat = jnp.concatenate([g.ravel() for g in leaves])
+        flat = lax.psum(flat, axis) / n
+        out, offset = [], 0
+        for g in leaves:
+            out.append(flat[offset:offset + g.size].reshape(g.shape))
+            offset += g.size
+        return out
 
     def __call__(self, grads: PyTree, axis: str) -> PyTree:
-        n = lax.axis_size(axis)
         leaves, treedef = jax.tree.flatten(grads)
-        # Pack in reverse so late-backward (output-side) grads share the
-        # first-reduced bucket, like torch DDP's reversed bucket order.
-        buckets: list[list[int]] = [[]]
-        size = 0
-        for i in reversed(range(len(leaves))):
-            nbytes = leaves[i].size * leaves[i].dtype.itemsize
-            if size + nbytes > self.bucket_bytes and buckets[-1]:
-                buckets.append([])
-                size = 0
-            buckets[-1].append(i)
-            size += nbytes
         out: list[jax.Array | None] = [None] * len(leaves)
-        for bucket in buckets:
-            flat = jnp.concatenate([leaves[i].ravel() for i in bucket])
-            flat = lax.psum(flat, axis) / n
-            offset = 0
-            for i in bucket:
-                g = leaves[i]
-                out[i] = flat[offset : offset + g.size].reshape(g.shape)
-                offset += g.size
+        for bucket in make_bucket_plan(leaves, self.bucket_bytes):
+            synced = self.sync_bucket([leaves[i] for i in bucket], axis)
+            for i, s in zip(bucket, synced):
+                out[i] = s
         return jax.tree.unflatten(treedef, out)
 
 
@@ -268,23 +342,30 @@ class QuantizedAllReduce:
 
     name = "quantized"
     needs_mesh = True
+    supports_overlap = True
+    bucket_bytes = BUCKET_CAP_MB * 1024 * 1024  # overlap marker grouping only
 
     def __init__(self, bits: int = 8):
         self.levels = 2 ** (bits - 1) - 1  # 127 for int8
 
+    def _sync_leaf(self, g: jax.Array, axis: str, n) -> jax.Array:
+        g32 = g.astype(jnp.float32)
+        absmax = lax.pmax(jnp.max(jnp.abs(g32)), axis)
+        scale = jnp.maximum(absmax / self.levels, 1e-30)
+        q = jnp.clip(jnp.round(g32 / scale), -self.levels,
+                     self.levels).astype(jnp.int8)
+        summed = lax.psum(q.astype(jnp.int32), axis)
+        return (summed.astype(jnp.float32) * scale / n).astype(g.dtype)
+
     def __call__(self, grads: PyTree, axis: str) -> PyTree:
         n = lax.axis_size(axis)
+        return jax.tree.map(lambda g: self._sync_leaf(g, axis, n), grads)
 
-        def sync(g):
-            g32 = g.astype(jnp.float32)
-            absmax = lax.pmax(jnp.max(jnp.abs(g32)), axis)
-            scale = jnp.maximum(absmax / self.levels, 1e-30)
-            q = jnp.clip(jnp.round(g32 / scale), -self.levels,
-                         self.levels).astype(jnp.int8)
-            summed = lax.psum(q.astype(jnp.int32), axis)
-            return (summed.astype(jnp.float32) * scale / n).astype(g.dtype)
-
-        return jax.tree.map(sync, grads)
+    def sync_bucket(self, leaves: list, axis: str) -> list:
+        # per-leaf quantized all-reduce (the scale is per TENSOR, so the
+        # bucket grouping cannot change numerics vs the post-backward path)
+        n = lax.axis_size(axis)
+        return [self._sync_leaf(g, axis, n) for g in leaves]
 
 
 class QuantizedRing:
@@ -313,10 +394,28 @@ class QuantizedRing:
     name = "quantized_ring"
     needs_mesh = True
     vma_opaque = True  # replication holds by construction, not by proof
+    supports_overlap = True
 
-    def __init__(self, bits: int = 8, block: int = 256):
+    def __init__(self, bits: int = 8, block: int = 256,
+                 bucket_mb: float = BUCKET_CAP_MB):
         self.levels = 2 ** (bits - 1) - 1
         self.block = block
+        # One ring per ~bucket_mb bucket (make_bucket_plan, round 8): the
+        # per-hop block scales are computed within each bucket's own flat
+        # vector, so the ring's numerics depend on bucket LAYOUT — which is
+        # why overlap=True and the post-backward path share one plan (and
+        # why trees under the cap, every pre-round-8 test tree included,
+        # pack to a single bucket bitwise-identical to the old whole-tree
+        # flattening).
+        self.bucket_bytes = int(bucket_mb * 1024 * 1024)
+
+    def _plan(self, leaves: list) -> list[list[int]]:
+        return make_bucket_plan(leaves, self.bucket_bytes)
+
+    def _chunk(self, total: int, n: int) -> int:
+        """Per-device ring chunk (block-aligned) for a ``total``-element
+        flat vector over an ``n``-way ring."""
+        return -(-total // (n * self.block)) * self.block
 
     def _quant(self, x: jax.Array):
         xb = x.reshape(-1, self.block)
@@ -399,24 +498,34 @@ class QuantizedRing:
         summed = (q_all.astype(jnp.float32) * s_all).reshape(-1)[:total]
         return summed, err_rows
 
-    def _unflatten(self, mean: jax.Array, leaves, treedef) -> PyTree:
+    def _split(self, mean: jax.Array, leaves: list) -> list:
         out, offset = [], 0
         for g in leaves:
             out.append(mean[offset:offset + g.size]
                        .reshape(g.shape).astype(g.dtype))
             offset += g.size
-        return jax.tree.unflatten(treedef, out)
+        return out
 
-    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+    def sync_bucket(self, leaves: list, axis: str) -> list:
+        """One int8 ring over this bucket's flat (tree-order) vector."""
         n = lax.axis_size(axis)
-        leaves, treedef = jax.tree.flatten(grads)
         flat = jnp.concatenate([g.ravel().astype(jnp.float32)
                                 for g in leaves])
         if n == 1:
             mean = flat
         else:
-            mean, _ = self._ring_sum(flat, axis, n)
-        return self._unflatten(mean / n, leaves, treedef)
+            summed, _ = self._ring_sum(flat, axis, n)
+            mean = summed / n
+        return self._split(mean, leaves)
+
+    def __call__(self, grads: PyTree, axis: str) -> PyTree:
+        leaves, treedef = jax.tree.flatten(grads)
+        out: list[jax.Array | None] = [None] * len(leaves)
+        for bucket in self._plan(leaves):
+            synced = self.sync_bucket([leaves[i] for i in bucket], axis)
+            for i, s in zip(bucket, synced):
+                out[i] = s
+        return jax.tree.unflatten(treedef, out)
 
 
 class QuantizedRingEF(QuantizedRing):
@@ -438,34 +547,66 @@ class QuantizedRingEF(QuantizedRing):
 
     i.e. nothing is ever lost — only delayed one step.
 
-    State: one f32 vector per device (the padded flat gradient size),
-    carried through the train step's scan like BN state (leading device
-    axis, sharded over the data axis).  Dropping the state on restart is
-    safe (residuals re-accumulate within a step).
+    State: one f32 vector per device — the per-bucket padded residuals
+    concatenated in bucket-plan order (a single segment, the padded flat
+    gradient size, for trees under the bucket cap) — carried through the
+    train step's scan like BN state (leading device axis, sharded over the
+    data axis).  Dropping the state on restart is safe (residuals
+    re-accumulate within a step).  Under ``overlap=True`` the same layout
+    threads through the scan carry with each bucket's segment consumed and
+    refilled by that bucket's in-backward marker (``OverlapSync``).
     """
 
     name = "quantized_ring_ef"
     stateful = True  # __call__ takes and returns the residual carry
 
+    def state_segments(self, leaves: list, n_axis: int) -> list[int]:
+        """Per-bucket residual lengths (n_axis * block-aligned chunk), in
+        bucket-plan order — the layout contract between ``init_state``,
+        ``__call__``, and the overlap markers."""
+        return [n_axis * self._chunk(sum(leaves[i].size for i in bucket),
+                                     n_axis)
+                for bucket in self._plan(leaves)]
+
     def init_state(self, params: PyTree, n_axis: int) -> jax.Array:
         """Per-device zero residual for a gradient pytree shaped like
         ``params`` over an ``n_axis``-way ring (local, unstacked view)."""
-        total = sum(leaf.size for leaf in jax.tree.leaves(params))
-        chunk = -(-total // (n_axis * self.block)) * self.block
-        return jnp.zeros((n_axis * chunk,), jnp.float32)
+        leaves = jax.tree.leaves(params)
+        return jnp.zeros((sum(self.state_segments(leaves, n_axis)),),
+                         jnp.float32)
 
-    def __call__(self, grads: PyTree, axis: str,
-                 residual: jax.Array) -> tuple[PyTree, jax.Array]:
+    def sync_bucket(self, leaves: list, axis: str,
+                    residual: jax.Array) -> tuple[list, jax.Array]:
+        """One error-feedback int8 ring over this bucket; ``residual`` is
+        the bucket's state segment, returned updated."""
         n = lax.axis_size(axis)
-        leaves, treedef = jax.tree.flatten(grads)
         flat = jnp.concatenate([g.ravel().astype(jnp.float32)
                                 for g in leaves])
         if n == 1:
             mean, new_res = flat, jnp.zeros_like(residual)
         else:
-            mean, err_rows = self._ring_sum(flat, axis, n, residual=residual)
-            new_res = err_rows.ravel()
-        return self._unflatten(mean / n, leaves, treedef), new_res
+            summed, err_rows = self._ring_sum(flat, axis, n,
+                                              residual=residual)
+            mean, new_res = summed / n, err_rows.ravel()
+        return self._split(mean, leaves), new_res
+
+    def __call__(self, grads: PyTree, axis: str,
+                 residual: jax.Array) -> tuple[PyTree, jax.Array]:
+        n = lax.axis_size(axis)
+        leaves, treedef = jax.tree.flatten(grads)
+        out: list[jax.Array | None] = [None] * len(leaves)
+        segs = self.state_segments(leaves, n)
+        new_parts, offset = [], 0
+        for bucket, seg in zip(self._plan(leaves), segs):
+            synced, new_r = self.sync_bucket(
+                [leaves[i] for i in bucket], axis,
+                residual[offset:offset + seg])
+            offset += seg
+            new_parts.append(new_r)
+            for i, s in zip(bucket, synced):
+                out[i] = s
+        return (jax.tree.unflatten(treedef, out),
+                jnp.concatenate(new_parts))
 
 
 class Hierarchical:
@@ -506,17 +647,35 @@ class Hierarchical:
     name = "hierarchical"
     needs_mesh = True
     axes = ("dcn", "ici")  # outer = cross-slice (slow), inner = within-slice
+    supports_overlap = True
+    bucket_bytes = BUCKET_CAP_MB * 1024 * 1024
+
+    @staticmethod
+    def _factor(axis) -> tuple[str | None, str]:
+        if isinstance(axis, str):
+            return None, axis
+        dcn, ici = axis
+        return dcn, ici
 
     def __call__(self, grads: PyTree, axis) -> PyTree:
-        if isinstance(axis, str):
-            dcn, ici = None, axis
-        else:
-            dcn, ici = axis
+        dcn, ici = self._factor(axis)
         n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
         # the mean division happens on the f32 sum INSIDE two_level_psum
         # (before the cast back to leaf dtype): low-precision leaves must
         # not see the undivided sum, which can overflow their range
         return two_level_psum(grads, dcn, ici, scale=1.0 / n)
+
+    def sync_bucket(self, leaves: list, axis) -> list:
+        # one two-level (reduce-scatter / shard-sized DCN psum / gather)
+        # reduction per bucket; sums are elementwise over devices, so the
+        # result is packing-independent ONLY within a bucket — unlike psum
+        # strategies, the reduce-scatter pads each bucket's own flat
+        # vector, so post-backward (whole-tree) and overlap (per-bucket)
+        # differ in f32 summation grouping by nothing: the two-level
+        # algorithm sums the same addends per element either way.
+        dcn, ici = self._factor(axis)
+        n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
+        return two_level_psum(leaves, dcn, ici, scale=1.0 / n)
 
 
 def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
@@ -561,6 +720,175 @@ def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
     return jax.tree.unflatten(treedef, out)
 
 
+# -- backward-overlapped gradient sync (round 8) ---------------------------
+#
+# The one trick torch DDP plays that the post-backward strategies above do
+# not: its Reducer launches each ~25 MB bucket's all-reduce from a C++
+# autograd hook the moment the bucket's gradients are produced, hiding the
+# collective under the remaining backward compute.  The JAX analogue is a
+# custom_vjp identity ("sync point") wrapping each bucket's params at the
+# bucket's EARLIEST layer-group boundary in the model's forward pass: the
+# transpose visits forward equations in reverse, so the marker's backward
+# rule — which runs the bucket's collective on the accumulated cotangents —
+# lands in the backward graph immediately after that layer group's backward
+# matmuls, with every later bucket's collective already emitted.  XLA's
+# latency-hiding scheduler can then run bucket N's collective concurrently
+# with layer N-1's backward dot_generals (utils/debug.py op_schedule pins
+# the interleaving; train.py overlap=True wires it up).
+
+def sync_boundary(tree: PyTree, sync_fn: Callable[[PyTree], PyTree],
+                  group_id: int | str | None = None) -> PyTree:
+    """Identity on ``tree`` whose BACKWARD applies ``sync_fn`` to the
+    accumulated cotangents at this position in the backward graph — the
+    in-backward bucket collective of overlap mode.  ``group_id`` is
+    documentation/debugging only (the layer group whose boundary this is).
+    """
+
+    @jax.custom_vjp
+    def point(t):
+        return t
+
+    def fwd(t):
+        return t, None
+
+    def bwd(_, ct):
+        return (sync_fn(ct),)
+
+    point.defvjp(fwd, bwd)
+    return point(tree)
+
+
+def sync_boundary_stateful(
+        tree: PyTree, residual: jax.Array,
+        sync_fn: Callable[[PyTree, jax.Array], tuple[PyTree, jax.Array]],
+        group_id: int | str | None = None) -> PyTree:
+    """``sync_boundary`` for stateful (error-feedback) strategies: the
+    residual rides the forward as an inert input and its COTANGENT channel
+    carries the updated residual out of the backward — differentiate the
+    loss w.r.t. ``(params, sync_state)`` and the sync-state "gradient" IS
+    the next step's residual carry (train.py overlap=True threads it back
+    into the scan carry).  ``sync_fn(cotangents, residual) -> (synced,
+    new_residual)``."""
+
+    @jax.custom_vjp
+    def point(t, r):
+        return t
+
+    def fwd(t, r):
+        return t, r
+
+    def bwd(r, ct):
+        synced, new_r = sync_fn(ct, r)
+        return synced, new_r
+
+    point.defvjp(fwd, bwd)
+    return point(tree, residual)
+
+
+def _leaf_group(path, group_index: dict) -> int:
+    """Map a leaf's tree path to its model layer group via the top-level
+    key (models expose ``sync_group_index``)."""
+    entry = path[0]
+    key = getattr(entry, "key", None)
+    if key is None:  # tuple-style paths on older tree_util
+        key = str(entry)
+    try:
+        return group_index[key]
+    except KeyError:
+        raise ValueError(
+            f"param key {key!r} missing from the model's sync_group_index "
+            f"map; overlap needs every top-level param entry assigned to a "
+            f"forward layer group") from None
+
+
+class OverlapSync:
+    """Per-trace orchestrator for backward-overlapped gradient sync.
+
+    Packs the param tree's leaves into reverse-topological ~bucket_bytes
+    buckets (``make_bucket_plan`` — the SAME plan the bucketed/ring
+    strategies use post-backward, so overlap=True compares bitwise against
+    an equally-bucketed post-backward step), then inserts one sync-point
+    marker per bucket at the bucket's earliest layer-group boundary.
+
+    Usage (inside the loss function, fresh per trace):
+
+        ov = OverlapSync(strategy, axis, params, model.sync_group_index(...),
+                         sync_state=residual_or_None)
+        logits = model.apply(params, ..., boundary=ov.boundary)
+
+    The model calls ``params = boundary(group, params)`` at each layer-group
+    boundary in forward order; the returned tree has the due buckets' leaves
+    wrapped so their cotangents are synced in-backward.  For stateful
+    strategies the residual's updated value comes back as the sync_state
+    argument's gradient (see ``sync_boundary_stateful``).
+    """
+
+    def __init__(self, strategy, axis, params: PyTree,
+                 group_index: dict, *, sync_state: jax.Array | None = None):
+        if not getattr(strategy, "supports_overlap", False):
+            raise ValueError(
+                f"strategy {strategy.name!r} does not support overlap=True; "
+                f"overlap-capable strategies: {overlap_capable()}")
+        self.strategy, self.axis = strategy, axis
+        flat, self.treedef = jax.tree_util.tree_flatten_with_path(params)
+        self.leaves = [leaf for _, leaf in flat]
+        groups = [_leaf_group(path, group_index) for path, _ in flat]
+        self.plan = make_bucket_plan(self.leaves, strategy.bucket_bytes)
+        self.stateful = getattr(strategy, "stateful", False)
+        if self.stateful:
+            if sync_state is None:
+                raise ValueError(
+                    f"stateful strategy {strategy.name!r} needs sync_state "
+                    f"for overlap (the per-device EF residual)")
+            segs = strategy.state_segments(self.leaves,
+                                           lax.axis_size(axis))
+            offs = [0]
+            for s in segs:
+                offs.append(offs[-1] + s)
+            self._res = [sync_state[a:b] for a, b in zip(offs, offs[1:])]
+        # bucket b fires at the boundary of its earliest forward group:
+        # by then every later group's backward (hence every cotangent the
+        # bucket needs) is complete
+        self._due: dict[int, list[int]] = {}
+        for b, bucket in enumerate(self.plan):
+            trigger = min(groups[i] for i in bucket)
+            self._due.setdefault(trigger, []).append(b)
+        self._marked: set[int] = set()
+
+    def boundary(self, group: int, params: PyTree) -> PyTree:
+        """Mark the buckets due at this layer-group boundary; returns the
+        params tree with those buckets' leaves replaced by sync-point
+        outputs (identity forward, in-backward collective)."""
+        due = self._due.get(group)
+        if not due:
+            return params
+        leaves = [leaf for _, leaf in
+                  jax.tree_util.tree_flatten_with_path(params)[0]]
+        # later boundaries must see earlier markers' outputs: refresh from
+        # the incoming tree, then overlay this boundary's markers
+        self.leaves = leaves
+        for b in due:
+            assert b not in self._marked, (b, group)
+            self._marked.add(b)
+            bucket = self.plan[b]
+            sub = tuple(self.leaves[i] for i in bucket)
+            if self.stateful:
+                def sync_fn(ct, r):
+                    synced, new_r = self.strategy.sync_bucket(
+                        list(ct), self.axis, r)
+                    return tuple(synced), new_r
+                marked = sync_boundary_stateful(sub, self._res[b], sync_fn,
+                                                group_id=group)
+            else:
+                def sync_fn(ct):
+                    return tuple(self.strategy.sync_bucket(list(ct),
+                                                           self.axis))
+                marked = sync_boundary(sub, sync_fn, group_id=group)
+            for i, m in zip(bucket, marked):
+                self.leaves[i] = m
+        return jax.tree_util.tree_unflatten(self.treedef, self.leaves)
+
+
 _REGISTRY: dict[str, Callable[[], Strategy]] = {
     "none": NoSync,
     "all_reduce": AllReduce,
@@ -588,3 +916,14 @@ def get(name: str) -> Strategy:
 
 def available() -> list[str]:
     return sorted(_REGISTRY)
+
+
+def overlap_capable() -> list[str]:
+    """Strategies usable with ``TrainConfig(overlap=True)`` (they expose
+    ``sync_bucket``, the per-bucket collective the in-backward markers
+    call).  The sequential-by-design baselines (all_reduce, the
+    gather_scatter pair) are deliberately excluded: their point is the
+    serialized wire pattern overlap would dissolve (module docstring,
+    'preserving naivety on purpose')."""
+    return sorted(n for n, c in _REGISTRY.items()
+                  if getattr(c, "supports_overlap", False))

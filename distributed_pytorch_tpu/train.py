@@ -53,6 +53,20 @@ class TrainConfig:
     weight_decay: float = 1e-4    # main.py:104
     batch_size: int = 256         # per replica (main.py:18)
     strategy: str = "ddp"
+    # Backward-overlapped gradient sync (round 8): emit each ~25 MB
+    # bucket's collective INSIDE the backward graph at the bucket's layer-
+    # group boundary (custom_vjp sync points — strategies.OverlapSync), so
+    # XLA's latency-hiding scheduler can run bucket N's reduction under
+    # layer N-1's backward matmuls, instead of starting all collectives
+    # only after the backward fully drains.  Requires a mesh and an
+    # overlap-capable strategy (strategies.overlap_capable()); numerics
+    # are bitwise-identical to the post-backward path (test-pinned).
+    overlap: bool = False
+    # Bucket size for overlap packing (and for the bucketed/ring
+    # strategies' internal packing); None keeps each strategy's default
+    # (torch DDP's 25 MB).  Small values force many buckets — useful for
+    # schedule inspection on tiny models.
+    overlap_bucket_mb: float | None = None
     # Number of slices for the 'hierarchical' strategy: the data axis
     # factors into Mesh(('dcn', 'ici')) with dcn_size slices (cross-slice
     # DCN traffic drops to payload/ici — see strategies.Hierarchical).
@@ -104,18 +118,45 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def _loss_fn(params, state, key, images, labels, *, cfg: TrainConfig,
-             bn_axis: str | None):
-    """Forward + loss on one replica's shard; images are raw uint8 NHWC."""
+             bn_axis: str | None, boundary=None):
+    """Forward + loss on one replica's shard; images are raw uint8 NHWC.
+    ``boundary`` threads the overlap sync hook into the model's layer-group
+    boundaries (vgg.apply; None = historical graph, byte-identical)."""
     if cfg.augment:
         x = aug.augment(key, images)
     else:
         x = aug.normalize(images)
     logits, new_state = vgg.apply(
         params, state, x, name=cfg.model, train=True,
-        dtype=cfg.dtype, bn_axis_name=bn_axis,
+        dtype=cfg.dtype, bn_axis_name=bn_axis, boundary=boundary,
     )
     loss = ops.cross_entropy_loss(logits, labels)
     return loss, new_state
+
+
+def _apply_bucket_mb(cfg: TrainConfig, strategy: strat.Strategy) -> None:
+    """Propagate cfg.overlap_bucket_mb into the strategy's packing knob
+    (shared by the overlap markers and the bucketed/ring post-backward
+    paths, so both modes always agree on bucket membership)."""
+    if cfg.overlap_bucket_mb is not None and hasattr(strategy,
+                                                     "bucket_bytes"):
+        strategy.bucket_bytes = int(cfg.overlap_bucket_mb * 1024 * 1024)
+
+
+def _validate_overlap(cfg: TrainConfig, strategy: strat.Strategy,
+                      mesh: Mesh | None) -> None:
+    if not cfg.overlap:
+        return
+    if mesh is None:
+        raise ValueError(
+            "overlap=True requires a mesh: the data-axis collectives are "
+            "the thing being overlapped with backward compute")
+    if not getattr(strategy, "supports_overlap", False):
+        raise ValueError(
+            f"strategy {strategy.name!r} does not support overlap=True; "
+            f"overlap-capable strategies: {strat.overlap_capable()} (the "
+            f"sequential baselines keep their serialized wire pattern on "
+            f"purpose)")
 
 
 def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
@@ -182,6 +223,37 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     grad_fn = jax.value_and_grad(
         partial(_loss_fn, cfg=cfg, bn_axis=bn_axis), has_aux=True)
 
+    # Backward-overlapped sync (round 8): the loss traces with per-bucket
+    # custom_vjp sync points at the model's layer-group boundaries, so
+    # value_and_grad returns ALREADY-SYNCED grads with each bucket's
+    # collective emitted inside the backward graph; the post-backward
+    # strategy call is skipped.  Stateful (EF) strategies differentiate
+    # w.r.t. the residual too — its "gradient" is the updated residual
+    # (strategies.sync_boundary_stateful), threaded back into the scan
+    # carry exactly like the post-backward path's returned state.
+    overlap = cfg.overlap
+    _validate_overlap(cfg, strategy, mesh)
+    _apply_bucket_mb(cfg, strategy)
+    if overlap:
+        group_idx = vgg.sync_group_index(cfg.model)
+
+        def _ov_loss(params, state, key, images, labels):
+            ov = strat.OverlapSync(strategy, data_axes, params, group_idx)
+            return _loss_fn(params, state, key, images, labels, cfg=cfg,
+                            bn_axis=bn_axis, boundary=ov.boundary)
+
+        def _ov_loss_stateful(params, sync_state, state, key, images,
+                              labels):
+            ov = strat.OverlapSync(strategy, data_axes, params, group_idx,
+                                   sync_state=sync_state)
+            return _loss_fn(params, state, key, images, labels, cfg=cfg,
+                            bn_axis=bn_axis, boundary=ov.boundary)
+
+        grad_fn_ov = (jax.value_and_grad(_ov_loss_stateful, argnums=(0, 1),
+                                         has_aux=True)
+                      if stateful
+                      else jax.value_and_grad(_ov_loss, has_aux=True))
+
     # Chaos-harness plumbing: with an installed STEP-KEYED FaultPlan
     # (nan/inf grad, loss spike) the compiled step gains ONE trailing f32
     # arg (the host's arm_window gate for the in-jit taps); the clean
@@ -204,10 +276,24 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                 local_params = _as_varying(params, axis)
             else:
                 local_params = params
-            (loss, state), grads = grad_fn(local_params, state, k, imgs, lbls)
+            if overlap:
+                # grads arrive pre-synced (in-backward bucket collectives);
+                # the chaos taps therefore land POST-sync here — an
+                # injected NaN still poisons params and trips the health
+                # flag, it just no longer rides the wire first
+                if stateful:
+                    (loss, state), (grads, sync_state) = grad_fn_ov(
+                        local_params, sync_state, state, k, imgs, lbls)
+                else:
+                    (loss, state), grads = grad_fn_ov(
+                        local_params, state, k, imgs, lbls)
+            else:
+                (loss, state), grads = grad_fn(local_params, state, k,
+                                               imgs, lbls)
             # chaos-harness taps: trace-time no-ops unless a FaultPlan is
-            # installed (utils/faults.py) — pre-sync, so an injected bad
-            # shard propagates through the collective like a real one
+            # installed (utils/faults.py) — pre-sync on the post-backward
+            # path, so an injected bad shard propagates through the
+            # collective like a real one
             grads = faults.tap_grads(grads, step, fault_arm)
             loss = faults.tap_loss(loss, step, fault_arm)
             if bcast_buffers and axis is not None:
@@ -225,10 +311,11 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                             jnp.where(idx == 0, s, jnp.zeros_like(s)), axis),
                         axis),
                     state)
-            if stateful:
-                grads, sync_state = strategy(grads, axis, sync_state)
-            else:
-                grads = strategy(grads, axis)
+            if not overlap:
+                if stateful:
+                    grads, sync_state = strategy(grads, axis, sync_state)
+                else:
+                    grads = strategy(grads, axis)
             # per-step health flag (sentry): finite loss + finite synced
             # grads, via one global sum-of-squares over the tree
             gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -368,6 +455,10 @@ class Trainer:
                 f"{self.data_axes}, got {mesh.axis_names}")
         self.mesh = mesh if self.strategy.needs_mesh else None
         self.n_replicas = self.mesh.devices.size if self.mesh else 1
+        # overlap knobs must land before init_state (the EF residual layout
+        # follows the bucket plan) and fail fast on incapable strategies
+        _apply_bucket_mb(cfg, self.strategy)
+        _validate_overlap(cfg, self.strategy, self.mesh)
 
         key = jax.random.key(cfg.seed)
         self.init_key, self.data_key = jax.random.split(key)

@@ -14,7 +14,16 @@ module makes them checkable:
 - ``check_determinism(fn, *args)``: run a compiled step twice from identical
   inputs and compare results bitwise — catches nondeterministic kernels or
   host-side state leaking into a supposedly pure step;
-- ``assert_finite(tree)``: NaN/Inf scan over a pytree (grad/param health).
+- ``assert_finite(tree)``: NaN/Inf scan over a pytree (grad/param health);
+- the SCHEDULE INSPECTOR (round 8): ``op_schedule`` linearizes a compiled
+  step's jaxpr into equation order — the order XLA receives the program,
+  which the backward-overlap machinery (parallel/strategies.OverlapSync)
+  manipulates — and ``collective_stats`` / ``assert_overlap_schedule`` /
+  ``assert_post_backward_schedule`` prove whether gradient-sync
+  collectives are interleaved between backward matmuls (overlap=True) or
+  clustered after the backward drains (the historical post-backward
+  shape).  ``hlo_collective_counts`` counts collectives in lowered
+  (Stable)HLO text for the bench tables.
 """
 
 from __future__ import annotations
@@ -25,6 +34,202 @@ import jax
 import numpy as np
 
 PyTree = Any
+
+# Compute ops a training step's forward/backward is made of (VGG steps are
+# convolution-dominated, LM steps dot_general-dominated).
+COMPUTE_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+# Cross-device collectives (pmean lowers to psum+div, reduce-scatter to
+# psum_scatter, so these cover every strategy's wire ops).
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter",
+})
+
+
+def _eqn_axes(eqn) -> tuple:
+    """The mesh axis names a collective equation runs over (normalized to
+    a flat tuple; empty for non-collectives)."""
+    axes = eqn.params.get("axes", None)
+    if axes is None:
+        axes = eqn.params.get("axis_name", None)
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    flat: list = []
+    for a in axes:
+        if isinstance(a, (tuple, list)):
+            flat.extend(a)
+        else:
+            flat.append(a)
+    return tuple(flat)
+
+
+def _eqn_bytes(eqn) -> int:
+    """Total operand payload of an equation (per device, per execution of
+    its enclosing jaxpr) — the collective's wire cost proxy."""
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            total += int(np.prod(aval.shape, dtype=np.int64) or 1) * \
+                jax.dtypes.canonicalize_dtype(aval.dtype).itemsize
+    return total
+
+
+def _sub_jaxprs(eqn):
+    """Nested jaxprs of call-like equations (pjit/scan/while/cond/
+    shard_map/remat/custom_* ...), in parameter order."""
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for s in vals:
+            inner = getattr(s, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(s, "eqns"):
+                yield s
+
+
+def jaxpr_schedule(jaxpr) -> list[dict]:
+    """Flatten a (closed) jaxpr into equation order, recursing into nested
+    jaxprs in place, and record every compute/collective op as
+    ``{"kind": "compute"|"collective", "prim": name, "axes": tuple,
+    "bytes": int, "trips": int}``.  Equation order is the order
+    autodiff/transposition emitted the program and the order XLA receives
+    it — the thing the overlap sync points exist to restructure.
+
+    A scan body appears ONCE in the schedule (its per-iteration sequence
+    is the repeating unit), but ``trips`` carries the product of the
+    enclosing scan lengths, so per-execution accounting (the ring
+    strategies' 2(n-1) ppermute hops live in scans) sums ``bytes *
+    trips`` — see ``collective_stats``'s ``bytes_executed``.  ``while``
+    bodies have no static trip count and keep the enclosing multiplier
+    (an undercount; none of the train steps use while-loop collectives).
+    """
+    sched: list[dict] = []
+
+    def walk(j, trips: int):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in COMPUTE_PRIMS:
+                sched.append({"kind": "compute", "prim": name,
+                              "axes": (), "bytes": _eqn_bytes(eqn),
+                              "trips": trips})
+            elif name in COLLECTIVE_PRIMS:
+                sched.append({"kind": "collective", "prim": name,
+                              "axes": _eqn_axes(eqn),
+                              "bytes": _eqn_bytes(eqn), "trips": trips})
+            inner = trips
+            if name == "scan":
+                inner = trips * int(eqn.params.get("length", 1))
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, inner)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr, 1)
+    return sched
+
+
+def op_schedule(fn: Callable, *args, **kwargs) -> list[dict]:
+    """``jaxpr_schedule`` of ``fn(*args, **kwargs)`` (fn may be jitted or
+    shard_mapped; nothing is executed — args can be ShapeDtypeStructs)."""
+    return jaxpr_schedule(jax.make_jaxpr(fn)(*args, **kwargs))
+
+
+def collective_stats(sched: list[dict], axes=None) -> dict:
+    """Interleaving statistics for the collectives in a schedule.
+
+    ``axes``: restrict to collectives touching ANY of these mesh axes
+    (e.g. ("data",) for the data-parallel gradient sync; None = all).
+
+    Returns counts over the STATIC schedule: ``total`` collectives,
+    ``interleaved`` (compute BOTH before and after — emitted strictly
+    between matmuls), ``tail`` (no compute after — the post-backward
+    cluster), ``bytes`` (summed operand payload, each scan body once) and
+    ``compute`` (compute-op count); plus the PER-EXECUTION accounting
+    ``executions`` / ``bytes_executed`` (scan-trip-weighted — the honest
+    wire totals when collectives ride a scan, e.g. the int8 ring's
+    ppermute hops)."""
+    if axes is not None:
+        axes = set(axes)
+    compute_idx = [i for i, r in enumerate(sched) if r["kind"] == "compute"]
+    first_c = compute_idx[0] if compute_idx else None
+    last_c = compute_idx[-1] if compute_idx else None
+    total = interleaved = tail = executions = 0
+    nbytes = nbytes_exec = 0
+    for i, r in enumerate(sched):
+        if r["kind"] != "collective":
+            continue
+        if axes is not None and not (axes & set(r["axes"])):
+            continue
+        total += 1
+        nbytes += r["bytes"]
+        trips = r.get("trips", 1)
+        executions += trips
+        nbytes_exec += r["bytes"] * trips
+        if last_c is None or i > last_c:
+            tail += 1
+        elif first_c is not None and i > first_c:
+            interleaved += 1
+    return {"total": total, "interleaved": interleaved, "tail": tail,
+            "bytes": nbytes, "compute": len(compute_idx),
+            "executions": executions, "bytes_executed": nbytes_exec}
+
+
+def assert_overlap_schedule(sched: list[dict], axes=("data",),
+                            min_interleaved: int = 2) -> dict:
+    """Assert the overlap property: at least ``min_interleaved``
+    ``axes``-collectives sit STRICTLY BETWEEN compute ops (backward
+    matmuls run after them — the latency-hiding scheduler has something
+    to overlap).  Returns the stats for reporting."""
+    stats = collective_stats(sched, axes=axes)
+    if stats["interleaved"] < min_interleaved:
+        raise ConsistencyError(
+            f"expected >= {min_interleaved} {tuple(axes)}-collectives "
+            f"interleaved between compute ops, found "
+            f"{stats['interleaved']} (of {stats['total']}; {stats}) — "
+            f"the collectives are not overlapped with backward compute")
+    return stats
+
+
+def assert_post_backward_schedule(sched: list[dict],
+                                  axes=("data",)) -> dict:
+    """Assert the historical post-backward shape: every ``axes``-collective
+    comes AFTER the last compute op (all-at-the-end; nothing for the
+    scheduler to overlap)."""
+    stats = collective_stats(sched, axes=axes)
+    if stats["interleaved"] != 0 or stats["tail"] != stats["total"]:
+        raise ConsistencyError(
+            f"expected all {tuple(axes)}-collectives after the final "
+            f"compute op, got {stats}")
+    return stats
+
+
+# Lowered-HLO collective opcodes (canonical name -> regex matching the op
+# DEFINITION site — opcode immediately followed by its operand list — in
+# both classic HLO (`all-reduce(...)`) and StableHLO
+# (`"stablehlo.all_reduce"(...)` / `stablehlo.all_reduce(...)`) text;
+# value references like `%all-reduce.1` never match).
+_HLO_COLLECTIVES = {
+    "all-reduce": r"all[-_]reduce\"?\(",
+    "collective-permute": r"collective[-_]permute\"?\(",
+    "all-gather": r"all[-_]gather\"?\(",
+    "reduce-scatter": r"reduce[-_]scatter\"?\(",
+    "all-to-all": r"all[-_]to[-_]all\"?\(",
+}
+
+
+def hlo_collective_counts(hlo_text: str) -> dict[str, int]:
+    """Count collective ops in lowered (Stable)HLO text
+    (``jit(f).lower(...).as_text()``), keyed by canonical opcode plus a
+    ``"total"`` — the bench tables' HLO collective-count column
+    (scripts/bench_strategies.py)."""
+    import re
+
+    counts = {canon: len(re.findall(pat, hlo_text))
+              for canon, pat in _HLO_COLLECTIVES.items()}
+    counts = {k: v for k, v in counts.items() if v}
+    counts["total"] = sum(counts.values())
+    return counts
 
 
 class ConsistencyError(AssertionError):

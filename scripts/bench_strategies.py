@@ -44,19 +44,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from distributed_pytorch_tpu.parallel import strategies as strat  # noqa: E402
 from distributed_pytorch_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
+from distributed_pytorch_tpu.utils import debug as dbg  # noqa: E402
 
 PER_DEV_BATCH = int(os.environ.get("BENCH_PER_DEV_BATCH", "4"))
 WINDOW = int(os.environ.get("BENCH_WINDOW", "20"))
+OVERLAP = os.environ.get("BENCH_STRATEGY_OVERLAP", "0") == "1"
 
 
-def bench_strategy(name: str) -> float:
-    """Mean seconds/step over WINDOW iterations, compile + warm-up excluded
-    (the reference's iter-0-excluded window, main.py:43-48)."""
+def comm_profile(tr: Trainer, images, labels) -> dict:
+    """Per-step wire accounting from the traced/lowered program
+    (utils/debug.py schedule inspector, round 8) — the reproducible
+    source of BASELINE.md's strategy cost table.
+
+    ``comm_bytes_per_step`` / ``collective_count`` are PER-EXECUTION
+    (scan-trip-weighted): the ring strategies' ppermute hops ride
+    ``lax.scan``, so the static jaxpr holds each hop once but the wire
+    sees it n-1 times — static counts would under-report the rings by
+    ~(n-1)x against the psum strategies.  The static program-shape
+    numbers ride along as ``*_static``/``collectives_interleaved``.
+    Tracing (make_jaxpr) and lowering (no backend compile) happen once
+    each; the executable itself was already compiled by the warm-up
+    step."""
+    img, lbl = tr._stage(images[None], labels[None])
+    args = tr._args(img, lbl)
+    if tr._multi_fn is None:  # build the program without compiling it
+        from distributed_pytorch_tpu.train import make_multi_step
+        tr._multi_fn = make_multi_step(tr.cfg, tr.strategy, tr.mesh,
+                                       fault_sig=tr._fault_sig)
+    sched = dbg.op_schedule(tr._multi_fn, *args)
+    stats = dbg.collective_stats(sched)
+    hlo = dbg.hlo_collective_counts(tr._multi_fn.lower(*args).as_text())
+    return {"comm_bytes_per_step": stats["bytes_executed"],
+            "collective_count": stats["executions"],
+            "comm_bytes_static": stats["bytes"],
+            "collective_count_static": stats["total"],
+            "collectives_interleaved": stats["interleaved"],
+            "hlo_collective_count": hlo.pop("total"),
+            "hlo_collectives": hlo}
+
+
+def bench_strategy(name: str) -> tuple[float, dict]:
+    """(mean seconds/step over WINDOW iterations, comm profile); compile +
+    warm-up excluded (the reference's iter-0-excluded window,
+    main.py:43-48)."""
     # Factored-axis strategies (hierarchical): mesh=None lets the Trainer
     # build the right ('dcn', 'ici') mesh from cfg.dcn_size — one recipe.
     factored = getattr(strat.get(name), "axes", None) is not None
     mesh = make_mesh(N_DEV) if (name != "none" and not factored) else None
-    cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False)
+    overlap = OVERLAP and name in strat.overlap_capable() and name != "none"
+    cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False,
+                      overlap=overlap)
     tr = Trainer(cfg, mesh=mesh)
     n = tr.n_replicas
     rng = np.random.default_rng(0)
@@ -65,13 +102,14 @@ def bench_strategy(name: str) -> float:
     labels = rng.integers(0, 10, PER_DEV_BATCH * n).astype(np.int32)
 
     tr.train_step(images, labels)  # compile + warm-up (excluded)
+    comm = comm_profile(tr, images, labels)
     times = []
     for _ in range(WINDOW):
         t0 = time.perf_counter()
         loss = tr.train_step(images, labels)
         float(loss)  # value fetch: the honest end-of-step barrier
         times.append(time.perf_counter() - t0)
-    return sum(times) / len(times)
+    return sum(times) / len(times), comm
 
 
 def main() -> None:
@@ -79,19 +117,28 @@ def main() -> None:
              "gather_scatter_symmetric", "gather_scatter",
              "quantized", "quantized_ring", "quantized_ring_ef"]
     results: dict[str, float] = {}
+    comms: dict[str, dict] = {}
     for name in names:
-        t = bench_strategy(name)
-        results[name] = t
+        t, comm = bench_strategy(name)
+        results[name], comms[name] = t, comm
         print(json.dumps({"strategy": name, "sec_per_step": round(t, 4),
                           "window": WINDOW,
-                          "per_dev_batch": PER_DEV_BATCH}), flush=True)
+                          "per_dev_batch": PER_DEV_BATCH,
+                          "overlap": OVERLAP and name in
+                          strat.overlap_capable(),
+                          **comm}), flush=True)
 
     ddp = results["ddp"]
-    print("\n| Strategy | s/step | vs ddp |", file=sys.stderr)
-    print("|---|---|---|", file=sys.stderr)
+    print("\n| Strategy | s/step | vs ddp | comm MB/step | collectives "
+          "(interleaved) | HLO collectives |", file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
     for name in names:
+        c = comms[name]
         print(f"| {name} | {results[name]:.3f} | "
-              f"{results[name] / ddp:.2f}x |", file=sys.stderr)
+              f"{results[name] / ddp:.2f}x | "
+              f"{c['comm_bytes_per_step'] / 1e6:.2f} | "
+              f"{c['collective_count']} ({c['collectives_interleaved']}) | "
+              f"{c['hlo_collective_count']} |", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -62,10 +62,55 @@ def test_bench_json_keys_include_transformer_gates():
                 "serving_overlap_speedup",
                 "serving_slot_step_utilization",
                 "kv_dtype", "decode_kv_bytes_per_step",
-                "serving_emitted_per_slot_step"):
+                "serving_emitted_per_slot_step",
+                # round-8 backward-overlap A/B keys
+                "train_overlap_speedup", "train_step_ms_overlap",
+                "train_step_ms_post_backward"):
         assert key in src, key
     # the knob reaches both inference gates
     assert "BENCH_KV_DTYPE" in src
+    # the overlap knob is validated PRE-bench (canon_overlap_env), same
+    # fail-loudly contract as BENCH_KV_DTYPE
+    assert "canon_overlap_env" in src
+
+
+def test_bench_overlap_env_knob_fails_loudly():
+    """A typo'd BENCH_OVERLAP must raise before any measurement, not be
+    swallowed into a silently-skipped (or silently-run) A/B."""
+    assert bench.canon_overlap_env(None) is True
+    assert bench.canon_overlap_env("") is True
+    assert bench.canon_overlap_env("1") is True
+    assert bench.canon_overlap_env("0") is False
+    for bad in ("yes", "true", "On", "2", " 1"):
+        with pytest.raises(ValueError, match="BENCH_OVERLAP"):
+            bench.canon_overlap_env(bad)
+
+
+def test_bench_train_overlap_uses_hardened_window():
+    """The overlap A/B inherits the hardened-window discipline: >= 5
+    alternating reps, median-of-reps, value fetch as the step barrier,
+    and the bitwise-pinned bucketed strategy on both sides."""
+    import inspect
+    sig = inspect.signature(bench.bench_train_overlap)
+    assert sig.parameters["reps"].default >= 5
+    src = inspect.getsource(bench.bench_train_overlap)
+    assert "overlap=overlap" in src and "bucketed" in src
+    assert "precompile_steps" in src  # compile excluded from timed reps
+
+
+def test_bench_strategies_emits_comm_columns():
+    """scripts/bench_strategies.py's JSON rows carry the wire-accounting
+    columns (round 8): comm bytes + jaxpr/HLO collective counts from the
+    schedule inspector, making BASELINE.md's strategy cost table
+    reproducible from one command."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "bench_strategies.py")
+    with open(path) as f:
+        src = f.read()
+    for key in ("comm_bytes_per_step", "collective_count",
+                "collectives_interleaved", "hlo_collective_count",
+                "op_schedule", "hlo_collective_counts"):
+        assert key in src, key
 
 
 def test_bench_decode_kv_dtype_knob_and_bytes_estimate():

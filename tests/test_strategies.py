@@ -624,6 +624,164 @@ class TestQuantizedRingEF:
                                    losses["ddp"], rtol=1e-2, atol=1e-2)
 
 
+class TestOverlap:
+    """Backward-overlapped gradient sync (round 8): the bucket collectives
+    move INSIDE the backward graph (custom_vjp sync points at layer-group
+    boundaries — strategies.OverlapSync) without changing a single bit of
+    the training trajectory."""
+
+    # small cap so TINY (~160 KB of grads) packs several buckets; the ring
+    # strategies' post-backward baseline must share the plan (their
+    # per-hop block quantization makes numerics bucket-LAYOUT-dependent),
+    # while the linear (psum) strategies are pinned against the UNTOUCHED
+    # default post-backward path — the strongest form of the claim.
+    BUCKET_MB = 0.02
+
+    def _run(self, name, overlap, bucket_mb=None, steps=3):
+        cfg = _cfg(name, overlap=overlap, overlap_bucket_mb=bucket_mb,
+                   dcn_size=2)
+        mesh = None if name == "hierarchical" else make_mesh(N_DEV)
+        tr = Trainer(cfg, mesh)
+        rng = np.random.default_rng(3)
+        images = rng.integers(0, 256, (steps, GLOBAL_BATCH, 32, 32, 3)
+                              ).astype(np.uint8)
+        labels = rng.integers(0, 10, (steps, GLOBAL_BATCH)).astype(np.int32)
+        tr.train_steps(images, labels)  # one K-step scan dispatch
+        return tr
+
+    @pytest.mark.parametrize("name,base_bucket", [
+        ("ddp", None), ("bucketed", None), ("quantized", None),
+        ("hierarchical", None),
+        ("quantized_ring", BUCKET_MB), ("quantized_ring_ef", BUCKET_MB)])
+    def test_overlap_bitwise_matches_post_backward(self, name, base_bucket):
+        """overlap=True == the post-backward strategy, bit for bit, over a
+        multi-step scan: params, optimizer state, AND the EF residual
+        carry.  The collectives move; the numbers do not."""
+        base = self._run(name, overlap=False, bucket_mb=base_bucket)
+        over = self._run(name, overlap=True, bucket_mb=self.BUCKET_MB)
+        for a, b in zip(
+                jax.tree.leaves((base.params, base.opt_state)),
+                jax.tree.leaves((over.params, over.opt_state))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+        np.testing.assert_array_equal(np.asarray(base.sync_state),
+                                      np.asarray(over.sync_state),
+                                      err_msg=f"{name} sync_state")
+        if name == "quantized_ring_ef":
+            # the residual is live (the wire really drops bits) and rides
+            # the scan carry per device
+            assert over.sync_state.shape[0] == N_DEV
+            assert float(np.abs(np.asarray(over.sync_state)).max()) > 0
+
+    def test_overlap_zero_extra_recompiles(self, batch):
+        """The overlap step compiles ONCE per shape: repeated dispatches
+        reuse the executable (no marker-induced retrace)."""
+        cfg = _cfg("bucketed", overlap=True,
+                   overlap_bucket_mb=self.BUCKET_MB)
+        tr = Trainer(cfg, make_mesh(N_DEV))
+        for _ in range(3):
+            tr.train_step(*batch)
+        assert len(tr._compiled) == 1
+        if hasattr(tr._multi_fn, "_cache_size"):
+            assert tr._multi_fn._cache_size() == 1
+
+    def test_overlap_rejects_incapable_strategy(self, mesh):
+        for name in ("all_reduce", "gather_scatter",
+                     "gather_scatter_symmetric"):
+            with pytest.raises(ValueError, match="overlap"):
+                Trainer(_cfg(name, overlap=True), mesh)
+
+    def test_overlap_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            Trainer(_cfg("none", overlap=True))
+
+    def test_overlap_capable_listing(self):
+        assert strat.overlap_capable() == [
+            "bucketed", "ddp", "hierarchical", "quantized",
+            "quantized_ring", "quantized_ring_ef"]
+
+    def test_overlap_health_flag_composes_with_fault_taps(self, mesh,
+                                                          batch):
+        """The sentry's in-scan health flag still fires under overlap: an
+        injected NaN grad (which now lands POST-sync — the collective ran
+        inside the backward already) poisons the step and drops ok to 0."""
+        from distributed_pytorch_tpu.utils import faults
+        faults.install(faults.FaultPlan(kind="nan_grad", step=1))
+        try:
+            tr = Trainer(_cfg("ddp", overlap=True), mesh)
+            tr.train_step(*batch)       # step 0: healthy
+            assert float(np.asarray(tr.last_ok)[0]) == 1.0
+            tr.train_step(*batch)       # step 1: NaN tap fires
+            assert float(np.asarray(tr.last_ok)[0]) == 0.0
+        finally:
+            faults.reset()
+
+
+class TestBucketPlan:
+    """make_bucket_plan: the ONE packing shared by Bucketed, the int8
+    rings, and the overlap markers (membership by reverse flatten order,
+    tree-order layout within buckets)."""
+
+    def test_single_bucket_under_cap(self):
+        leaves = [jnp.ones((10,)), jnp.ones((4, 4)), jnp.ones(())]
+        assert strat.make_bucket_plan(leaves, 10**9) == [[0, 1, 2]]
+
+    def test_reverse_order_membership_ascending_layout(self):
+        # 4 x 1KB leaves, 2KB cap: packed from the BACK -> {3,2}, {1,0};
+        # indices ascending within each bucket
+        leaves = [jnp.ones((256,), jnp.float32) for _ in range(4)]
+        plan = strat.make_bucket_plan(leaves, 2 * 1024)
+        assert plan == [[2, 3], [0, 1]]
+
+    def test_oversized_leaf_gets_own_bucket(self):
+        leaves = [jnp.ones((8,)), jnp.ones((100_000,)), jnp.ones((8,))]
+        plan = strat.make_bucket_plan(leaves, 1024)
+        assert [sorted(b) for b in plan] == [[2], [1], [0]]
+
+    def test_ring_bucketed_post_backward_approximates_mean(self):
+        """Multi-bucket rings (round 8: one ring per plan bucket) still
+        deliver the mean within the int8 ring's tolerance."""
+        from functools import partial
+
+        from distributed_pytorch_tpu.utils.compat import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        rng = np.random.default_rng(0)
+        grads = {"w": rng.standard_normal((4, 300, 7)).astype(np.float32),
+                 "b": rng.standard_normal((4, 11)).astype(np.float32)}
+        ring = strat.QuantizedRing(bucket_mb=0.002)  # ~3 buckets
+        assert len(ring._plan(jax.tree.leaves(
+            jax.tree.map(lambda g: g[0], grads)))) > 1
+        f = jax.jit(shard_map(
+            partial(ring, axis="data"), mesh=mesh,
+            in_specs=(P("data"),), out_specs=P("data"), check_vma=False))
+        out = f(grads)
+        for k in grads:
+            exact = np.mean(grads[k], axis=0, keepdims=True)
+            np.testing.assert_allclose(np.asarray(out[k])[0:1], exact,
+                                       atol=5e-2, rtol=5e-2)
+
+    def test_ef_state_segments_match_init_state(self):
+        """The EF residual layout contract: init_state length == the sum
+        of per-bucket segments, and the single-bucket case reproduces the
+        historical whole-tree n*chunk size."""
+        ef = strat.QuantizedRingEF()
+        params = {"w": jnp.ones((300, 7)), "b": jnp.ones((13,))}
+        leaves = jax.tree.leaves(params)
+        segs = ef.state_segments(leaves, 4)
+        assert len(segs) == 1  # under the 25 MB cap: one bucket
+        total = sum(leaf.size for leaf in leaves)
+        chunk = -(-total // (4 * ef.block)) * ef.block
+        assert segs == [4 * chunk]
+        assert ef.init_state(params, 4).shape == (4 * chunk,)
+        # multi-bucket: segments partition the state exactly
+        ef_small = strat.QuantizedRingEF(bucket_mb=0.002)
+        segs = ef_small.state_segments(leaves, 4)
+        assert len(segs) > 1
+        assert ef_small.init_state(params, 4).shape == (sum(segs),)
+
+
 class TestVmaRecompileVerification:
     """check_vma=False strategies re-verify replication after EVERY fresh
     compile, not just the first step (VERDICT round-2 #7): a collective
