@@ -241,6 +241,120 @@ def bench_train_overlap(batch_per_replica: int = 64, iters: int = 30,
             "ms_post_backward": med[False]}
 
 
+def canon_dcn_size_env(value: str | None) -> int:
+    """Validate the BENCH_DCN_SIZE knob: unset/''/'0' skips the factored-
+    mesh DCN A/B (the default — it needs >= 2 devices to mean anything);
+    an integer >= 2 is the number of slices for the virtual two-level
+    mesh.  A typo must fail HERE, before any measurement (the
+    BENCH_KV_DTYPE contract): inside the bench it would be swallowed by
+    the catch-all while the JSON silently omitted the A/B."""
+    if value is None or value in ("", "0"):
+        return 0
+    try:
+        n = int(value)
+    except ValueError:
+        raise ValueError(
+            f"BENCH_DCN_SIZE must be an integer >= 2 (or ''/0 to skip), "
+            f"got {value!r}") from None
+    if n < 2:
+        raise ValueError(
+            f"BENCH_DCN_SIZE must be >= 2 (a {n}-slice 'factored' mesh "
+            f"has no cross-slice hop); unset it or use 0 to skip")
+    return n
+
+
+def canon_dcn_compress_env(value: str | None) -> str | None:
+    """Validate BENCH_DCN_COMPRESS (the slow-hop compression the DCN A/B
+    runs with): unset/''/'none' = exact full-precision psum, 'int8' = the
+    quantized ring exchange.  Fails loudly pre-bench like BENCH_KV_DTYPE."""
+    if value is None or value in ("", "none"):
+        return None
+    if value == "int8":
+        return "int8"
+    raise ValueError(
+        f"BENCH_DCN_COMPRESS must be ''/'none' or 'int8', got {value!r}")
+
+
+def bench_train_dcn(dcn_size: int, compress: str | None,
+                    batch_per_replica: int = 64, iters: int = 30,
+                    reps: int = 5) -> dict | None:
+    """Factored-mesh (two-level DCN) training A/B (round 9): the
+    'hierarchical' strategy over a Mesh(('dcn', 'ici')) built from all
+    devices, streaming per-bucket overlap=True vs the post-backward
+    path, with the same hardened-window discipline as the round-8
+    overlap A/B (>= ``reps`` alternating reps, median, value-fetch
+    barrier).  ``compress`` additionally runs the int8 DCN hop on BOTH
+    sides of the A/B.  Also reports the per-axis wire accounting from
+    the schedule inspector — ``dcn_bytes_per_step`` is the measured
+    cross-slice payload (|grads|/ici exact, ~1/4 of that again under
+    int8).  Needs >= 2 devices divisible by dcn_size; returns None (JSON
+    nulls) otherwise.  On CPU meshes expect ~1.0x speedup (no
+    latency-hiding scheduler — the schedule/byte numbers are the CPU
+    content); on real DCN the slow hop hides under backward compute."""
+    import jax
+
+    from distributed_pytorch_tpu.train import (TrainConfig, Trainer,
+                                               make_multi_step)
+    from distributed_pytorch_tpu.utils import debug as dbg
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % dcn_size or n_dev // dcn_size < 1:
+        _log(f"[bench] train-dcn A/B needs >= 2 devices divisible by "
+             f"dcn_size={dcn_size} (have {n_dev}); omitting")
+        return None
+
+    def build(overlap: bool) -> Trainer:
+        cfg = TrainConfig(strategy="hierarchical", dcn_size=dcn_size,
+                          dcn_compress=compress,
+                          batch_size=batch_per_replica,
+                          steps_per_loop=iters, compute_dtype="bfloat16",
+                          overlap=overlap)
+        return Trainer(cfg)  # builds the ('dcn', 'ici') mesh itself
+
+    trainers = {False: build(False), True: build(True)}
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        tr.precompile_steps(images, labels)
+        float(tr.train_steps(images, labels)[-1])
+
+    times: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            losses = tr.train_steps(images, labels)
+            float(losses[-1])  # fetch forces the whole donated chain
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[False] / max(med[True], 1e-9)
+
+    # per-axis wire accounting of the overlapped program (one trace; the
+    # executable is already compiled) — the dcn row is the slow-hop cost
+    tr = trainers[True]
+    img, lbl = tr._stage(images[:1], labels[:1])
+    args = tr._args(img, lbl)
+    if tr._multi_fn is None:
+        tr._multi_fn = make_multi_step(tr.cfg, tr.strategy, tr.mesh,
+                                       fault_sig=tr._fault_sig)
+    per_axis = dbg.per_axis_collective_stats(
+        dbg.op_schedule(tr._multi_fn, *args))
+    dcn_bytes = per_axis.get("dcn", {}).get("bytes_executed", 0)
+    ici_bytes = per_axis.get("ici", {}).get("bytes_executed", 0)
+    _log(f"[bench] train-dcn A/B (hierarchical, dcn_size={dcn_size}, "
+         f"compress={compress or 'none'}, {n_dev} dev): "
+         f"{med[True]:.2f} ms/step overlapped vs {med[False]:.2f} "
+         f"post-backward -> {speedup:.3f}x; "
+         f"{dcn_bytes / 1e6:.2f} MB dcn / {ici_bytes / 1e6:.2f} MB ici "
+         f"per step ({reps} reps median)")
+    return {"speedup": speedup, "ms_overlap": med[True],
+            "ms_post_backward": med[False], "dcn_bytes_per_step": dcn_bytes,
+            "ici_bytes_per_step": ici_bytes}
+
+
 def _lm_cfg():
     """The BASELINE.md LM measurement config: byte-vocab d512/4L
     transformer, flash attention, bf16."""
@@ -540,6 +654,13 @@ def main() -> None:
     # Overlap A/B knob: validated pre-bench for the same reason (a typo'd
     # BENCH_OVERLAP must not silently skip or force the A/B).
     run_overlap = canon_overlap_env(os.environ.get("BENCH_OVERLAP"))
+    # Factored-mesh DCN A/B knobs (round 9), validated loudly pre-bench:
+    # BENCH_DCN_SIZE >= 2 runs the two-level hierarchical A/B on a
+    # dcn_size-sliced mesh; BENCH_DCN_COMPRESS selects the slow-hop
+    # format it measures.
+    dcn_size = canon_dcn_size_env(os.environ.get("BENCH_DCN_SIZE"))
+    dcn_compress = canon_dcn_compress_env(
+        os.environ.get("BENCH_DCN_COMPRESS"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -563,6 +684,15 @@ def main() -> None:
             overlap_ab = bench_train_overlap()
         except Exception as e:
             _log(f"[bench] train-overlap A/B failed ({e}); omitting")
+
+    # Factored-mesh DCN A/B (round 9): streaming two-level sync on the
+    # dcn_size-sliced mesh; optional like the other gates.
+    dcn_ab = None
+    if dcn_size:
+        try:
+            dcn_ab = bench_train_dcn(dcn_size, dcn_compress)
+        except Exception as e:
+            _log(f"[bench] train-dcn A/B failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -622,6 +752,18 @@ def main() -> None:
         "train_step_ms_post_backward": (
             round(overlap_ab["ms_post_backward"], 3)
             if overlap_ab is not None else None),
+        # factored-mesh DCN A/B (round 9, BENCH_DCN_SIZE): streaming
+        # per-bucket two-level sync vs post-backward on the
+        # Mesh(('dcn','ici')) virtual topology; dcn bytes are the
+        # measured cross-slice payload (inspector, per-axis), and
+        # train_dcn_compress records which slow-hop format ran
+        # (BENCH_DCN_COMPRESS).  All null when the A/B is skipped.
+        "train_dcn_overlap_speedup": (round(dcn_ab["speedup"], 3)
+                                      if dcn_ab is not None else None),
+        "train_dcn_bytes_per_step": (dcn_ab["dcn_bytes_per_step"]
+                                     if dcn_ab is not None else None),
+        "train_dcn_compress": ((dcn_compress or "none")
+                               if dcn_ab is not None else None),
         # transformer-stack gates (BASELINE.md is the prose companion;
         # these keys are the regression source of truth since round 4)
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)

@@ -69,6 +69,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of slices for --strategy hierarchical: the "
                         "data axis factors into Mesh(('dcn','ici')) and "
                         "cross-slice traffic drops to payload/ici")
+    p.add_argument("--dcn-compress", default=None, choices=["int8"],
+                   help="quantize the cross-slice (dcn) hop of --strategy "
+                        "hierarchical: int8 ring exchange with per-row "
+                        "scales and error-feedback residuals; the ICI "
+                        "reduce-scatter/all-gather stay full-precision")
+    p.add_argument("--overlap", action="store_true",
+                   help="emit each ~25 MB gradient bucket's collective "
+                        "INSIDE the backward pass at its layer-group "
+                        "boundary (in-backward sync points; bitwise-"
+                        "identical trajectory, test-pinned) so the "
+                        "latency-hiding scheduler can run bucket N's "
+                        "sync under layer N-1's backward matmuls")
+    p.add_argument("--overlap-bucket-mb", type=float, default=None,
+                   help="bucket size for overlap packing (default: torch "
+                        "DDP's 25 MB)")
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106
@@ -166,6 +181,8 @@ def main(argv: list[str] | None = None) -> int:
         strategy=args.strategy, sync_bn=args.sync_bn,
         compute_dtype=args.compute_dtype, augment=not args.no_augment,
         seed=args.seed, dcn_size=args.dcn_size,
+        dcn_compress=args.dcn_compress, overlap=args.overlap,
+        overlap_bucket_mb=args.overlap_bucket_mb,
     )
     mesh = None
     factored = getattr(_strat.get(args.strategy), "axes", None) is not None

@@ -98,18 +98,23 @@ class LMTrainConfig:
     # tick count.
     pp_remat_block: int | None = 0
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
-    # Backward-overlapped ZeRO-3 (round 8): gather each layer group's
-    # fsdp-sharded weights AT ITS LAYER BOUNDARY (transformer.apply
-    # boundary hook) instead of all-at-once before the stack — the
-    # forward's all_gathers stream layer by layer (peak weight memory
-    # drops from all-layers-resident to one group ahead) and, because the
-    # transpose of each gather is that layer's gradient reduce-scatter,
-    # the backward's reduce-scatters are emitted interleaved between the
-    # layers' backward matmuls for XLA's scheduler to overlap.  Bitwise-
-    # identical trajectories (same ops, moved).  Requires fsdp=True: the
-    # plain data-axis cotangent psums are synthesized by shard_map's
-    # transpose at each param's use site already, so without fsdp there
-    # is no post-backward cluster to dissolve.
+    # Backward-overlapped sync (rounds 8-9): stream the step's bulk
+    # communication through the layer-group boundaries (transformer.apply
+    # boundary hook) instead of emitting it all-at-once.  With fsdp
+    # (round 8), each group's ZeRO-3 weight gather moves to its boundary
+    # — forward all_gathers stream layer by layer and their transposes
+    # (the gradient reduce-scatters) land interleaved between the
+    # backward matmuls.  With dcn_size > 1 (round 9), the factored-mesh
+    # two-level gradient sync streams the same way: the whole-tree
+    # _dcn_sync_point becomes one per-layer-group custom-vjp point each,
+    # so group N's ICI reduce-scatter -> shard-sized DCN psum ->
+    # all-gather is emitted right after group N's backward matmuls and
+    # the latency-hiding scheduler can run it under group N-1's backward.
+    # Bitwise-identical trajectories either way (same ops, moved; the
+    # two-level reduction is elementwise, so regrouping changes no sums).
+    # Requires fsdp=True or dcn_size > 1: otherwise the data-axis
+    # cotangent psums already sit at each param's use site and there is
+    # no post-backward cluster to dissolve.
     overlap: bool = False
     # Gradient accumulation: split each global batch into grad_accum
     # microbatches, scan them accumulating gradients, apply ONE optimizer
@@ -172,22 +177,17 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
             f"dp // dcn_size = 1, so no leaf can shard over it — raise "
             f"dp (or drop fsdp)")
     if cfg.overlap:
-        if not cfg.fsdp:
-            raise ValueError(
-                "lm overlap=True streams the ZeRO-3 (fsdp) weight gathers "
-                "and their reduce-scatter transposes through the layer "
-                "boundaries; without fsdp the data-axis cotangent psums "
-                "are already emitted at each param's use site by "
-                "shard_map's transpose — there is no post-backward "
-                "cluster to dissolve (BASELINE.md round 8).  Enable fsdp "
-                "or drop overlap (the VGG trainer's overlap=True covers "
-                "the explicit-strategy case)")
-        if cfg.dcn_size > 1:
-            raise ValueError(
-                "overlap does not compose with the factored (dcn) mesh: "
-                "its two-level sync point is a whole-tree custom-vjp "
-                "(_dcn_sync_point); streaming it per bucket is an open "
-                "item (ROADMAP.md)")
+        # the ONE capability-check site (parallel/strategies.py, round 9):
+        # overlap streams ZeRO-3 gathers and/or — since round 9 — the
+        # factored-mesh two-level DCN sync points, per layer group.
+        # Under grad_accum > 1 the dcn exchange happens ONCE after the
+        # local accumulation scan (never per microbatch), so dcn alone
+        # gives overlap nothing to stream there — only fsdp does (its
+        # per-microbatch gathers still stream); refuse the silent no-op.
+        from .parallel.strategies import require_lm_overlap_streamable
+        require_lm_overlap_streamable(
+            fsdp=cfg.fsdp,
+            dcn=cfg.dcn_size > 1 and cfg.grad_accum == 1)
     if cfg.ep > 1:
         if cfg.pp > 1:
             raise ValueError("the dedicated 'expert' axis does not compose "
@@ -375,8 +375,8 @@ def _spec_axes(spec) -> set:
 
 
 def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
-    """Identity whose BACKWARD owns the ENTIRE cotangent sync for the
-    factored multislice mesh: the data-axis reduction runs as the
+    """Identity whose BACKWARD owns the cotangent sync of ``params`` on
+    the factored multislice mesh: the data-axis reduction runs as the
     explicit two-level algorithm — reduce-scatter('data') ->
     SHARD-SIZED psum('dcn') -> all_gather_invariant('data') — instead
     of shard_map's automatic flat psum, and each leaf's remaining
@@ -384,7 +384,12 @@ def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
     read off its PartitionSpec) get their flat intra-slice psums.  The
     cotangent returns fully vma-invariant, so shard_map inserts nothing
     more: the shard-sized DCN payload is a property of the program,
-    pinned by tests/test_lm.py::test_dcn_payload_is_shard_sized_lm."""
+    pinned by tests/test_lm.py::test_dcn_payload_is_shard_sized_lm.
+
+    Placement is the caller's: the post-backward path wraps the WHOLE
+    tree once (all dcn traffic after the backward drains); overlap=True
+    wraps each layer group at its boundary (``_stream_group_boundary``),
+    so the groups' sync points stream through the backward."""
     @jax.custom_vjp
     def point(p):
         return p
@@ -399,7 +404,8 @@ def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
     return point(params)
 
 
-def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
+def _two_level_sync(g: PyTree, specs: PyTree,
+                    bucket_bytes: int | None = None) -> PyTree:
     """The factored-mesh gradient sync itself (shared by the custom-VJP
     point and the grad-accumulation path): per-leaf flat psums over each
     leaf's remaining invariant axes, then the grouped two-level (data,
@@ -408,13 +414,28 @@ def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
     (say) tp-sharded leaves — whose values legitimately vary over
     'model' — with replicated ones would poison the latter's vma.
 
+    ``bucket_bytes`` (round 9, the grad-accumulation path) splits each
+    group into ~bucket-sized pipelines (``strategies.make_bucket_plan``)
+    instead of one monolithic flat vector per group: bucket N's ICI
+    reduce-scatter can run under bucket N-1's DCN psum.  The reduction
+    is elementwise, so the split changes no sums — numerics are bitwise
+    bucket-independent (test-pinned).
+
     FSDP leaves ('data' in the spec) skip the two-level reduction
     entirely: the ``_fsdp_gather`` transpose already reduce-scattered
     their cotangent over 'data', so what arrives here IS the
     slice-local ZeRO-3 shard — the cross-slice exchange is one
-    shard-sized ``psum('dcn')``, the same DCN payload as the
+    shard-sized ``psum('dcn')`` per bucket, the same DCN payload as the
     replicated-state path."""
-    from .parallel.strategies import two_level_psum
+    from .parallel.strategies import make_bucket_plan, two_level_psum
+
+    def buckets(items: list) -> list[list]:
+        if not items:
+            return []
+        if bucket_bytes is None or len(items) <= 1:
+            return [items]
+        plan = make_bucket_plan([gl for _, gl in items], bucket_bytes)
+        return [[items[j] for j in b] for b in plan]
 
     g_leaves, td = jax.tree.flatten(g)
     s_leaves = jax.tree.leaves(specs)
@@ -431,29 +452,35 @@ def _two_level_sync(g: PyTree, specs: PyTree) -> PyTree:
         else:
             groups.setdefault(frozenset(axes), []).append((i, gl))
     out: list = [None] * len(g_leaves)
-    if fsdp_items:
-        # one psum primitive, per-leaf payloads (no concat: leaves keep
-        # their own vma; each is already data-shard-sized)
-        synced = jax.lax.psum([gl for _, gl in fsdp_items], DCN)
-        for (i, _), s in zip(fsdp_items, synced):
+    for bucket in buckets(fsdp_items):
+        # one psum primitive per bucket, per-leaf payloads (no concat:
+        # leaves keep their own vma; each is already data-shard-sized)
+        synced = jax.lax.psum([gl for _, gl in bucket], DCN)
+        for (i, _), s in zip(bucket, synced):
             out[i] = s
     for items in groups.values():
-        idxs = [i for i, _ in items]
-        synced = two_level_psum([gl for _, gl in items], DCN, DATA)
-        for i, s in zip(idxs, synced):
-            out[i] = s
+        for bucket in buckets(items):
+            idxs = [i for i, _ in bucket]
+            synced = two_level_psum([gl for _, gl in bucket], DCN, DATA)
+            for i, s in zip(idxs, synced):
+                out[i] = s
     return jax.tree.unflatten(td, out)
 
 
-def _fsdp_group_boundary(cfg: LMTrainConfig, specs):
-    """The streaming ZeRO-3 hook (``cfg.overlap``): gather each layer
-    group's fsdp-sharded leaves at the group's boundary in
-    ``transformer.apply`` instead of all-at-once before the stack.  The
-    gathers are the SAME per-leaf ``all_gather`` ops as ``_fsdp_gather``
-    — only their position moves — so trajectories are bitwise-identical;
-    their transposes (the per-leaf gradient reduce-scatters) land
-    interleaved between the layers' backward matmuls, which is the whole
-    point (utils/debug.py op_schedule pins it)."""
+def _stream_group_boundary(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
+    """The streaming (``cfg.overlap``) layer-group hook: at each group's
+    boundary in ``transformer.apply``, wrap the group's params in the
+    two-level DCN sync point (``dcn_sync``, round 9) and/or gather its
+    ZeRO-3 shards (``cfg.fsdp``, round 8) — instead of doing either
+    all-at-once on the whole tree.  The ops are IDENTICAL to the
+    whole-tree path (the two-level reduction is elementwise, the gathers
+    are the same per-leaf all_gathers) — only their position moves, so
+    trajectories are bitwise-identical; in the backward, each group's
+    gradient reduce-scatter (the gather's transpose) runs first and the
+    sync point's shard-sized ``psum('dcn')`` immediately after, right
+    where that group's backward matmuls finish — the per-layer-group
+    streaming the latency-hiding scheduler needs (utils/debug.py
+    op_schedule pins the dcn-axis interleaving)."""
     # one source of truth for the boundary numbering: the model's own
     # group schedule (transformer.sync_group_index), inverted to
     # group-index -> top-level param key
@@ -464,7 +491,15 @@ def _fsdp_group_boundary(cfg: LMTrainConfig, specs):
         if k is None:
             return params
         p = dict(params)
-        p[k] = _fsdp_gather(params[k], specs[k])
+        sub = p[k]
+        # forward order: sync point THEN gather, so the backward runs the
+        # gather's reduce-scatter first and the point's psum('dcn') on
+        # the already-scattered shard — the whole-tree op sequence
+        if dcn_sync:
+            sub = _dcn_sync_point(sub, specs[k])
+        if cfg.fsdp:
+            sub = _fsdp_gather(sub, specs[k])
+        p[k] = sub
         return p
 
     return boundary
@@ -484,15 +519,19 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
     reduce_axes = _batch_axes(cfg) + (SEQ,)
 
     def local_loss(params, tokens, targets, n_total, aux_w):
-        if dcn_sync:
-            # route the data-axis cotangent sync through the explicit
-            # two-level reduction (shard-sized DCN payload)
-            params = _dcn_sync_point(params, specs)
         boundary = None
-        if cfg.fsdp:
-            if cfg.overlap:
-                boundary = _fsdp_group_boundary(cfg, specs)
-            else:
+        if cfg.overlap and (dcn_sync or cfg.fsdp):
+            # streaming (rounds 8-9): per-layer-group sync points and/or
+            # ZeRO-3 gathers at the boundaries instead of whole-tree
+            boundary = _stream_group_boundary(cfg, specs,
+                                              dcn_sync=dcn_sync)
+        else:
+            if dcn_sync:
+                # route the data-axis cotangent sync through the explicit
+                # two-level reduction (shard-sized DCN payload), as one
+                # whole-tree point — the post-backward contrast shape
+                params = _dcn_sync_point(params, specs)
+            if cfg.fsdp:
                 params = _fsdp_gather(params, specs)
         pos = _shard_positions(cfg, tokens.shape[1])
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
@@ -541,8 +580,10 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
     when fsdp is on), local grads accumulate through a lax.scan, and
     the accumulated tree syncs once — per-leaf intra psums + the
     grouped two-level (data, dcn) reduction (shard-sized psum('dcn')
-    for fsdp leaves).  The naive alternative (scanning the synced
-    grad_step) pays A sequential shard-sized DCN round-trips per step.
+    for fsdp leaves), emitted per ~25 MB bucket (round 9) so the
+    exchange pipelines instead of moving as one monolithic per-group
+    vector.  The naive alternative (scanning the synced grad_step)
+    pays A sequential shard-sized DCN round-trips per step.
 
     ``(params, micro_tokens (A, B, S), micro_targets, n_total, aux_w)
     -> (summed loss, synced grads)``; numerics match the scanned path
@@ -562,7 +603,12 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
         zeros = jax.tree.map(jnp.zeros_like, params)
         (loss, g), _ = jax.lax.scan(
             body, (jnp.float32(0), zeros), (micro_t, micro_y))
-        return loss, _two_level_sync(g, specs)
+        # the ONE post-accumulation sync, streamed per ~25 MB bucket
+        # (round 9) instead of as a monolithic per-group tree: bucket
+        # N's ICI reduce-scatter runs under bucket N-1's DCN psum
+        from .parallel.strategies import BUCKET_CAP_MB
+        return loss, _two_level_sync(
+            g, specs, bucket_bytes=BUCKET_CAP_MB * 1024 * 1024)
 
     bspec = _lm_batch_spec(cfg)
     mspec = P(None, *bspec)  # leading scan axis unsharded

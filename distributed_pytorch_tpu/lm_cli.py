@@ -73,8 +73,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--interleave", type=int, default=1,
                    help="virtual pipeline stages per device (shrinks the "
                         "pipeline bubble by this factor)")
+    p.add_argument("--dcn-size", type=int, default=1,
+                   help="multislice factoring of the data axis: dp = "
+                        "dcn-size slices x (dp / dcn-size) chips; the DP "
+                        "gradient sync becomes the explicit two-level "
+                        "reduction (shard-sized cross-slice payload)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
+    p.add_argument("--overlap", action="store_true",
+                   help="stream the step's bulk communication through the "
+                        "layer-group boundaries: per-group ZeRO-3 weight "
+                        "gathers (--fsdp) and/or per-group two-level DCN "
+                        "sync points (--dcn-size > 1), emitted in-backward "
+                        "for the latency-hiding scheduler (bitwise-"
+                        "identical trajectory, test-pinned)")
     # training
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=8,
@@ -168,12 +180,13 @@ def main(argv: list[str] | None = None) -> int:
                        else args.compute_dtype),
         warmup_steps=args.warmup_steps, decay_steps=args.decay_steps,
         dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, ep=args.ep,
-        grad_accum=args.grad_accum,
-        interleave=args.interleave, fsdp=args.fsdp)
+        dcn_size=args.dcn_size, grad_accum=args.grad_accum,
+        interleave=args.interleave, fsdp=args.fsdp, overlap=args.overlap)
     trainer = LMTrainer(cfg)
-    log.info("model: %s | mesh: dp=%d ep=%d sp=%d tp=%d pp=%d over %d devices",
-             cfg.model, args.dp, args.ep, args.sp, args.tp, args.pp,
-             trainer.mesh.devices.size)
+    log.info("model: %s | mesh: dp=%d (dcn=%d) ep=%d sp=%d tp=%d pp=%d "
+             "over %d devices",
+             cfg.model, args.dp, args.dcn_size, args.ep, args.sp, args.tp,
+             args.pp, trainer.mesh.devices.size)
 
     start = 0
     if args.checkpoint_dir:

@@ -642,13 +642,48 @@ class Hierarchical:
     ``TrainConfig.dcn_size`` (number of slices).  With a single flat axis
     (or axis size 1 on either level) it degrades gracefully to the exact
     flat mean.
+
+    ``dcn_compress="int8"`` (round 9, ``TrainConfig.dcn_compress``)
+    additionally quantizes ONLY the slow hop: step 2's shard exchange
+    runs as an int8 ring over ``'dcn'`` (``QuantizedRing._ring_sum`` —
+    int8 payloads + per-256-row f32 scales on every cross-slice
+    transfer, the DynamiQ/EQuARX compress-the-scarce-link design point)
+    while the ICI reduce-scatter/all-gather stay full-precision.  Every
+    bit the wire drops lands in a per-device error-feedback residual
+    threaded through the trainer's stateful sync-state channel (the
+    ``quantized_ring_ef`` carry), so compressed sync converges like
+    exact sync with one step of delay.  Compression makes the strategy
+    stateful AND vma-opaque (the ring assembles its result from
+    ppermute payloads — replicated by construction, not by proof);
+    numerics become bucket-LAYOUT-dependent through the row scales, so
+    post-backward and overlap share ONE ``make_bucket_plan`` packing
+    exactly like the int8 rings.
     """
 
     name = "hierarchical"
     needs_mesh = True
     axes = ("dcn", "ici")  # outer = cross-slice (slow), inner = within-slice
     supports_overlap = True
-    bucket_bytes = BUCKET_CAP_MB * 1024 * 1024
+
+    def __init__(self, dcn_compress: str | None = None, dcn_size: int = 2,
+                 bucket_mb: float = BUCKET_CAP_MB):
+        self.bucket_bytes = int(bucket_mb * 1024 * 1024)
+        self._ring = QuantizedRing()  # int8 quant/dequant/_ring_sum helpers
+        self.set_dcn(dcn_compress, dcn_size)
+
+    def set_dcn(self, compress: str | None, dcn_size: int) -> None:
+        """Configure the slow-hop compression (the trainers propagate
+        ``TrainConfig.dcn_compress``/``dcn_size`` here before building the
+        step OR the sync state — the EF residual layout needs dcn_size)."""
+        if compress not in (None, "int8"):
+            raise ValueError(
+                f"dcn_compress must be None or 'int8', got {compress!r}")
+        self.dcn_compress = compress
+        self.dcn_size = dcn_size
+        # compression adds the EF residual carry and gives up the static
+        # replication proof (ppermute ring on the dcn hop)
+        self.stateful = compress is not None
+        self.vma_opaque = compress is not None
 
     @staticmethod
     def _factor(axis) -> tuple[str | None, str]:
@@ -657,37 +692,113 @@ class Hierarchical:
         dcn, ici = axis
         return dcn, ici
 
-    def __call__(self, grads: PyTree, axis) -> PyTree:
+    # -- EF residual layout (dcn_compress only) ---------------------------
+    def _shard_len(self, total: int, n_ici: int) -> int:
+        """Per-chip ICI shard length of a ``total``-element bucket
+        (psum_scatter pads the flat vector to an n_ici multiple)."""
+        return -(-total // n_ici)
+
+    def _segments(self, leaves: list, n_dcn: int, n_ici: int) -> list[int]:
+        return [n_dcn * self._ring._chunk(
+                    self._shard_len(sum(leaves[i].size for i in b), n_ici),
+                    n_dcn)
+                for b in make_bucket_plan(leaves, self.bucket_bytes)]
+
+    def state_segments(self, leaves: list, n_axis: int) -> list[int]:
+        """Per-bucket residual lengths (n_dcn x the dcn-ring chunk of the
+        ICI shard), bucket-plan order — the layout contract between
+        ``init_state``, ``__call__``, and the overlap markers."""
+        n_ici = n_axis // self.dcn_size
+        return self._segments(leaves, self.dcn_size, n_ici)
+
+    def init_state(self, params: PyTree, n_axis: int) -> jax.Array:
+        if self.dcn_compress is None:
+            return jnp.zeros((0,), jnp.float32)
+        leaves = jax.tree.leaves(params)
+        return jnp.zeros((sum(self.state_segments(leaves, n_axis)),),
+                         jnp.float32)
+
+    def _int8_dcn_reduce(self, dcn, n_dcn, residual, out: dict):
+        """The compressed slow hop: a ``shard -> summed_shard`` callable
+        for ``two_level_psum(dcn_reduce=...)`` that runs the shard
+        exchange as an int8 ring over ``dcn`` and records the dropped
+        quantization error (the EF residual) in ``out``."""
+        def reduce(shard):
+            if n_dcn == 1:  # degraded topology: nothing crosses, no loss
+                out["res"] = jnp.zeros_like(residual)
+                return shard
+            summed, err_rows = self._ring._ring_sum(
+                shard, dcn, n_dcn, residual=residual)
+            out["res"] = err_rows.ravel()
+            return summed
+        return reduce
+
+    def sync_bucket(self, leaves: list, axis, residual: jax.Array | None
+                    = None):
+        # one two-level (reduce-scatter / shard-sized DCN exchange /
+        # gather) reduction per bucket; the plain exchange is elementwise
+        # over devices, so post-backward (whole-tree) and overlap
+        # (per-bucket) sum the same addends per element either way.  The
+        # int8 exchange quantizes against per-row scales of the bucket's
+        # OWN shard, so compressed mode shares the bucket plan instead.
         dcn, ici = self._factor(axis)
-        n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
+        n_dcn = lax.axis_size(dcn) if dcn else 1
+        n = lax.axis_size(ici) * n_dcn
         # the mean division happens on the f32 sum INSIDE two_level_psum
         # (before the cast back to leaf dtype): low-precision leaves must
         # not see the undivided sum, which can overflow their range
-        return two_level_psum(grads, dcn, ici, scale=1.0 / n)
+        if self.dcn_compress is None:
+            return two_level_psum(leaves, dcn, ici, scale=1.0 / n)
+        out: dict = {}
+        synced = two_level_psum(
+            leaves, dcn, ici, scale=1.0 / n,
+            dcn_reduce=self._int8_dcn_reduce(dcn, n_dcn, residual, out))
+        return synced, out["res"]
 
-    def sync_bucket(self, leaves: list, axis) -> list:
-        # one two-level (reduce-scatter / shard-sized DCN psum / gather)
-        # reduction per bucket; sums are elementwise over devices, so the
-        # result is packing-independent ONLY within a bucket — unlike psum
-        # strategies, the reduce-scatter pads each bucket's own flat
-        # vector, so post-backward (whole-tree) and overlap (per-bucket)
-        # differ in f32 summation grouping by nothing: the two-level
-        # algorithm sums the same addends per element either way.
+    def __call__(self, grads: PyTree, axis,
+                 sync_state: jax.Array | None = None):
         dcn, ici = self._factor(axis)
-        n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
-        return two_level_psum(leaves, dcn, ici, scale=1.0 / n)
+        if self.dcn_compress is None:
+            n = lax.axis_size(ici) * (lax.axis_size(dcn) if dcn else 1)
+            return two_level_psum(grads, dcn, ici, scale=1.0 / n)
+        # compressed: one ring-exchanged two-level reduction per plan
+        # bucket, residual segments consumed/refilled in plan order
+        leaves, treedef = jax.tree.flatten(grads)
+        out: list[jax.Array | None] = [None] * len(leaves)
+        n_dcn = lax.axis_size(dcn) if dcn else 1
+        segs = self._segments(leaves, n_dcn, lax.axis_size(ici))
+        new_parts, offset = [], 0
+        for bucket, seg in zip(make_bucket_plan(leaves, self.bucket_bytes),
+                               segs):
+            synced, new_r = self.sync_bucket(
+                [leaves[i] for i in bucket], axis,
+                sync_state[offset:offset + seg])
+            offset += seg
+            new_parts.append(new_r)
+            for i, s in zip(bucket, synced):
+                out[i] = s
+        return (jax.tree.unflatten(treedef, out),
+                jnp.concatenate(new_parts))
 
 
 def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
-                   scale: float | None = None) -> PyTree:
+                   scale: float | None = None,
+                   dcn_reduce: Callable | None = None) -> PyTree:
     """The two-level reduction underlying ``Hierarchical`` (steps 1-3 of
     its docstring): reduce-scatter over ``ici``, a SHARD-SIZED ``psum``
-    over ``dcn`` (the only cross-slice traffic — |grads|/ici bytes),
-    ``all_gather_invariant`` back over ``ici``.  ``scale`` (e.g. 1/n for
-    a mean) applies to the f32 sum before the cast back to each leaf's
-    dtype.  Output is provably replicated over both axes.  Shared with
-    the LM trainer's factored-mesh gradient sync (lm.py dcn_size),
-    whose jaxpr test pins the shard-sized DCN payload."""
+    over ``dcn`` (the only cross-slice traffic — |grads|/ici bytes, a
+    claim scripts/bench_strategies.py now MEASURES per axis from the
+    schedule inspector rather than asserts), ``all_gather_invariant``
+    back over ``ici``.  ``scale`` (e.g. 1/n for a mean) applies to the
+    f32 sum before the cast back to each leaf's dtype.  ``dcn_reduce``
+    replaces the stock ``psum`` on the slow hop with a ``shard ->
+    summed_shard`` callable — ``Hierarchical(dcn_compress='int8')``
+    plugs its quantized ring exchange in here, leaving steps 1 and 3
+    untouched.  Output is provably replicated over both axes (with the
+    stock hop; a ppermute-based ``dcn_reduce`` forfeits the proof — see
+    ``Hierarchical.vma_opaque``).  Shared with the LM trainer's
+    factored-mesh gradient sync (lm.py dcn_size), whose jaxpr test pins
+    the shard-sized DCN payload."""
     n_ici = lax.axis_size(ici)
     leaves, treedef = jax.tree.flatten(grads)
     flat = jnp.concatenate(
@@ -698,7 +809,8 @@ def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
     shard = lax.psum_scatter(padded, ici, scatter_dimension=0, tiled=True)
     # 2. cross-slice all-reduce of the shard (slow link, payload/ici)
     if dcn is not None:
-        shard = lax.psum(shard, dcn)
+        shard = (dcn_reduce(shard) if dcn_reduce is not None
+                 else lax.psum(shard, dcn))
     # 3. gather the sum back within the slice (fast link)
     if _all_gather_inv is not None:
         full = _all_gather_inv(shard, ici, axis=0, tiled=True)
@@ -825,10 +937,7 @@ class OverlapSync:
 
     def __init__(self, strategy, axis, params: PyTree,
                  group_index: dict, *, sync_state: jax.Array | None = None):
-        if not getattr(strategy, "supports_overlap", False):
-            raise ValueError(
-                f"strategy {strategy.name!r} does not support overlap=True; "
-                f"overlap-capable strategies: {overlap_capable()}")
+        require_overlap_capable(strategy)
         self.strategy, self.axis = strategy, axis
         flat, self.treedef = jax.tree_util.tree_flatten_with_path(params)
         self.leaves = [leaf for _, leaf in flat]
@@ -840,8 +949,12 @@ class OverlapSync:
                 raise ValueError(
                     f"stateful strategy {strategy.name!r} needs sync_state "
                     f"for overlap (the per-device EF residual)")
-            segs = strategy.state_segments(self.leaves,
-                                           lax.axis_size(axis))
+            # total device count over a possibly-factored axis (the
+            # hierarchical strategy runs over the ('dcn', 'ici') tuple)
+            n_axis = 1
+            for a in ((axis,) if isinstance(axis, str) else tuple(axis)):
+                n_axis *= lax.axis_size(a)
+            segs = strategy.state_segments(self.leaves, n_axis)
             offs = [0]
             for s in segs:
                 offs.append(offs[-1] + s)
@@ -927,3 +1040,45 @@ def overlap_capable() -> list[str]:
     'preserving naivety on purpose')."""
     return sorted(n for n, c in _REGISTRY.items()
                   if getattr(c, "supports_overlap", False))
+
+
+# -- overlap capability checks (round 9): the ONE definition site ----------
+#
+# Both trainers used to hand-roll their overlap refusals (train.py's
+# strategy check and lm.py's fsdp/dcn check), which let the two messages —
+# and worse, the two CONDITIONS — drift.  They now both call here, next to
+# the machinery (OverlapSync) whose capabilities the checks describe.
+
+def require_overlap_capable(strategy) -> None:
+    """Raise unless ``strategy`` can run as in-backward bucket collectives
+    (``TrainConfig(overlap=True)``); shared by the VGG trainer's config
+    validation and ``OverlapSync`` itself, so the refusal and the
+    machinery can never disagree."""
+    if not getattr(strategy, "supports_overlap", False):
+        raise ValueError(
+            f"strategy {strategy.name!r} does not support overlap=True; "
+            f"overlap-capable strategies: {overlap_capable()} (the "
+            f"sequential baselines keep their serialized wire pattern on "
+            f"purpose)")
+
+
+def require_lm_overlap_streamable(*, fsdp: bool, dcn: bool) -> None:
+    """The LM trainer's overlap capability check
+    (``LMTrainConfig(overlap=True)``): raise unless the config has a
+    post-backward cluster the layer-group boundary hook can stream —
+    ZeRO-3 weight gathers (``fsdp``) and/or the factored-mesh two-level
+    DCN sync points (``dcn`` — dcn_size > 1 AND the sync actually runs
+    in-backward: under grad_accum > 1 the one post-accumulation exchange
+    sits outside the backward, so the caller passes dcn=False there;
+    streamed per layer group since round 9).  With neither, the
+    data-axis cotangent psums are already emitted at each param's use
+    site by shard_map's transpose — there is nothing to stream."""
+    if fsdp or dcn:
+        return
+    raise ValueError(
+        "lm overlap=True streams the ZeRO-3 (fsdp) weight gathers and/or "
+        "the factored-mesh (dcn_size > 1) two-level sync points through "
+        "the layer boundaries; without either there is no post-backward "
+        "cluster to dissolve (BASELINE.md rounds 8-9).  Enable fsdp, set "
+        "dcn_size > 1, or drop overlap (the VGG trainer's overlap=True "
+        "covers the explicit-strategy case)")

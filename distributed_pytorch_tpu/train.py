@@ -72,6 +72,14 @@ class TrainConfig:
     # DCN traffic drops to payload/ici — see strategies.Hierarchical).
     # Ignored by single-axis strategies.
     dcn_size: int = 2
+    # Slow-hop compression for the 'hierarchical' strategy (round 9):
+    # "int8" runs the cross-slice shard exchange as an int8 ring (per-row
+    # scales, error-feedback residuals through the sync-state carry)
+    # while the ICI reduce-scatter/all-gather stay full-precision — see
+    # strategies.Hierarchical's dcn_compress docstring.  None (default)
+    # keeps the exact full-precision psum.  Rejected for strategies with
+    # no DCN hop.
+    dcn_compress: str | None = None
     steps_per_loop: int = 1       # K optimizer steps per device dispatch
     sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
     # torch DDP's broadcast_buffers=True: BN running stats follow rank 0
@@ -143,6 +151,21 @@ def _apply_bucket_mb(cfg: TrainConfig, strategy: strat.Strategy) -> None:
         strategy.bucket_bytes = int(cfg.overlap_bucket_mb * 1024 * 1024)
 
 
+def _apply_dcn(cfg: TrainConfig, strategy: strat.Strategy) -> None:
+    """Propagate cfg.dcn_compress / cfg.dcn_size into the strategy (the
+    hierarchical slow-hop knobs); must run before the step is built AND
+    before init_state (compression flips the strategy stateful and the
+    EF residual layout reads dcn_size).  Strategies without a DCN hop
+    reject the compress knob instead of silently ignoring it."""
+    if hasattr(strategy, "set_dcn"):
+        strategy.set_dcn(cfg.dcn_compress, cfg.dcn_size)
+    elif cfg.dcn_compress is not None:
+        raise ValueError(
+            f"dcn_compress={cfg.dcn_compress!r} quantizes the cross-slice "
+            f"hop of the factored-mesh 'hierarchical' strategy; strategy "
+            f"{strategy.name!r} has no DCN hop to compress")
+
+
 def _validate_overlap(cfg: TrainConfig, strategy: strat.Strategy,
                       mesh: Mesh | None) -> None:
     if not cfg.overlap:
@@ -151,12 +174,9 @@ def _validate_overlap(cfg: TrainConfig, strategy: strat.Strategy,
         raise ValueError(
             "overlap=True requires a mesh: the data-axis collectives are "
             "the thing being overlapped with backward compute")
-    if not getattr(strategy, "supports_overlap", False):
-        raise ValueError(
-            f"strategy {strategy.name!r} does not support overlap=True; "
-            f"overlap-capable strategies: {strat.overlap_capable()} (the "
-            f"sequential baselines keep their serialized wire pattern on "
-            f"purpose)")
+    # the ONE capability-check site (strategies.py, round 9): the refusal
+    # lives next to the OverlapSync machinery it describes
+    strat.require_overlap_capable(strategy)
 
 
 def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
@@ -213,6 +233,12 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     exactly regardless of steps_per_loop.
     """
     tx = make_optimizer(cfg)
+    # Strategy knobs FIRST: dcn compression flips `stateful`/`vma_opaque`
+    # on the hierarchical strategy, and the bucket cap feeds both the
+    # overlap markers and the post-backward packing.
+    _apply_dcn(cfg, strategy)
+    _apply_bucket_mb(cfg, strategy)
+    _validate_overlap(cfg, strategy, mesh)
     # The data axis may be factored: hierarchical runs over ('dcn', 'ici').
     data_axes = getattr(strategy, "axes", None) or DATA_AXIS
     bn_axis = data_axes if (cfg.sync_bn and mesh is not None) else None
@@ -232,8 +258,6 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     # (strategies.sync_boundary_stateful), threaded back into the scan
     # carry exactly like the post-backward path's returned state.
     overlap = cfg.overlap
-    _validate_overlap(cfg, strategy, mesh)
-    _apply_bucket_mb(cfg, strategy)
     if overlap:
         group_idx = vgg.sync_group_index(cfg.model)
 
@@ -453,10 +477,24 @@ class Trainer:
             raise ValueError(
                 f"strategy {self.strategy.name!r} needs a mesh with axes "
                 f"{self.data_axes}, got {mesh.axis_names}")
+        if self.strategy.needs_mesh and isinstance(self.data_axes, tuple):
+            # caller-supplied factored meshes too: the outer (dcn) extent
+            # must match cfg.dcn_size — the int8 EF residual layout and
+            # the bench accounting are sized from the config, and a
+            # mismatch would surface as a cryptic reshape at trace time
+            dcn_axis = self.data_axes[0]
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes[dcn_axis] != cfg.dcn_size:
+                raise ValueError(
+                    f"mesh {dcn_axis!r} axis has size {sizes[dcn_axis]} "
+                    f"but cfg.dcn_size is {cfg.dcn_size}; pass a mesh "
+                    f"matching the config (or mesh=None to build one)")
         self.mesh = mesh if self.strategy.needs_mesh else None
         self.n_replicas = self.mesh.devices.size if self.mesh else 1
-        # overlap knobs must land before init_state (the EF residual layout
-        # follows the bucket plan) and fail fast on incapable strategies
+        # strategy knobs must land before init_state (dcn compression
+        # flips statefulness and the EF residual layout follows the
+        # bucket plan + dcn_size) and fail fast on incapable strategies
+        _apply_dcn(cfg, self.strategy)
         _apply_bucket_mb(cfg, self.strategy)
         _validate_overlap(cfg, self.strategy, self.mesh)
 

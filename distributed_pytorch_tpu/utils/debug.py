@@ -135,11 +135,16 @@ def op_schedule(fn: Callable, *args, **kwargs) -> list[dict]:
     return jaxpr_schedule(jax.make_jaxpr(fn)(*args, **kwargs))
 
 
-def collective_stats(sched: list[dict], axes=None) -> dict:
+def collective_stats(sched: list[dict], axes=None,
+                     min_bytes: int = 0) -> dict:
     """Interleaving statistics for the collectives in a schedule.
 
     ``axes``: restrict to collectives touching ANY of these mesh axes
     (e.g. ("data",) for the data-parallel gradient sync; None = all).
+    ``min_bytes``: drop collectives below this operand payload — the
+    LM steps psum scalar loss/token-count values over the batch axes
+    mid-graph, and a gradient-sync interleaving pin must not count a
+    4-byte loss reduction as overlapped sync traffic.
 
     Returns counts over the STATIC schedule: ``total`` collectives,
     ``interleaved`` (compute BOTH before and after — emitted strictly
@@ -161,6 +166,8 @@ def collective_stats(sched: list[dict], axes=None) -> dict:
             continue
         if axes is not None and not (axes & set(r["axes"])):
             continue
+        if r["bytes"] < min_bytes:
+            continue
         total += 1
         nbytes += r["bytes"]
         trips = r.get("trips", 1)
@@ -175,13 +182,32 @@ def collective_stats(sched: list[dict], axes=None) -> dict:
             "executions": executions, "bytes_executed": nbytes_exec}
 
 
+def per_axis_collective_stats(sched: list[dict],
+                              min_bytes: int = 0) -> dict[str, dict]:
+    """``collective_stats`` split BY MESH AXIS: one stats dict per axis
+    name appearing in the schedule ({'dcn': ..., 'ici': ...} for the
+    factored-mesh strategies), so wire accounting can attribute traffic
+    to the link that carries it — cross-slice DCN bytes separately from
+    within-slice ICI bytes (scripts/bench_strategies.py's per-axis
+    columns; the measurement behind two_level_psum's |grads|/ici claim).
+    A collective running over several axes at once (a flat psum over
+    ('data', 'expert')) counts toward EACH of them — per-axis rows are
+    attribution, not a partition, and need not sum to the total."""
+    axes = sorted({a for r in sched if r["kind"] == "collective"
+                   for a in r["axes"]})
+    return {a: collective_stats(sched, axes=(a,), min_bytes=min_bytes)
+            for a in axes}
+
+
 def assert_overlap_schedule(sched: list[dict], axes=("data",),
-                            min_interleaved: int = 2) -> dict:
+                            min_interleaved: int = 2,
+                            min_bytes: int = 0) -> dict:
     """Assert the overlap property: at least ``min_interleaved``
     ``axes``-collectives sit STRICTLY BETWEEN compute ops (backward
     matmuls run after them — the latency-hiding scheduler has something
-    to overlap).  Returns the stats for reporting."""
-    stats = collective_stats(sched, axes=axes)
+    to overlap).  ``min_bytes`` excludes scalar loss reductions (see
+    collective_stats).  Returns the stats for reporting."""
+    stats = collective_stats(sched, axes=axes, min_bytes=min_bytes)
     if stats["interleaved"] < min_interleaved:
         raise ConsistencyError(
             f"expected >= {min_interleaved} {tuple(axes)}-collectives "
@@ -192,11 +218,13 @@ def assert_overlap_schedule(sched: list[dict], axes=("data",),
 
 
 def assert_post_backward_schedule(sched: list[dict],
-                                  axes=("data",)) -> dict:
+                                  axes=("data",),
+                                  min_bytes: int = 0) -> dict:
     """Assert the historical post-backward shape: every ``axes``-collective
     comes AFTER the last compute op (all-at-the-end; nothing for the
-    scheduler to overlap)."""
-    stats = collective_stats(sched, axes=axes)
+    scheduler to overlap).  ``min_bytes`` excludes the scalar loss
+    reductions that legitimately sit mid-graph (see collective_stats)."""
+    stats = collective_stats(sched, axes=axes, min_bytes=min_bytes)
     if stats["interleaved"] != 0 or stats["tail"] != stats["total"]:
         raise ConsistencyError(
             f"expected all {tuple(axes)}-collectives after the final "

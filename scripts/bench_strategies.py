@@ -73,27 +73,41 @@ def comm_profile(tr: Trainer, images, labels) -> dict:
                                        fault_sig=tr._fault_sig)
     sched = dbg.op_schedule(tr._multi_fn, *args)
     stats = dbg.collective_stats(sched)
+    per_axis = dbg.per_axis_collective_stats(sched)
     hlo = dbg.hlo_collective_counts(tr._multi_fn.lower(*args).as_text())
     return {"comm_bytes_per_step": stats["bytes_executed"],
             "collective_count": stats["executions"],
             "comm_bytes_static": stats["bytes"],
             "collective_count_static": stats["total"],
             "collectives_interleaved": stats["interleaved"],
+            # per-AXIS attribution (round 9): dcn vs ici (vs data) bytes
+            # and collective counts, so the factored strategies' cross-
+            # slice claim (two_level_psum: |grads|/ici over DCN) is
+            # MEASURED per link, not asserted.  A multi-axis collective
+            # counts toward each axis it runs over.
+            "comm_bytes_by_axis": {a: s["bytes_executed"]
+                                   for a, s in per_axis.items()},
+            "collective_count_by_axis": {a: s["executions"]
+                                         for a, s in per_axis.items()},
             "hlo_collective_count": hlo.pop("total"),
             "hlo_collectives": hlo}
 
 
-def bench_strategy(name: str) -> tuple[float, dict]:
-    """(mean seconds/step over WINDOW iterations, comm profile); compile +
-    warm-up excluded (the reference's iter-0-excluded window,
-    main.py:43-48)."""
+def bench_strategy(name: str) -> tuple[float, dict, bool]:
+    """(mean seconds/step over WINDOW iterations, comm profile, overlap
+    used); compile + warm-up excluded (the reference's iter-0-excluded
+    window, main.py:43-48).  ``hierarchical_int8`` is the hierarchical
+    strategy with the int8-compressed DCN hop (TrainConfig.dcn_compress)."""
+    compress = None
+    if name == "hierarchical_int8":
+        name, compress = "hierarchical", "int8"
     # Factored-axis strategies (hierarchical): mesh=None lets the Trainer
     # build the right ('dcn', 'ici') mesh from cfg.dcn_size — one recipe.
     factored = getattr(strat.get(name), "axes", None) is not None
     mesh = make_mesh(N_DEV) if (name != "none" and not factored) else None
     overlap = OVERLAP and name in strat.overlap_capable() and name != "none"
     cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False,
-                      overlap=overlap)
+                      overlap=overlap, dcn_compress=compress)
     tr = Trainer(cfg, mesh=mesh)
     n = tr.n_replicas
     rng = np.random.default_rng(0)
@@ -109,34 +123,42 @@ def bench_strategy(name: str) -> tuple[float, dict]:
         loss = tr.train_step(images, labels)
         float(loss)  # value fetch: the honest end-of-step barrier
         times.append(time.perf_counter() - t0)
-    return sum(times) / len(times), comm
+    return sum(times) / len(times), comm, overlap
 
 
 def main() -> None:
-    names = ["none", "ddp", "bucketed", "hierarchical", "all_reduce",
-             "gather_scatter_symmetric", "gather_scatter",
+    names = ["none", "ddp", "bucketed", "hierarchical", "hierarchical_int8",
+             "all_reduce", "gather_scatter_symmetric", "gather_scatter",
              "quantized", "quantized_ring", "quantized_ring_ef"]
     results: dict[str, float] = {}
     comms: dict[str, dict] = {}
     for name in names:
-        t, comm = bench_strategy(name)
+        t, comm, overlap = bench_strategy(name)
         results[name], comms[name] = t, comm
         print(json.dumps({"strategy": name, "sec_per_step": round(t, 4),
                           "window": WINDOW,
                           "per_dev_batch": PER_DEV_BATCH,
-                          "overlap": OVERLAP and name in
-                          strat.overlap_capable(),
+                          "overlap": overlap,
                           **comm}), flush=True)
 
+    def axis_mb(c: dict) -> str:
+        """dcn/ici MB column for the factored strategies, '-' otherwise."""
+        by_axis = c["comm_bytes_by_axis"]
+        if "dcn" not in by_axis:
+            return "-"
+        return (f"{by_axis['dcn'] / 1e6:.2f}/"
+                f"{by_axis.get('ici', 0) / 1e6:.2f}")
+
     ddp = results["ddp"]
-    print("\n| Strategy | s/step | vs ddp | comm MB/step | collectives "
-          "(interleaved) | HLO collectives |", file=sys.stderr)
-    print("|---|---|---|---|---|---|", file=sys.stderr)
+    print("\n| Strategy | s/step | vs ddp | comm MB/step | dcn/ici MB | "
+          "collectives (interleaved) | HLO collectives |", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|", file=sys.stderr)
     for name in names:
         c = comms[name]
         print(f"| {name} | {results[name]:.3f} | "
               f"{results[name] / ddp:.2f}x | "
               f"{c['comm_bytes_per_step'] / 1e6:.2f} | "
+              f"{axis_mb(c)} | "
               f"{c['collective_count']} ({c['collectives_interleaved']}) | "
               f"{c['hlo_collective_count']} |", file=sys.stderr)
 

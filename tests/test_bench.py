@@ -65,13 +65,54 @@ def test_bench_json_keys_include_transformer_gates():
                 "serving_emitted_per_slot_step",
                 # round-8 backward-overlap A/B keys
                 "train_overlap_speedup", "train_step_ms_overlap",
-                "train_step_ms_post_backward"):
+                "train_step_ms_post_backward",
+                # round-9 factored-mesh DCN A/B keys
+                "train_dcn_overlap_speedup", "train_dcn_bytes_per_step",
+                "train_dcn_compress"):
         assert key in src, key
     # the knob reaches both inference gates
     assert "BENCH_KV_DTYPE" in src
     # the overlap knob is validated PRE-bench (canon_overlap_env), same
     # fail-loudly contract as BENCH_KV_DTYPE
     assert "canon_overlap_env" in src
+    # the dcn knobs too (round 9): size and slow-hop compression both
+    # canonicalized before any measurement
+    assert "canon_dcn_size_env" in src and "BENCH_DCN_SIZE" in src
+    assert "canon_dcn_compress_env" in src and "BENCH_DCN_COMPRESS" in src
+
+
+def test_bench_dcn_env_knobs_fail_loudly():
+    """Typo'd BENCH_DCN_SIZE / BENCH_DCN_COMPRESS must raise before any
+    measurement; unset/0/none skip cleanly."""
+    assert bench.canon_dcn_size_env(None) == 0
+    assert bench.canon_dcn_size_env("") == 0
+    assert bench.canon_dcn_size_env("0") == 0
+    assert bench.canon_dcn_size_env("2") == 2
+    assert bench.canon_dcn_size_env("4") == 4
+    for bad in ("1", "-2", "two", "2.5"):
+        with pytest.raises(ValueError, match="BENCH_DCN_SIZE"):
+            bench.canon_dcn_size_env(bad)
+    assert bench.canon_dcn_compress_env(None) is None
+    assert bench.canon_dcn_compress_env("") is None
+    assert bench.canon_dcn_compress_env("none") is None
+    assert bench.canon_dcn_compress_env("int8") == "int8"
+    for bad in ("fp8", "INT8", "1"):
+        with pytest.raises(ValueError, match="BENCH_DCN_COMPRESS"):
+            bench.canon_dcn_compress_env(bad)
+
+
+def test_bench_train_dcn_uses_hardened_window_and_inspector():
+    """The dcn A/B inherits the hardened-window discipline (>= 5
+    alternating reps, median, precompile outside the window) and reads
+    its byte columns from the per-axis schedule inspector rather than
+    asserting them."""
+    import inspect
+    sig = inspect.signature(bench.bench_train_dcn)
+    assert sig.parameters["reps"].default >= 5
+    src = inspect.getsource(bench.bench_train_dcn)
+    assert "hierarchical" in src and "precompile_steps" in src
+    assert "per_axis_collective_stats" in src
+    assert "dcn_compress=compress" in src
 
 
 def test_bench_overlap_env_knob_fails_loudly():
@@ -109,7 +150,11 @@ def test_bench_strategies_emits_comm_columns():
         src = f.read()
     for key in ("comm_bytes_per_step", "collective_count",
                 "collectives_interleaved", "hlo_collective_count",
-                "op_schedule", "hlo_collective_counts"):
+                "op_schedule", "hlo_collective_counts",
+                # round 9: per-axis (dcn vs ici) byte/count columns from
+                # per_axis_collective_stats, plus the compressed-hop row
+                "comm_bytes_by_axis", "collective_count_by_axis",
+                "per_axis_collective_stats", "hierarchical_int8"):
         assert key in src, key
 
 

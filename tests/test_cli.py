@@ -30,6 +30,27 @@ def test_parser_defaults_match_reference():
     assert args.seed == 1            # main.py:70
 
 
+def test_parser_overlap_and_dcn_flags():
+    """Round-9 surface: the overlap + dcn-compression knobs reach
+    TrainConfig (defaults off/None so historical invocations are
+    byte-identical)."""
+    args = cli.build_parser().parse_args([])
+    assert args.overlap is False and args.dcn_compress is None
+    assert args.overlap_bucket_mb is None
+    args = cli.build_parser().parse_args(
+        ["--strategy", "hierarchical", "--dcn-size", "2",
+         "--dcn-compress", "int8", "--overlap",
+         "--overlap-bucket-mb", "0.5"])
+    assert args.dcn_compress == "int8" and args.overlap
+    assert args.overlap_bucket_mb == 0.5
+    from distributed_pytorch_tpu import lm_cli
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.dcn_size == 1 and lm_args.overlap is False
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--dp", "4", "--dcn-size", "2", "--fsdp", "--overlap"])
+    assert lm_args.dcn_size == 2 and lm_args.overlap
+
+
 def test_init_single_host_is_noop():
     dist_init.init_distributed(None, num_nodes=1, rank=0)  # must not raise
 

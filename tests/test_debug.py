@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_pytorch_tpu.parallel import strategies as strat
 from distributed_pytorch_tpu.parallel.mesh import make_mesh
 from distributed_pytorch_tpu.train import TrainConfig, Trainer
 from distributed_pytorch_tpu.utils import debug as dbg
@@ -112,12 +113,15 @@ def test_trace_writes_profile(tmp_path):
 
 # -- schedule inspector (round 8): proving comm/compute overlap on CPU ------
 
-def _train_sched(strategy: str, overlap: bool):
+def _train_sched(strategy: str, overlap: bool, **cfg_kw):
     """(schedule, lowered HLO text) of the real compiled train step."""
     cfg = TrainConfig(strategy=strategy, batch_size=4, augment=False,
                       model="TINY", overlap=overlap, overlap_bucket_mb=0.02,
-                      broadcast_buffers=False)
-    tr = Trainer(cfg, make_mesh(4))
+                      broadcast_buffers=False, **cfg_kw)
+    # factored-axis strategies (hierarchical): the Trainer builds its own
+    # ('dcn', 'ici') mesh from cfg.dcn_size
+    factored = getattr(strat.get(strategy), "axes", None) is not None
+    tr = Trainer(cfg, None if factored else make_mesh(4))
     rng = np.random.default_rng(0)
     images = rng.integers(0, 256, (1, 16, 32, 32, 3)).astype(np.uint8)
     labels = rng.integers(0, 10, (1, 16)).astype(np.int32)
@@ -160,6 +164,38 @@ def test_overlap_schedule_ddp_and_ring():
         sched, _ = _train_sched(name, overlap=True)
         dbg.assert_overlap_schedule(sched, axes=("data",),
                                     min_interleaved=2)
+
+
+def test_per_axis_attribution_pins_dcn_vs_ici():
+    """Per-axis collective attribution (round 9): on the factored
+    ('dcn', 'ici') mesh the inspector splits wire traffic by link, so
+    (a) the hierarchical strategy's cross-slice claim — |grads|/ici
+    bytes over DCN, a fraction of the ICI traffic — is MEASURED, and
+    (b) dcn-axis interleaving is pinned separately from ici: overlap
+    places >= 2 dcn collectives strictly between backward matmuls,
+    post-backward places none."""
+    over_sched, _ = _train_sched("hierarchical", overlap=True)
+    base_sched, _ = _train_sched("hierarchical", overlap=False)
+
+    per_axis = dbg.per_axis_collective_stats(base_sched)
+    assert set(per_axis) >= {"dcn", "ici"}, per_axis
+    # the slow hop moves shard-sized payloads: strictly less than the
+    # within-slice traffic (ici carries the full reduce-scatter/gather)
+    assert 0 < per_axis["dcn"]["bytes_executed"] < \
+        per_axis["ici"]["bytes_executed"]
+
+    dbg.assert_overlap_schedule(over_sched, axes=("dcn",),
+                                min_interleaved=2, min_bytes=65)
+    dbg.assert_post_backward_schedule(base_sched, axes=("dcn",),
+                                      min_bytes=65)
+    # int8 dcn compression shrinks ONLY the slow hop (ici byte-identical)
+    int8_sched, _ = _train_sched("hierarchical", overlap=False,
+                                 dcn_compress="int8")
+    pa8 = dbg.per_axis_collective_stats(int8_sched)
+    assert pa8["dcn"]["bytes_executed"] * 2 < \
+        per_axis["dcn"]["bytes_executed"]
+    assert pa8["ici"]["bytes_executed"] == \
+        per_axis["ici"]["bytes_executed"]
 
 
 def test_inspector_sees_ring_wire_compression():
@@ -227,6 +263,15 @@ def test_op_schedule_units():
     dbg.assert_overlap_schedule(sched, min_interleaved=1)
     with pytest.raises(dbg.ConsistencyError, match="post|after|final"):
         dbg.assert_post_backward_schedule(sched)
+    # per-axis attribution: one stats row per axis name, multi-axis
+    # collectives counted toward EACH axis; min_bytes drops small ops
+    assert dbg.per_axis_collective_stats(sched) == {"data": stats}
+    assert dbg.collective_stats(sched, axes=("data",),
+                                min_bytes=64)["total"] == 0
+    synth = [{"kind": "collective", "prim": "psum",
+              "axes": ("dcn", "ici"), "bytes": 8, "trips": 1}]
+    per = dbg.per_axis_collective_stats(synth)
+    assert per["dcn"]["total"] == 1 and per["ici"]["total"] == 1
     # HLO counter: definition sites only, references don't double-count
     txt = ('%all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %x), ...\n'
            '%add = f32[8]{0} add(f32[8]{0} %all-reduce.1, %y)\n'

@@ -255,16 +255,157 @@ def test_fsdp_overlap_streams_gathers_and_is_bitwise():
 
 
 def test_lm_overlap_validation():
-    """overlap=True is the streaming-fsdp mode: without fsdp (nothing to
-    stream — the data-axis cotangent psums already sit at use sites) or
-    on the factored dcn mesh (whole-tree sync point) it must refuse, not
-    silently no-op."""
+    """overlap=True streams ZeRO-3 gathers and/or the factored-mesh DCN
+    sync points; with NEITHER fsdp nor dcn_size > 1 there is nothing to
+    stream (the data-axis cotangent psums already sit at use sites) and
+    it must refuse, not silently no-op.  The round-8 overlap+dcn refusal
+    is GONE (round 9): the streamed two-level sync composes — with and
+    without fsdp."""
     from distributed_pytorch_tpu.lm import validate_lm_cfg
     with pytest.raises(ValueError, match="fsdp"):
         validate_lm_cfg(LMTrainConfig(dp=4, overlap=True))
-    with pytest.raises(ValueError, match="dcn"):
-        validate_lm_cfg(LMTrainConfig(dp=4, dcn_size=2, fsdp=True,
+    # round 9: the previously-raising compositions are now valid configs
+    validate_lm_cfg(LMTrainConfig(dp=4, dcn_size=2, fsdp=True,
+                                  overlap=True))
+    validate_lm_cfg(LMTrainConfig(dp=4, dcn_size=2, overlap=True))
+    validate_lm_cfg(LMTrainConfig(dp=4, dcn_size=2, grad_accum=2,
+                                  fsdp=True, overlap=True))
+    # ... but dcn + grad_accum WITHOUT fsdp still refuses: the one
+    # post-accumulation exchange sits outside the backward, so overlap
+    # would be a silent no-op there
+    with pytest.raises(ValueError, match="fsdp"):
+        validate_lm_cfg(LMTrainConfig(dp=4, dcn_size=2, grad_accum=2,
                                       overlap=True))
+
+
+@pytest.mark.parametrize("fsdp", [False, True])
+def test_lm_dcn_overlap_streams_and_is_bitwise(fsdp):
+    """Streaming two-level DCN sync (round 9): with ``overlap=True`` on
+    the factored (dcn, data) mesh, the whole-tree ``_dcn_sync_point``
+    becomes one per-layer-group sync point each.  Three pins:
+
+    (a) BITWISE trajectory equality — params AND optimizer state — over
+        a multi-step run vs the whole-tree path (the two-level reduction
+        is elementwise, so regrouping changes no sums; same ops, moved);
+    (b) the compiled program actually streams: >= 2 non-scalar dcn-axis
+        collectives land STRICTLY BETWEEN backward matmuls under overlap
+        (``min_bytes`` excludes the scalar loss psums that legitimately
+        cross 'dcn' mid-graph), while the whole-tree path emits every
+        non-scalar dcn collective after the final matmul;
+    (c) zero EXTRA compiles: the streamed step's compile count equals
+        the whole-tree path's, and it reaches steady state (no
+        marker-induced retrace on later steps).
+    """
+    from distributed_pytorch_tpu.lm import make_lm_mesh, make_lm_train_step
+    from distributed_pytorch_tpu.lm import make_optimizer as lm_opt
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.utils import debug as dbg
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                  n_heads=2, head_dim=32, d_ff=128)
+    tokens, targets = _data(b=4, s=64, vocab=256)
+
+    compiles = {}
+
+    def run(overlap):
+        cfg = LMTrainConfig(model=model, dp=4, dcn_size=2, fsdp=fsdp,
+                            overlap=overlap, compute_dtype=None)
+        tr = LMTrainer(cfg)
+        for _ in range(3):
+            tr.train_step(tokens, targets)
+        if hasattr(tr.step_fn, "_cache_size"):
+            compiles[overlap] = tr.step_fn._cache_size()
+        return jax.tree.map(lambda x: np.array(x, copy=True),
+                            (tr.params, tr.opt_state))
+
+    base, over = run(False), run(True)
+    for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(over)):
+        np.testing.assert_array_equal(a, b)
+    # the per-group markers cost no extra compiles over the whole-tree
+    # path (both reach the same steady state by step 3)
+    if compiles:
+        assert compiles[True] == compiles[False], compiles
+
+    def dcn_schedule(overlap):
+        cfg = LMTrainConfig(model=model, dp=4, dcn_size=2, fsdp=fsdp,
+                            overlap=overlap, compute_dtype=None)
+        step = make_lm_train_step(cfg, make_lm_mesh(cfg))
+        params = tfm.init(jax.random.key(0), model)
+        opt = lm_opt(cfg).init(params)
+        sched = dbg.op_schedule(step, params, opt, jnp.asarray(tokens),
+                                jnp.asarray(targets))
+        return sched
+
+    # scalar loss/aux/token-count psums cross 'dcn' mid-graph by design;
+    # the gradient-sync pins look only at non-scalar payloads
+    dbg.assert_overlap_schedule(dcn_schedule(True), axes=("dcn",),
+                                min_interleaved=2, min_bytes=65)
+    dbg.assert_post_backward_schedule(dcn_schedule(False), axes=("dcn",),
+                                      min_bytes=65)
+
+
+def test_two_level_sync_bucket_split_is_bitwise():
+    """The grad-accumulation path's post-scan sync streams per ~bucket
+    (round 9): splitting a spec group into buckets changes NOTHING —
+    the two-level reduction is elementwise — while the program carries
+    one shard-sized dcn psum PER BUCKET (the pipelineable layout)."""
+    from distributed_pytorch_tpu.lm import _two_level_sync, make_lm_mesh
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.utils.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                                  n_heads=2, head_dim=16)
+    mesh = make_lm_mesh(LMTrainConfig(model=model, dp=4, dcn_size=2))
+    grads = {"a": jnp.arange(2100, dtype=jnp.float32),
+             "b": jnp.ones((3000,), jnp.float32)}
+    specs = {"a": P(), "b": P()}
+    axes = ("dcn", "data", "expert", "seq", "model")
+
+    def f(g):
+        g = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axes, to="varying"), g)
+        mono = _two_level_sync(g, specs)
+        bucketed = _two_level_sync(g, specs, bucket_bytes=4096)
+        return jax.tree.map(lambda x, y: jnp.max(jnp.abs(x - y)),
+                            mono, bucketed)
+
+    diffs = jax.jit(shard_map(f, mesh=mesh, in_specs=(P(),),
+                              out_specs=P(), check_vma=False))(grads)
+    for k, d in diffs.items():
+        assert float(d) == 0.0, (k, float(d))
+
+    # program shape: the bucketed sync carries one dcn psum per bucket
+    # (two here: the 3000-leaf bucket, then the 2100-leaf one), each
+    # shard-sized — vs ONE for the monolithic group
+    import re
+
+    def dcn_payloads(fn):
+        jaxpr = str(jax.make_jaxpr(shard_map(
+            fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False))(grads))
+        sizes = []
+        for ln in jaxpr.splitlines():
+            if "psum" in ln and "'dcn'" in ln:
+                for dims in re.findall(r"f32\[([\d,]+)\]", ln):
+                    n = int(np.prod([int(d) for d in dims.split(",")]))
+                    if n > 1:
+                        sizes.append(n)
+        return sorted(sizes)
+
+    def mono(g):
+        g = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axes, to="varying"), g)
+        return _two_level_sync(g, specs)
+
+    def bucketed(g):
+        g = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axes, to="varying"), g)
+        return _two_level_sync(g, specs, bucket_bytes=4096)
+
+    assert dcn_payloads(mono) == [-(-5100 // 2)]
+    assert dcn_payloads(bucketed) == sorted(
+        [-(-2100 // 2), -(-3000 // 2)])
 
 
 def test_fsdp_checkpoint_roundtrip(tmp_path):
@@ -593,12 +734,17 @@ def test_dcn_factored_lm_matches_flat_dp():
     runs = {}
     for name, kw in {"flat": dict(dp=4),
                      "dcn2x2": dict(dp=4, dcn_size=2),
+                     "dcn2x2_ov": dict(dp=4, dcn_size=2, overlap=True),
                      "dcn2x1_sp2_tp2": dict(dp=2, dcn_size=2, sp=2,
                                             tp=2)}.items():
         tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None, **kw))
         runs[name] = [float(tr.train_step(tokens, targets))
                       for _ in range(3)]
     np.testing.assert_allclose(runs["dcn2x2"], runs["flat"], rtol=2e-5)
+    # streaming per-group sync points (round 9): same trajectory as the
+    # whole-tree point, hence as flat dp
+    np.testing.assert_allclose(runs["dcn2x2_ov"], runs["dcn2x2"],
+                               rtol=0, atol=0)
     np.testing.assert_allclose(runs["dcn2x1_sp2_tp2"], runs["flat"],
                                rtol=2e-5)
     # eval runs on the factored mesh too
