@@ -70,6 +70,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient-accumulation microbatches per optimizer "
                         "step (peak activation memory drops ~A-fold; CE "
                         "gradient exact)")
+    p.add_argument("--pp-size", type=int, default=0,
+                   help="interleaved-1F1B pipeline stages over a dedicated "
+                        "'pp' mesh axis (round 10): layer chunks cut on "
+                        "layer-group boundaries, one-forward-one-backward "
+                        "microbatch schedule with explicit per-unit "
+                        "backward, bitwise-identical trajectory to "
+                        "pp_size=1 (composes with --fsdp/--tp/--dcn-size/"
+                        "--grad-accum/--overlap; distinct from --pp, the "
+                        "forward-wave scheduler)")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="in-flight microbatches per optimizer step for "
+                        "--pp-size (M >= pp_size required; steady-state "
+                        "bubble fraction is (pp-1)/(pp-1+M); default "
+                        "2*pp_size)")
     p.add_argument("--interleave", type=int, default=1,
                    help="virtual pipeline stages per device (shrinks the "
                         "pipeline bubble by this factor)")
@@ -180,13 +194,14 @@ def main(argv: list[str] | None = None) -> int:
                        else args.compute_dtype),
         warmup_steps=args.warmup_steps, decay_steps=args.decay_steps,
         dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, ep=args.ep,
+        pp_size=args.pp_size, microbatches=args.microbatches,
         dcn_size=args.dcn_size, grad_accum=args.grad_accum,
         interleave=args.interleave, fsdp=args.fsdp, overlap=args.overlap)
     trainer = LMTrainer(cfg)
     log.info("model: %s | mesh: dp=%d (dcn=%d) ep=%d sp=%d tp=%d pp=%d "
-             "over %d devices",
+             "pp_size=%d over %d devices",
              cfg.model, args.dp, args.dcn_size, args.ep, args.sp, args.tp,
-             args.pp, trainer.mesh.devices.size)
+             args.pp, args.pp_size, trainer.mesh.devices.size)
 
     start = 0
     if args.checkpoint_dir:

@@ -60,7 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models import transformer as tfm
-from ..utils.compat import pcast, vma_of
+from ..utils.compat import opt_barrier, pcast, vma_of
 
 PyTree = Any
 
@@ -160,11 +160,24 @@ def _chunk(chunk_layers: PyTree, x: jax.Array,
         pos = jnp.arange(x.shape[1])
 
     def body(carry, lp):
-        x, aux_acc = carry
+        # Fusion barrier at the body boundary (both passes — compat's
+        # opt_barrier also barriers the cotangent): a rolled scan body is
+        # a fusion unit by construction (the while-loop boundary), but XLA
+        # UNROLLS trip-count-1 scans and then fuses the body with its
+        # neighbours, perturbing f32 reduction vectorization sub-ulp — a
+        # 1-layer pipeline chunk would train measurably ≠ the same layer
+        # inside a longer chunk (found by the round-10 bitwise pins: every
+        # per>=2 split exact, every per=1 split off by ~1e-10).  The
+        # explicit barrier pins the body's compilation boundary at every
+        # trip count; rolled splits (>= 2 layers per chunk) are bitwise ==
+        # monolithic, and 1-layer chunks keep a residual ~1e-10 drift from
+        # the reverse-scan residual layouts the barrier cannot reach —
+        # the bitwise pins run per >= 2, the per=1 corner pins allclose.
+        x, aux_acc = opt_barrier(carry)
         x, aux = tfm.block(lp, x, cfg=cfg, is_moe=is_moe, pos=pos,
                            attn_impl=attn_impl, tp_axis=tp_axis,
                            seq_axis=seq_axis, seq_layout=seq_layout)
-        return (x, aux_acc + aux), None
+        return opt_barrier((x, aux_acc + aux)), None
 
     # aux carry starts with x's vma so the scan carry types are stable
     aux0 = jnp.zeros((), jnp.float32)
@@ -183,6 +196,236 @@ def num_ticks(m_micro: int, n: int, interleave: int) -> int:
     big_n = n * interleave
     return ((waves - 1) * big_n + (interleave - 1) * n
             + ((m_micro - 1) % n) + n)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved-1F1B over the 'pp' mesh axis (round 10).
+#
+# The wave schedule above is the forward-only SPMD formulation (one scanned
+# tick body, backward synthesized by autodiff).  The 1F1B machinery below is
+# its training-schedule sibling for lm.py's ``pp_size``: the transformer's
+# layer GROUPS (models/transformer.sync_group_index — the same boundary
+# schedule that places the streaming ZeRO-3 gathers and DCN sync points)
+# are partitioned into ``pp_size * interleave`` contiguous chunks, chunk j
+# living on physical stage j % pp_size (Megatron's round-robin interleaved
+# placement), and the train step EMITS each (chunk, microbatch) forward/
+# backward unit in the order of an explicit one-forward-one-backward
+# timetable, with the stage-boundary activation handoffs expressed as
+# ppermute transfers over the 'pp' axis.  The timetable is data (a list of
+# clocks), so the schedule the program was emitted in is directly
+# measurable — utils/debug.py ``assert_pipeline_schedule`` checks 1F1B
+# well-formedness and the fill/drain bubble against the analytic
+# (pp-1)/(pp-1+M) bound, the same way the round-8/9 inspector pins
+# collective interleaving.
+#
+# Unlike the wave schedule, the 1F1B step's backward is NOT synthesized by
+# autodiff-through-the-scan: lm.py emits one explicit ``jax.vjp`` per
+# (chunk, microbatch) backward unit in timetable order, with every
+# cross-device reduction written out by hand.  That makes the schedule a
+# first-class program property (the thing the inspector measures) — and,
+# operationally, the whole path runs bit-correct even on legacy runtimes
+# whose shard_map lacks automatic cotangent psums (utils/compat.py), which
+# autodiff-era multi-axis LM paths do not.
+# ---------------------------------------------------------------------------
+
+
+def one_f_one_b_schedule(n_micro: int, n_stages: int,
+                         interleave: int = 1) -> list[dict]:
+    """The interleaved-1F1B timetable: a list of clocks, each a dict
+    ``{stage: (kind, chunk, microbatch)}`` with kind "F" or "B".
+
+    Generated by a work-conserving greedy simulation of the classic
+    policy — every stage runs, each clock, its earliest-microbatch READY
+    backward if one exists (a backward is ready once its own forward and
+    the downstream chunk's backward finished in an EARLIER clock), else
+    its earliest ready forward.  For interleave=1 this reproduces the
+    textbook 1F1B schedule exactly (warmup forwards, steady-state strict
+    F/B alternation, backward drain) and meets the analytic bubble bound
+    (pp-1)/(pp-1+M); with interleave > 1 the virtual chunks round-robin
+    through the same policy.  Per chunk, backwards execute in ascending
+    microbatch order — the property that makes the 1F1B reordering a
+    pure reassociation of the grad-accumulation sum (lm.py's bitwise
+    claim)."""
+    if n_micro < 1:
+        raise ValueError(f"need >= 1 microbatch, got {n_micro}")
+    n_chunks = n_stages * interleave
+    done_f: dict[tuple[int, int], int] = {}   # (chunk, micro) -> clock
+    done_b: dict[tuple[int, int], int] = {}
+    next_f = [0] * n_chunks
+    next_b = [0] * n_chunks
+    clocks: list[dict] = []
+    total = 2 * n_micro * n_chunks
+    while len(done_f) + len(done_b) < total:
+        clock: dict[int, tuple] = {}
+        for s in range(n_stages):
+            op = None
+            cand_b = []
+            for k in range(interleave):
+                c = k * n_stages + s
+                m = next_b[c]
+                if (m < n_micro and (c, m) in done_f
+                        and (c == n_chunks - 1 or (c + 1, m) in done_b)):
+                    cand_b.append((m, -c))
+            if cand_b:
+                m, neg_c = min(cand_b)
+                op = ("B", -neg_c, m)
+            else:
+                cand_f = []
+                for k in range(interleave):
+                    c = k * n_stages + s
+                    m = next_f[c]
+                    if m < n_micro and (c == 0 or (c - 1, m) in done_f):
+                        cand_f.append((m, c))
+                if cand_f:
+                    m, c = min(cand_f)
+                    op = ("F", c, m)
+            if op is not None:
+                clock[s] = op
+        if not clock:  # pragma: no cover - a policy bug, not a data case
+            raise AssertionError(
+                f"1F1B schedule deadlocked at clock {len(clocks)} "
+                f"(M={n_micro}, stages={n_stages}, v={interleave})")
+        t = len(clocks)
+        for s, (kind, c, m) in clock.items():
+            if kind == "F":
+                done_f[(c, m)] = t
+                next_f[c] = m + 1
+            else:
+                done_b[(c, m)] = t
+                next_b[c] = m + 1
+        clocks.append(clock)
+    return clocks
+
+
+def bubble_fraction(clocks: list[dict], n_stages: int) -> float:
+    """Measured bubble of a timetable: the fraction of (stage, clock)
+    slots with no scheduled unit.  For the textbook 1F1B timetable this
+    equals the analytic fill/drain bound exactly — see
+    ``analytic_bubble_bound`` (the ONE definition of that bound — the
+    schedule inspector imports it)."""
+    busy = sum(len(c) for c in clocks)
+    slots = n_stages * len(clocks)
+    return 1.0 - busy / slots if slots else 0.0
+
+
+def analytic_bubble_bound(n_stages: int, n_micro: int,
+                          interleave: int = 1) -> float:
+    """The interleaved-1F1B fill/drain bubble bound in chunk-clock units:
+    ``(pp-1) / (pp-1 + M*v)`` — the classic (pp-1)/(pp-1+M) at
+    interleave 1, shrinking v-fold with virtual stages (each of the M*v
+    chunk-passes per stage is 1/v the work, but the fill/drain ramp stays
+    pp-1 chunk-clocks)."""
+    denom = n_stages - 1 + n_micro * interleave
+    return (n_stages - 1) / denom if denom else 0.0
+
+
+def schedule_tables(clocks: list[dict], n_stages: int, n_micro: int,
+                    interleave: int = 1) -> dict:
+    """Compile a 1F1B timetable into the dense per-(clock, stage) arrays
+    the SPMD train step indexes with ``axis_index('pp')`` — the bridge
+    from the timetable-as-data to the uniform per-clock program every
+    rank traces.
+
+    Returns int32/bool numpy arrays of shape (T, n_stages):
+
+    - ``f_valid/f_k/f_m``: this stage runs a forward unit this clock, on
+      its local virtual-stage slot ``f_k`` (chunk ``f_k*n + s``) and
+      microbatch ``f_m``;
+    - ``b_valid/b_k/b_m``: same for backward units;
+    - ``fr_valid/fr_k/fr_m``: the stage RECEIVES a forward activation
+      this clock (the upstream neighbour ran F on the preceding chunk),
+      to stash for local slot ``fr_k``'s microbatch ``fr_m``;
+    - ``br_valid/br_k/br_m``: same for backward cotangents arriving from
+      the downstream neighbour.
+
+    Invalid slots carry index 0 (the step masks them out).
+    """
+    import numpy as np
+
+    n_chunks = n_stages * interleave
+    t_total = len(clocks)
+    z = lambda: np.zeros((t_total, n_stages), np.int32)  # noqa: E731
+    f = {k: z() for k in ("f_valid", "f_k", "f_m", "b_valid", "b_k", "b_m",
+                          "fr_valid", "fr_k", "fr_m",
+                          "br_valid", "br_k", "br_m")}
+    for t, clock in enumerate(clocks):
+        for s, (kind, c, m) in clock.items():
+            k = c // n_stages
+            if kind == "F":
+                f["f_valid"][t, s] = 1
+                f["f_k"][t, s], f["f_m"][t, s] = k, m
+                if c < n_chunks - 1:
+                    # chunk c+1 lives on stage (s+1) % n: it receives this
+                    # unit's output over the forward ring hop this clock
+                    rs = (s + 1) % n_stages
+                    f["fr_valid"][t, rs] = 1
+                    f["fr_k"][t, rs] = (c + 1) // n_stages
+                    f["fr_m"][t, rs] = m
+            else:
+                f["b_valid"][t, s] = 1
+                f["b_k"][t, s], f["b_m"][t, s] = k, m
+                if c > 0:
+                    # chunk c-1's stage receives this unit's input
+                    # cotangent over the reverse ring hop this clock
+                    rs = (s - 1) % n_stages
+                    f["br_valid"][t, rs] = 1
+                    f["br_k"][t, rs] = (c - 1) // n_stages
+                    f["br_m"][t, rs] = m
+    return f
+
+
+def stash_plan(clocks: list[dict], n_stages: int, n_micro: int,
+               interleave: int = 1) -> tuple[int, int]:
+    """Activation/cotangent stash depths for the 1F1B step, computed FROM
+    the timetable and statically verified collision-free.
+
+    The step keeps two rolling buffers per local chunk slot, indexed by
+    ``microbatch % depth``: ``x_stash`` (chunk inputs received over the
+    forward hop, read at the chunk's F clock and again at its B clock for
+    the recompute-vjp) and ``cot_stash`` (output cotangents received over
+    the reverse hop, read at the B clock).  A slot written at the end of
+    clock ``t_w`` is live through its final read at clock ``t_r``; the
+    plan asserts no later write lands on the slot before ``t_r`` — the
+    bounded-stash property that gives 1F1B its O(pp * microbatch)
+    activation memory (vs the flat wave scan's O(num_ticks)).
+
+    Returns ``(x_depth, cot_depth)``.
+    """
+    n_chunks = n_stages * interleave
+    done_f: dict = {}
+    done_b: dict = {}
+    for t, clock in enumerate(clocks):
+        for s, (kind, c, m) in clock.items():
+            (done_f if kind == "F" else done_b)[(c, m)] = t
+
+    def min_depth(spans_by_chunk: dict) -> int:
+        depth = 1
+        for spans in spans_by_chunk.values():
+            while True:
+                by_slot: dict = {}
+                for m, (t_w, t_r) in spans.items():
+                    by_slot.setdefault(m % depth, []).append((t_w, t_r))
+                ok = True
+                for entries in by_slot.values():
+                    entries.sort()
+                    for (w1, r1), (w2, _) in zip(entries, entries[1:]):
+                        if w2 < r1:  # overwritten while still live
+                            ok = False
+                if ok:
+                    break
+                depth += 1
+        return depth
+
+    x_spans: dict = {c: {} for c in range(1, n_chunks)}
+    cot_spans: dict = {c: {} for c in range(n_chunks - 1)}
+    for m in range(n_micro):
+        for c in range(1, n_chunks):
+            # written when the upstream F runs, last read at this B
+            x_spans[c][m] = (done_f[(c - 1, m)], done_b[(c, m)])
+        for c in range(n_chunks - 1):
+            # written when the downstream B runs, read at this B
+            cot_spans[c][m] = (done_b[(c + 1, m)], done_b[(c, m)])
+    return (max(1, min_depth(x_spans)), max(1, min_depth(cot_spans)))
 
 
 def pipeline_loss(

@@ -1062,7 +1062,8 @@ def require_overlap_capable(strategy) -> None:
             f"purpose)")
 
 
-def require_lm_overlap_streamable(*, fsdp: bool, dcn: bool) -> None:
+def require_lm_overlap_streamable(*, fsdp: bool, dcn: bool,
+                                  pp: bool = False) -> None:
     """The LM trainer's overlap capability check
     (``LMTrainConfig(overlap=True)``): raise unless the config has a
     post-backward cluster the layer-group boundary hook can stream —
@@ -1070,15 +1071,55 @@ def require_lm_overlap_streamable(*, fsdp: bool, dcn: bool) -> None:
     DCN sync points (``dcn`` — dcn_size > 1 AND the sync actually runs
     in-backward: under grad_accum > 1 the one post-accumulation exchange
     sits outside the backward, so the caller passes dcn=False there;
-    streamed per layer group since round 9).  With neither, the
-    data-axis cotangent psums are already emitted at each param's use
-    site by shard_map's transpose — there is nothing to stream."""
-    if fsdp or dcn:
+    streamed per layer group since round 9) and/or the interleaved-1F1B
+    pipeline (``pp`` — pp_size > 0, round 10: the 1F1B step's per-chunk
+    gradient syncs stream right after each chunk's LAST backward unit,
+    between the other chunks' remaining backward matmuls, and its ZeRO-3
+    gathers move to each chunk's own F/B clocks).  With none of the
+    three, the data-axis cotangent psums are already emitted at each
+    param's use site by shard_map's transpose — there is nothing to
+    stream."""
+    if fsdp or dcn or pp:
         return
     raise ValueError(
         "lm overlap=True streams the ZeRO-3 (fsdp) weight gathers and/or "
         "the factored-mesh (dcn_size > 1) two-level sync points through "
         "the layer boundaries; without either there is no post-backward "
         "cluster to dissolve (BASELINE.md rounds 8-9).  Enable fsdp, set "
-        "dcn_size > 1, or drop overlap (the VGG trainer's overlap=True "
-        "covers the explicit-strategy case)")
+        "dcn_size > 1, set pp_size > 0, or drop overlap (the VGG "
+        "trainer's overlap=True covers the explicit-strategy case)")
+
+
+def require_pp_schedulable(*, n_stages: int, n_micro: int, n_layers: int,
+                           interleave: int = 1) -> None:
+    """The interleaved-1F1B composition check (``LMTrainConfig(pp_size >
+    0)``): ONE definition site — the round-9 ``require_*`` consolidation
+    — shared by ``lm.validate_lm_cfg``, ``lm_cli``, and ``bench.py``'s
+    pre-bench knob validation, so the refusal conditions cannot drift
+    from what ``make_lm_1f1b_train_step`` actually schedules.
+
+    Rejects the incoherent combos loudly: a stage count that does not
+    divide the layer stack into ``n_stages * interleave`` homogeneous
+    contiguous chunks (the step builder's layer cut needs equal-length
+    layer scans), and fewer microbatches than stages (the 1F1B steady state
+    needs >= n_stages in-flight microbatches; below that the schedule
+    degenerates to fill/drain only and the bubble bound
+    (pp-1)/(pp-1+M) is a third or worse)."""
+    if n_stages < 1:
+        raise ValueError(f"pp_size must be >= 1 here, got {n_stages}")
+    n_chunks = n_stages * interleave
+    if n_layers % n_chunks:
+        raise ValueError(
+            f"pp_size={n_stages} x interleave={interleave} does not "
+            f"divide the {n_layers}-layer stack into contiguous layer-"
+            f"group chunks ({n_layers} % {n_chunks} != 0); pick a stage "
+            f"count that cuts on layer-group boundaries")
+    if n_micro < n_stages:
+        raise ValueError(
+            f"microbatches={n_micro} < pp_size={n_stages}: the 1F1B "
+            f"steady state keeps pp_size microbatches in flight — with "
+            f"fewer the pipeline never leaves fill/drain and the bubble "
+            f"fraction (pp-1)/(pp-1+M) >= "
+            f"{(n_stages - 1) / (n_stages - 1 + max(n_micro, 1)):.2f}; "
+            f"use microbatches >= pp_size (>= 2*pp_size to reach the "
+            f"<=1/3 bubble regime)")

@@ -2294,7 +2294,9 @@ class ContinuousBatcher:
                 packed, self.cache = self._decode_spec_for(w, gcols)(
                     self.params, self.cache, cur, ref, sub)
             with self.timers.phase("fetch"):
-                flat = np.asarray(packed)
+                # owned copy — see _collect: the parse below dispatches
+                # refill prefills (async, donated) while still reading
+                flat = np.array(packed, copy=True)
             with self.timers.phase("host_parse"):
                 self._parse_spec_block(flat, live, cols, w, out)
             return None
@@ -2321,7 +2323,18 @@ class ContinuousBatcher:
         k, w, live, cols = self.steps_per_sync, fl.w, fl.live, fl.cols
         plen, compact, npad = fl.plen, fl.compact, fl.npad
         with self.timers.phase("fetch"):
-            flat = np.asarray(fl.packed)
+            # OWNED copy, not np.asarray: on the CPU backend the latter
+            # can be a zero-copy VIEW of the device buffer, and the parse
+            # below dispatches follow-up work (refill prefills; under
+            # overlap the successor block is ALREADY executing from this
+            # block's donated carry) that may reuse the buffer while the
+            # view is still read — the utils/compat.py zero-copy hazard.
+            # packed is a small int32 vector; the copy is noise next to
+            # the transfer itself.  (Hardening, not the round-9 flake
+            # fix: that flake reproduces with donation FORCED on the
+            # legacy 0.4.37 runtime and diverges inside the donated
+            # decode chain itself — env-gated in tests/conftest.py.)
+            flat = np.array(fl.packed, copy=True)
         t0 = time.perf_counter()
         occ_before = [self.occupant[s] for s in live]
         kn = k * w

@@ -121,3 +121,35 @@ if not hasattr(jax.lax, "pcast"):  # pragma: no cover - old runtimes
         return x
 
     jax.lax.pcast = _pcast
+
+
+# -- differentiable fusion barrier (round 10) ------------------------------
+#
+# ``lax.optimization_barrier`` has no autodiff rule on legacy runtimes
+# (NotImplementedError under vjp on 0.4.37), and even where it does, the
+# pipeline chunk body needs the barrier on BOTH passes: the cotangent
+# chain must get the same compilation boundary as the primal, or the
+# unrolled-backward fusion drifts exactly like the forward one.  The
+# custom_vjp below is the one definition of "identity that XLA may not
+# fuse across, in either direction".
+
+@jax.custom_vjp
+def opt_barrier(x):
+    """Identity that blocks XLA fusion across it, differentiable: the
+    forward applies ``optimization_barrier`` to the primal, the backward
+    applies it to the cotangent (parallel/pipeline.py uses it to give
+    layer-scan bodies the same fusion boundary at every trip count —
+    XLA unrolls trip-count-1 scans and re-fuses them sub-ulp
+    differently)."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _opt_barrier_fwd(x):
+    return jax.lax.optimization_barrier(x), None
+
+
+def _opt_barrier_bwd(_, g):
+    return (jax.lax.optimization_barrier(g),)
+
+
+opt_barrier.defvjp(_opt_barrier_fwd, _opt_barrier_bwd)

@@ -66,6 +66,21 @@ _LEGACY_ENV_FAILURES = frozenset({
     "tests/test_lm_data_gen.py::test_lm_checkpoint_roundtrip",
 })
 
+# Tests that FORCE buffer donation on (monkeypatching DONATION_SAFE):
+# donation is compat-gated OFF on legacy runtimes precisely because the
+# 0.4.37 CPU runtime misbehaves with donated buffers (heap corruption
+# executing cache-loaded donated executables; aliasing under async
+# chains).  Forcing it re-creates the bug the gate exists for — the
+# round-9 carried-over flake test_overlap_donation_on_off_bitwise was
+# diagnosed in round 10 to exactly this: the donation-ON leg's decode
+# chain diverges mid-stream (first tokens bitwise-equal, then drift)
+# 1-3 times in 4 isolated runs at the pre-round-9 HEAD and after every
+# host-side fetch hardening, i.e. the divergence is inside the donated
+# device chain, not the test's fetches.  Modern runtimes run it.
+_LEGACY_DONATION_FAILURES = frozenset({
+    "tests/test_serve.py::test_overlap_donation_on_off_bitwise",
+})
+
 
 def pytest_collection_modifyitems(config, items):
     from distributed_pytorch_tpu.utils import compat
@@ -75,9 +90,16 @@ def pytest_collection_modifyitems(config, items):
     skip = pytest.mark.skip(
         reason="subject is modern-JAX vma collective semantics; fails "
                "environmentally on this legacy runtime (utils/compat.py)")
+    skip_donation = pytest.mark.skip(
+        reason="forces buffer donation on a legacy runtime whose broken "
+               "donation is exactly why compat.DONATION_SAFE gates it "
+               "off (diagnosed round 10: the donated decode chain "
+               "itself diverges; utils/compat.py)")
     for item in items:
         if item.nodeid in _LEGACY_ENV_FAILURES:
             item.add_marker(skip)
+        elif item.nodeid in _LEGACY_DONATION_FAILURES:
+            item.add_marker(skip_donation)
 
 
 def pytest_configure(config):

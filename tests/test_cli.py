@@ -126,3 +126,34 @@ def test_sharded_eval_matches_replicated():
         params, state, ds, make_mesh(4), batch_size=32, log=None)
     assert acc_sh == acc_rep
     np.testing.assert_allclose(loss_sh, loss_rep, rtol=1e-4)
+
+
+def test_parser_pp_size_flags():
+    """Round-10 surface: the interleaved-1F1B knobs reach LMTrainConfig
+    (defaults 0/0 so historical invocations are byte-identical), and the
+    incoherent combos refuse through the SAME require_pp_schedulable
+    check the trainer uses."""
+    from distributed_pytorch_tpu import lm_cli
+    from distributed_pytorch_tpu.lm import LMTrainConfig, validate_lm_cfg
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.pp_size == 0 and lm_args.microbatches == 0
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--pp-size", "2", "--microbatches", "4", "--fsdp", "--dp", "2",
+         "--overlap"])
+    assert lm_args.pp_size == 2 and lm_args.microbatches == 4
+
+    # the CLI's values flow into the ONE validation path: a pp_size that
+    # does not divide the layer groups, or microbatches < pp_size, is a
+    # loud config-time refusal (never a silently dropped flag)
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=4,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    with pytest.raises(ValueError, match="divide"):
+        validate_lm_cfg(LMTrainConfig(model=model, pp_size=3))
+    with pytest.raises(ValueError, match="microbatches"):
+        validate_lm_cfg(LMTrainConfig(model=model, pp_size=4,
+                                      microbatches=2))
+    with pytest.raises(ValueError, match="one, not both"):
+        validate_lm_cfg(LMTrainConfig(model=model, pp_size=2, pp=2))
+    validate_lm_cfg(LMTrainConfig(model=model, pp_size=2, microbatches=4))
