@@ -355,6 +355,97 @@ def bench_train_dcn(dcn_size: int, compress: str | None,
             "ici_bytes_per_step": ici_bytes}
 
 
+def canon_autotune_env(value: str | None) -> bool:
+    """Validate the BENCH_AUTOTUNE knob: '1' runs the round-11
+    calibrate->choose->A/B leg, unset/''/'0' skips it (the default —
+    calibration takes real device time).  A typo must fail HERE, before
+    any measurement (the BENCH_KV_DTYPE contract): inside the bench it
+    would be swallowed by the catch-all while the JSON silently omitted
+    the autotune keys."""
+    if value is None or value in ("", "0"):
+        return False
+    if value == "1":
+        return True
+    raise ValueError(
+        f"BENCH_AUTOTUNE must be '0' or '1', got {value!r} — refusing to "
+        f"guess whether to run the calibrate->choose->A/B leg")
+
+
+def bench_train_autotune(batch_per_replica: int = 64, iters: int = 30,
+                         reps: int = 5) -> dict | None:
+    """Topology-aware sync autotuner A/B (round 11, BENCH_AUTOTUNE=1):
+    CALIBRATE the real mesh's per-axis links (alpha-beta fit over a
+    psum / reduce-scatter+all-gather / ring ladder, cached repo-locally
+    like the XLA compile cache), CHOOSE the sync plan for the VGG-11
+    grad census (parallel/autotune.py), then A/B the resolved
+    ``strategy="auto"`` trainer against the hand-picked default (the
+    fixed-25 MB-bucket ``ddp`` baseline every round before this one
+    used) with the hardened-window discipline (>= ``reps`` alternating
+    timed windows, median, value-fetch barrier, precompile outside the
+    window).  Returns the measured speedup plus the explainable plan
+    (strategy / bucket / compression / predicted ms) so the JSON
+    records WHY the chooser picked what it picked.  Needs >= 2 devices
+    (one chip has no sync to tune) — returns None there, JSON nulls.
+    On CPU meshes expect ~1.0x (no latency-hiding scheduler; the
+    calibration/choice plumbing is the content)."""
+    import jax
+
+    from distributed_pytorch_tpu.parallel import autotune
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _log(f"[bench] train-autotune A/B needs >= 2 devices (have "
+             f"{n_dev}); omitting")
+        return None
+    # calibrate (or reuse the cached profile) on the topology the config
+    # describes: factored when the fleet splits into 2 slices, flat
+    # otherwise — the same recipe Trainer(strategy="auto") applies.
+    dcn_size = 2 if n_dev % 2 == 0 and n_dev > 2 else 1
+    axes = autotune.train_topology_axes(dcn_size, n_dev)
+    profile = autotune.get_profile(None, axes)
+    _log(f"[bench] autotune profile ({profile.source}): " + "; ".join(
+        f"{a}: alpha {l.alpha_s * 1e6:.1f}us beta "
+        f"{1.0 / max(l.beta_s_per_byte, 1e-30) / 1e9:.2f}GB/s"
+        for a, l in profile.links.items()))
+
+    def build(auto: bool) -> Trainer:
+        cfg = TrainConfig(
+            strategy="auto" if auto else "ddp",
+            batch_size=batch_per_replica, dcn_size=dcn_size,
+            steps_per_loop=iters, compute_dtype="bfloat16",
+            autotune_profile=profile if auto else None)
+        return Trainer(cfg)
+
+    trainers = {False: build(False), True: build(True)}
+    plan = trainers[True].sync_plan
+    _log("[bench] " + plan.table().replace("\n", "\n[bench] "))
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        tr.precompile_steps(images, labels)
+        float(tr.train_steps(images, labels)[-1])
+
+    times: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            losses = tr.train_steps(images, labels)
+            float(losses[-1])  # fetch forces the whole donated chain
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[False] / max(med[True], 1e-9)
+    _log(f"[bench] train-autotune A/B (auto={plan.strategy}, {n_dev} "
+         f"dev): {med[True]:.2f} ms/step auto vs {med[False]:.2f} "
+         f"default-ddp -> {speedup:.3f}x ({reps} reps median)")
+    return {"speedup": speedup, "ms_auto": med[True],
+            "ms_default": med[False], "plan": plan.summary()}
+
+
 def canon_pp_size_env(value: str | None) -> int:
     """Validate the BENCH_PP_SIZE knob: unset/''/'0' skips the
     interleaved-1F1B pipeline A/B (the default — it needs >= 2 devices
@@ -786,6 +877,10 @@ def main() -> None:
     pp_size = canon_pp_size_env(os.environ.get("BENCH_PP_SIZE"))
     pp_micro = canon_microbatches_env(
         os.environ.get("BENCH_MICROBATCHES"), pp_size)
+    # Autotuner A/B knob (round 11), validated loudly pre-bench:
+    # BENCH_AUTOTUNE=1 runs calibrate->choose->A/B vs the hand-picked
+    # default and stamps the chosen plan into the JSON.
+    run_autotune = canon_autotune_env(os.environ.get("BENCH_AUTOTUNE"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -827,6 +922,16 @@ def main() -> None:
             pp_ab = bench_train_pp(pp_size, pp_micro)
         except Exception as e:
             _log(f"[bench] train-pp A/B failed ({e}); omitting")
+
+    # Topology-aware autotuner A/B (round 11): calibrate the real
+    # links, choose a plan, measure it against the hand-picked default;
+    # optional like the other gates.
+    autotune_ab = None
+    if run_autotune:
+        try:
+            autotune_ab = bench_train_autotune()
+        except Exception as e:
+            _log(f"[bench] train-autotune A/B failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -911,6 +1016,14 @@ def main() -> None:
                                   if pp_ab is not None else None),
         "lm_pp_speedup": (round(pp_ab["speedup"], 3)
                           if pp_ab is not None else None),
+        # topology-aware autotuner A/B (round 11, BENCH_AUTOTUNE=1):
+        # calibrated-link plan (strategy/bucket/compression + predicted
+        # ms — the explainable decision) and its measured ms/step ratio
+        # vs the hand-picked ddp default.  Null when skipped.
+        "train_autotune_speedup": (round(autotune_ab["speedup"], 3)
+                                   if autotune_ab is not None else None),
+        "train_autotune_plan": (autotune_ab["plan"]
+                                if autotune_ab is not None else None),
         # transformer-stack gates (BASELINE.md is the prose companion;
         # these keys are the regression source of truth since round 4)
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)

@@ -64,7 +64,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference hangs forever: timeout=None)")
     # Training hyper-parameters; defaults are the reference's exact values.
     p.add_argument("--strategy", default="ddp",
-                   choices=_strat.available())
+                   choices=_strat.available() + ["auto"],
+                   help="gradient-sync strategy, or 'auto' (round 11): "
+                        "calibrate per-axis link alpha/beta (cached "
+                        "repo-locally) and resolve to the named strategy "
+                        "+ bucket/compression knobs minimizing predicted "
+                        "step-sync time (parallel/autotune.py)")
+    p.add_argument("--autotune-profile", default=None,
+                   help="profile source for --strategy auto: a synthetic "
+                        "preset name (uniform, fast_ici_slow_dcn, "
+                        "inverted, slow, fast) or a profile-JSON path; "
+                        "default: the cached/calibrated profile for this "
+                        "topology")
     p.add_argument("--dcn-size", type=int, default=2,
                    help="number of slices for --strategy hierarchical: the "
                         "data axis factors into Mesh(('dcn','ici')) and "
@@ -183,9 +194,13 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed, dcn_size=args.dcn_size,
         dcn_compress=args.dcn_compress, overlap=args.overlap,
         overlap_bucket_mb=args.overlap_bucket_mb,
+        autotune_profile=args.autotune_profile,
     )
     mesh = None
-    factored = getattr(_strat.get(args.strategy), "axes", None) is not None
+    # "auto" resolves inside the Trainer (which then builds whatever mesh
+    # the chosen strategy needs); factored strategies likewise.
+    factored = (args.strategy == "auto" or
+                getattr(_strat.get(args.strategy), "axes", None) is not None)
     if args.strategy != "none" and not factored:
         mesh = make_mesh(args.num_devices)
     # factored data axes (hierarchical): mesh=None lets the Trainer build

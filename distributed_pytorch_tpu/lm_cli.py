@@ -92,6 +92,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "dcn-size slices x (dp / dcn-size) chips; the DP "
                         "gradient sync becomes the explicit two-level "
                         "reduction (shard-sized cross-slice payload)")
+    p.add_argument("--dcn-compress", default=None, choices=["int8"],
+                   help="quantize the cross-slice (dcn) hop of the "
+                        "two-level sync: int8 ring exchange with per-row "
+                        "scales and error-feedback residuals threaded "
+                        "through the train step's sync-state carry "
+                        "(requires --dcn-size >= 2; round 11)")
+    p.add_argument("--bucket-mb", type=float, default=None,
+                   help="streaming bucket size for the factored-mesh "
+                        "exchange (default: the 25 MB torch-DDP cap)")
+    p.add_argument("--sync-plan", default=None, choices=["auto"],
+                   help="'auto' (round 11): calibrate per-axis link "
+                        "alpha/beta (cached repo-locally) and resolve "
+                        "--dcn-compress/--bucket-mb to the plan "
+                        "minimizing predicted step-sync time "
+                        "(parallel/autotune.py)")
+    p.add_argument("--autotune-profile", default=None,
+                   help="profile source for --sync-plan auto: a "
+                        "synthetic preset name or a profile-JSON path "
+                        "(default: cached/calibrated for this topology)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
     p.add_argument("--overlap", action="store_true",
@@ -196,7 +215,9 @@ def main(argv: list[str] | None = None) -> int:
         dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp, ep=args.ep,
         pp_size=args.pp_size, microbatches=args.microbatches,
         dcn_size=args.dcn_size, grad_accum=args.grad_accum,
-        interleave=args.interleave, fsdp=args.fsdp, overlap=args.overlap)
+        interleave=args.interleave, fsdp=args.fsdp, overlap=args.overlap,
+        dcn_compress=args.dcn_compress, bucket_mb=args.bucket_mb,
+        sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
     trainer = LMTrainer(cfg)
     log.info("model: %s | mesh: dp=%d (dcn=%d) ep=%d sp=%d tp=%d pp=%d "
              "pp_size=%d over %d devices",

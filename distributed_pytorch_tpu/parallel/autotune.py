@@ -1,0 +1,907 @@
+"""Topology-aware gradient-sync autotuner (round 11).
+
+PRs 4-10 built every sync mechanism the dp x fsdp x tp x pp lattice
+needs — reverse-topo bucket plans, in-backward sync points, two-level
+(ici, dcn) streaming, int8-on-the-DCN-hop with error feedback — but
+every knob was hand-picked: fixed 25 MB buckets, one global strategy
+string, compression only where a human wired it.  DynamiQ (compressed
+multi-hop all-reduce) and "The Big Send-off" (PAPERS.md) both show the
+right algorithm/compression choice is a function of the LINK, not of
+the model; this module closes the loop:
+
+1. **Calibration** (``calibrate``): per mesh axis, time a small ladder
+   of real collectives — ``psum``, reduce-scatter + all-gather, and a
+   ppermute ring — at 3-4 payload sizes, then least-squares fit an
+   alpha-beta cost model per link (``LinkModel``: launch latency
+   ``alpha_s`` + inverse bandwidth ``beta_s_per_byte``), using each
+   algorithm's analytic launch/wire factors so all observations
+   constrain one (alpha, beta) pair.  Profiles cache to a versioned
+   repo-local JSON (like the XLA compile cache; ``save_profile`` /
+   ``load_profile``; a version mismatch invalidates silently), and
+   deterministic synthetic profiles (``synthetic_profile``) are
+   injectable for CPU tests.
+
+2. **Plan choosing** (``choose_train_plan`` / ``choose_lm_plan``):
+   given the grad-tree byte census (the same ``make_bucket_plan``
+   packing the strategies execute) and a fitted profile, pick the
+   bucket size, the ring-vs-tree-vs-two-level algorithm, and per-hop
+   compression (none / int8+EF) by minimizing predicted step-sync
+   time, emitting an explainable ``SyncPlan`` (predicted ms + operand
+   bytes per axis, printable table).  The chooser is a pure function
+   of (census, profile, config flags) — deterministic given a fixed
+   profile (test-pinned).
+
+3. **Resolution** (``resolve_train_auto`` / ``resolve_lm_auto``):
+   ``TrainConfig(strategy="auto")`` / ``LMTrainConfig(sync_plan=
+   "auto")`` resolve to the NAMED strategies/knobs the framework
+   already ships, so the chosen plan routes through the existing
+   (bitwise-pinned) paths unchanged: ``strategy="auto"`` under a
+   forced profile trains bitwise-identically to the named strategy it
+   resolves to.
+
+Cost model (documented so the numbers are auditable; O = operand bytes
+per device, n = axis size, a/b = the link's alpha/beta):
+
+- ``psum`` (all-reduce, modeled bandwidth-optimal): a + 2*O*(n-1)/n*b
+- ``psum_scatter`` (reduce-scatter):                a +   O*(n-1)/n*b
+- ``all_gather`` of an O-byte shard:                a + O*(n-1)*b
+- ``ppermute`` of an O-byte payload:                a + O*b
+
+Wire accounting (``AxisPlan.predicted_bytes``) is OPERAND-PAYLOAD,
+scan-trip-weighted — deliberately the same accounting as the schedule
+inspector's ``bytes_executed`` (utils/debug.py), so predictions are
+cross-checkable against measurements (``debug.assert_plan_bytes_match``,
+scripts/bench_strategies.py's predicted-vs-measured table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from . import strategies as strat
+
+PROFILE_VERSION = 1
+
+# Bucket-size candidates (MB).  25 first: the torch-DDP default wins
+# ties (strict-improvement argmin), so the chooser only moves off it
+# when the profile actually says so.
+BUCKET_LADDER_MB = (25.0, 4.0, 100.0)
+
+# int8 ring per-hop payload factor: chunk int8 bytes + one f32 scale per
+# 256-element row = chunk * (1 + 4/(4*256)) relative to chunk elements.
+_RING_BLOCK = 256
+_INT8_ROW_OVERHEAD = 1.0 + 1.0 / 64.0  # (1 int8 + 4/256 scale bytes)/elem
+
+# The two-level gather-back runs all_gather_invariant where available;
+# legacy runtimes fall back to an embed + full-width psum over the fast
+# axis (strategies.two_level_psum) — the predictor must account bytes
+# for the program THIS runtime actually emits.
+_GATHER_FALLBACK = strat._all_gather_inv is None
+
+
+# ---------------------------------------------------------------------------
+# profiles
+
+
+@dataclass(frozen=True)
+class LinkModel:
+    """Alpha-beta cost model of one mesh-axis link: a collective costs
+    ``launches * alpha_s + wire_bytes * beta_s_per_byte`` seconds."""
+
+    alpha_s: float
+    beta_s_per_byte: float
+
+
+@dataclass
+class TopologyProfile:
+    """Fitted per-axis link models for one mesh topology.
+
+    ``axes`` preserves mesh order (outer first); ``measured`` carries the
+    raw calibration observations (axis -> algo -> payload-bytes -> s) for
+    auditability; ``source`` records provenance ("calibrated",
+    "synthetic:<preset>", "cache:<path>")."""
+
+    version: int
+    device_kind: str
+    axes: dict[str, int]
+    links: dict[str, LinkModel]
+    source: str = "calibrated"
+    measured: dict = field(default_factory=dict)
+
+    def key(self) -> str:
+        """Cache-file key: device kind + topology (axis names x sizes)."""
+        topo = "-".join(f"{a}{s}" for a, s in self.axes.items())
+        kind = "".join(c if c.isalnum() else "_" for c in self.device_kind)
+        return f"{kind}_{topo}"
+
+    def to_json(self) -> dict:
+        return {"version": self.version, "device_kind": self.device_kind,
+                "axes": dict(self.axes),
+                "links": {a: {"alpha_s": l.alpha_s,
+                              "beta_s_per_byte": l.beta_s_per_byte}
+                          for a, l in self.links.items()},
+                "source": self.source, "measured": self.measured}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TopologyProfile":
+        return cls(version=int(d["version"]),
+                   device_kind=d["device_kind"],
+                   axes={a: int(s) for a, s in d["axes"].items()},
+                   links={a: LinkModel(float(l["alpha_s"]),
+                                       float(l["beta_s_per_byte"]))
+                          for a, l in d["links"].items()},
+                   source=d.get("source", "cache"),
+                   measured=d.get("measured", {}))
+
+
+# Deterministic synthetic profiles for CPU tests and the dryrun: each
+# preset maps the requested axes onto fixed (alpha, beta) pairs by ROLE
+# ('dcn' = the cross-slice slow hop; every other axis is a fast intra-
+# slice link).  The numbers are chosen so each preset has one clearly
+# optimal plan (test-pinned in tests/test_autotune.py):
+#
+# - uniform:           equal medium links, launch-latency-dominated ->
+#                      the flat fused psum (fewest launches) wins.
+# - fast_ici_slow_dcn: ~400x bandwidth gap -> two-level + int8 on the
+#                      scarce hop (the DynamiQ design point).
+# - inverted:          the INNER link is the bottleneck -> two-level
+#                      buys nothing (its reduce-scatter/gather ride the
+#                      slow link either way); flat psum wins on launches.
+# - slow:              one slow flat link -> the int8+EF ring (true
+#                      per-hop wire compression) wins.
+# - fast:              one fast flat link -> plain fused psum wins.
+_FAST = LinkModel(alpha_s=1e-6, beta_s_per_byte=5e-12)     # ~200 GB/s
+_SLOW = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-9)      # ~0.5 GB/s
+_MEDIUM_HIGH_ALPHA = LinkModel(alpha_s=2e-4, beta_s_per_byte=1e-11)
+SYNTHETIC_PRESETS = {
+    "uniform": lambda axis: _MEDIUM_HIGH_ALPHA,
+    "fast_ici_slow_dcn": lambda axis: _SLOW if axis == "dcn" else _FAST,
+    "inverted": lambda axis: _FAST if axis == "dcn" else _SLOW,
+    "slow": lambda axis: LinkModel(alpha_s=2e-6, beta_s_per_byte=2e-9),
+    "fast": lambda axis: _MEDIUM_HIGH_ALPHA,
+}
+
+
+def synthetic_profile(preset: str, axes: dict[str, int]) -> TopologyProfile:
+    """A deterministic profile for ``axes`` from a named preset — the CPU
+    tests' injection point (no device timing anywhere)."""
+    try:
+        link_of = SYNTHETIC_PRESETS[preset]
+    except KeyError:
+        raise ValueError(
+            f"unknown synthetic profile {preset!r}; presets: "
+            f"{sorted(SYNTHETIC_PRESETS)}") from None
+    return TopologyProfile(
+        version=PROFILE_VERSION, device_kind="synthetic",
+        axes=dict(axes), links={a: link_of(a) for a in axes},
+        source=f"synthetic:{preset}")
+
+
+# ---------------------------------------------------------------------------
+# profile cache (repo-local, versioned — the XLA-compile-cache shape)
+
+
+def profile_cache_dir() -> str:
+    env = os.environ.get("JAX_GRAFT_AUTOTUNE_CACHE")
+    if env:
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, ".autotune_cache")
+
+
+def save_profile(profile: TopologyProfile,
+                 cache_dir: str | None = None) -> str:
+    d = cache_dir or profile_cache_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"profile_{profile.key()}.json")
+    with open(path, "w") as f:
+        json.dump(profile.to_json(), f, indent=1, sort_keys=True)
+    return path
+
+
+def load_profile(device_kind: str, axes: dict[str, int],
+                 cache_dir: str | None = None) -> TopologyProfile | None:
+    """Cached profile for this (device kind, topology), or None on a miss
+    OR a version/topology mismatch — a stale profile must trigger
+    recalibration, never silently steer the chooser."""
+    key = TopologyProfile(PROFILE_VERSION, device_kind, dict(axes), {}).key()
+    path = os.path.join(cache_dir or profile_cache_dir(),
+                        f"profile_{key}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if int(d.get("version", -1)) != PROFILE_VERSION:
+        return None
+    p = TopologyProfile.from_json(d)
+    if p.axes != dict(axes):
+        return None
+    p.source = f"cache:{path}"
+    return p
+
+
+# ---------------------------------------------------------------------------
+# calibration
+
+
+def _algo_factors(algo: str, n: int) -> tuple[float, float]:
+    """(launches, wire-bytes-per-payload-byte) of one calibration
+    collective over an n-way axis — the analytic factors the fit divides
+    out so every (algo, size) observation constrains ONE (alpha, beta)."""
+    if algo == "psum":
+        return 1.0, 2.0 * (n - 1) / n
+    if algo == "rs_ag":  # psum_scatter + all_gather
+        return 2.0, 2.0 * (n - 1) / n
+    if algo == "ring":   # n-1 chained full-payload ppermute hops
+        return float(n - 1), float(n - 1)
+    raise ValueError(f"unknown calibration algorithm {algo!r}")
+
+
+def fit_alpha_beta(observations: list[tuple[float, float, float]]
+                   ) -> LinkModel:
+    """Least-squares fit of ``t = alpha*L + beta*W`` over observations
+    ``(launches L, wire_bytes W, seconds t)``; both coefficients clamped
+    non-negative (a negative latency/bandwidth fit is noise)."""
+    A = np.asarray([[l, w] for l, w, _ in observations], np.float64)
+    t = np.asarray([s for _, _, s in observations], np.float64)
+    coef, *_ = np.linalg.lstsq(A, t, rcond=None)
+    alpha = float(max(coef[0], 1e-12))
+    beta = float(max(coef[1], 1e-15))
+    return LinkModel(alpha_s=alpha, beta_s_per_byte=beta)
+
+
+def _time_axis_collective(mesh, axis: str, payload_bytes: int, algo: str,
+                          *, inner: int = 4, reps: int = 2) -> float:
+    """Measured seconds per execution of one ``algo`` collective over
+    ``axis`` at ``payload_bytes`` (f32 payload), best-of-``reps`` of an
+    ``inner``-deep data-chained loop (the bench.py chained-window
+    discipline: the chain defeats CSE, one fetch ends the window)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    elems = max(payload_bytes // 4, _RING_BLOCK)
+    elems += (-elems) % n  # rs_ag needs an n-divisible payload
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        if algo == "psum":
+            return lax.psum(x, axis) * (1.0 / n)
+        if algo == "rs_ag":
+            s = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+            return lax.all_gather(s, axis, axis=0, tiled=True) * (1.0 / n)
+        acc = x
+        for _ in range(n - 1):  # ring: chained full-payload hops
+            acc = lax.ppermute(acc, axis, perm)
+        return acc
+
+    def chained(x):
+        for _ in range(inner):
+            x = body(x)
+            x = lax.optimization_barrier(x)
+        return x
+
+    fn = jax.jit(shard_map(
+        chained, mesh=mesh,
+        in_specs=(P(),), out_specs=P(),
+        # the ring assembles a ppermute result: replicated by
+        # construction (value-preserving permutation of identical
+        # payloads), not provably — calibration is measurement-only
+        check_vma=False))
+    x = jnp.full((elems,), 1.0 / inner, jnp.float32)
+    np.asarray(fn(x))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(x)
+        out.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best / inner
+
+
+def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
+              algos=("psum", "rs_ag", "ring"),
+              inner: int = 4, reps: int = 2) -> TopologyProfile:
+    """Fit a ``TopologyProfile`` by timing real collectives per axis of
+    ``mesh`` (the calibration pass).  Axes of size 1 get a zero-cost
+    link (nothing ever crosses them)."""
+    import jax
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    links: dict[str, LinkModel] = {}
+    measured: dict[str, dict] = {}
+    for axis, n in sizes.items():
+        if n < 2:
+            links[axis] = LinkModel(alpha_s=0.0, beta_s_per_byte=0.0)
+            continue
+        obs: list[tuple[float, float, float]] = []
+        raw: dict[str, dict] = {}
+        for algo in algos:
+            raw[algo] = {}
+            for b in payload_bytes:
+                t = _time_axis_collective(mesh, axis, b, algo,
+                                          inner=inner, reps=reps)
+                launches, wire_per_byte = _algo_factors(algo, n)
+                obs.append((launches, wire_per_byte * b, t))
+                raw[algo][str(b)] = t
+        links[axis] = fit_alpha_beta(obs)
+        measured[axis] = raw
+    return TopologyProfile(
+        version=PROFILE_VERSION,
+        device_kind=getattr(jax.devices()[0], "device_kind", "cpu"),
+        axes=sizes, links=links, source="calibrated", measured=measured)
+
+
+def get_profile(spec, axes: dict[str, int], *, cache_dir: str | None = None,
+                calibrate_kwargs: dict | None = None) -> TopologyProfile:
+    """Resolve a profile for ``axes`` from ``spec``:
+
+    - a ``TopologyProfile``: used as-is (axes must match — a forced
+      profile for the wrong topology would silently mis-steer);
+    - a synthetic preset name (``SYNTHETIC_PRESETS``);
+    - a path to a profile JSON (version/axes-checked, loudly);
+    - ``None``: the cached profile for this (device kind, topology), or
+      a fresh calibration over a throwaway mesh, saved back to the cache.
+    """
+    if isinstance(spec, TopologyProfile):
+        if spec.axes != dict(axes):
+            raise ValueError(
+                f"injected profile is for topology {spec.axes}, the config "
+                f"needs {dict(axes)} — refusing to choose from the wrong "
+                f"links")
+        return spec
+    if isinstance(spec, str):
+        if spec in SYNTHETIC_PRESETS:
+            return synthetic_profile(spec, axes)
+        if os.path.exists(spec):
+            with open(spec) as f:
+                d = json.load(f)
+            if int(d.get("version", -1)) != PROFILE_VERSION:
+                raise ValueError(
+                    f"profile {spec} has version {d.get('version')}, this "
+                    f"build needs {PROFILE_VERSION} — recalibrate")
+            p = TopologyProfile.from_json(d)
+            if p.axes != dict(axes):
+                raise ValueError(
+                    f"profile {spec} is for topology {p.axes}, the config "
+                    f"needs {dict(axes)}")
+            return p
+        raise ValueError(
+            f"autotune profile {spec!r} is neither a synthetic preset "
+            f"({sorted(SYNTHETIC_PRESETS)}) nor an existing profile file")
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    cached = load_profile(kind, axes, cache_dir)
+    if cached is not None:
+        return cached
+    from .mesh import make_mesh
+
+    n = int(np.prod(list(axes.values())))
+    mesh = make_mesh(n, axis_names=tuple(axes),
+                     axis_shape=tuple(axes.values()))
+    prof = calibrate(mesh, **(calibrate_kwargs or {}))
+    save_profile(prof, cache_dir)
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# grad census
+
+
+# the ONE shapes-only stand-in for bucket planning (defined next to
+# make_bucket_plan; lm.py's EF-residual sizing shares it)
+_SizedLeaf = strat.SizedLeaf
+
+
+@dataclass(frozen=True)
+class GradCensus:
+    """Byte census of a gradient pytree: per-leaf (element count, dtype)
+    in flatten order — everything the bucket planner and the cost model
+    need, nothing device-resident."""
+
+    leaves: tuple[_SizedLeaf, ...]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(l.size * l.dtype.itemsize for l in self.leaves)
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.leaves)
+
+    def bucket_plan(self, bucket_bytes: int) -> list[int]:
+        """Per-bucket byte sizes under the REAL reverse-topo packing
+        (the one plan every strategy shares)."""
+        plan = strat.make_bucket_plan(list(self.leaves), bucket_bytes)
+        return [sum(self.leaves[i].size * self.leaves[i].dtype.itemsize
+                    for i in b) for b in plan]
+
+
+def grad_census(tree) -> GradCensus:
+    """Census of ``tree`` (arrays OR ShapeDtypeStructs, e.g. from
+    ``jax.eval_shape`` — no device work)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    return GradCensus(tuple(
+        _SizedLeaf(int(np.prod(l.shape, dtype=np.int64) or 1),
+                   np.dtype(l.dtype)) for l in leaves))
+
+
+# ---------------------------------------------------------------------------
+# the cost model
+
+
+@dataclass(frozen=True)
+class AxisPlan:
+    """One mesh axis' share of a candidate plan: the algorithm label,
+    launch count, predicted operand-payload bytes per step (the
+    inspector-comparable number), and predicted milliseconds."""
+
+    axis: str
+    algorithm: str
+    launches: int
+    predicted_bytes: int
+    predicted_ms: float
+
+
+@dataclass(frozen=True)
+class SyncPlan:
+    """The chooser's output: a resolved named strategy + knobs, with the
+    prediction that justified it.  ``predicted_ms`` is the EXPOSED
+    per-step sync time (wire hidden under backward compute is
+    discounted when ``overlap``); ``per_axis`` carries the raw totals."""
+
+    strategy: str
+    bucket_mb: float
+    dcn_compress: str | None
+    dcn_size: int
+    overlap: bool
+    predicted_ms: float
+    per_axis: tuple[AxisPlan, ...]
+    profile_source: str
+    census_bytes: int
+
+    def axis(self, name: str) -> AxisPlan | None:
+        for ap in self.per_axis:
+            if ap.axis == name:
+                return ap
+        return None
+
+    def summary(self) -> dict:
+        """Compact JSON-able form (the bench's train_autotune_plan)."""
+        return {"strategy": self.strategy, "bucket_mb": self.bucket_mb,
+                "dcn_compress": self.dcn_compress,
+                "dcn_size": self.dcn_size, "overlap": self.overlap,
+                "predicted_ms": round(self.predicted_ms, 4),
+                "profile": self.profile_source,
+                "bytes_by_axis": {ap.axis: ap.predicted_bytes
+                                  for ap in self.per_axis}}
+
+    def table(self) -> str:
+        """Printable explanation: one row per axis + the decision line."""
+        lines = [f"SyncPlan: strategy={self.strategy} "
+                 f"bucket={self.bucket_mb:g}MB "
+                 f"dcn_compress={self.dcn_compress or 'none'} "
+                 f"overlap={self.overlap} "
+                 f"predicted {self.predicted_ms:.3f} ms/step "
+                 f"(grads {self.census_bytes / 1e6:.2f} MB, "
+                 f"profile {self.profile_source})",
+                 "| axis | algorithm | launches | MB/step | ms |",
+                 "|---|---|---|---|---|"]
+        for ap in self.per_axis:
+            lines.append(
+                f"| {ap.axis} | {ap.algorithm} | {ap.launches} | "
+                f"{ap.predicted_bytes / 1e6:.2f} | "
+                f"{ap.predicted_ms:.3f} |")
+        return "\n".join(lines)
+
+
+def _ring_chunk_elems(elems: int, n: int) -> int:
+    """The int8 ring's block-aligned per-device chunk (strategies.
+    QuantizedRing._chunk) for an ``elems``-element flat vector."""
+    return -(-elems // (n * _RING_BLOCK)) * _RING_BLOCK
+
+
+def _int8_ring_bytes(elems: int, n: int) -> tuple[int, int]:
+    """(executed ppermute operand bytes, launches) of one
+    ``QuantizedRing._ring_sum`` over an n-way axis: the reduce-scatter
+    and all-gather scans each run n-1 trips of one int8-chunk ppermute
+    plus one f32 row-scale ppermute."""
+    if n < 2:
+        return 0, 0
+    chunk = _ring_chunk_elems(elems, n)
+    per_hop = int(chunk * _INT8_ROW_OVERHEAD)
+    return 2 * (n - 1) * per_hop, 2 * (n - 1)
+
+
+def _two_level_axis_costs(bucket_elems: list[int], n_ici: int, n_dcn: int,
+                          compress: str | None) -> dict[str, tuple]:
+    """Per-axis (operand bytes, launches, wire bytes) of the two-level
+    reduction over the given f32 bucket element counts: reduce-scatter
+    over the fast axis, shard exchange over the slow one (stock psum or
+    the int8 ring), gather back (all_gather_invariant, or the legacy
+    embed + full-width psum fallback)."""
+    ici_bytes = ici_wire = dcn_bytes = dcn_wire = 0
+    ici_launch = dcn_launch = 0
+    for e in bucket_elems:
+        padded = e + (-e) % max(n_ici, 1)
+        shard = padded // max(n_ici, 1)
+        if n_ici > 1:
+            # psum_scatter operand: the padded full vector
+            ici_bytes += padded * 4
+            ici_wire += padded * 4 * (n_ici - 1) // n_ici
+            ici_launch += 1
+            if _GATHER_FALLBACK:
+                ici_bytes += padded * 4      # full-width psum fallback
+                ici_wire += 2 * padded * 4 * (n_ici - 1) // n_ici
+            else:
+                ici_bytes += shard * 4       # all_gather of the shard
+                ici_wire += shard * 4 * (n_ici - 1)
+            ici_launch += 1
+        if n_dcn > 1:
+            if compress == "int8":
+                b, l = _int8_ring_bytes(shard, n_dcn)
+                dcn_bytes += b
+                dcn_wire += b
+                dcn_launch += l
+            else:
+                dcn_bytes += shard * 4
+                dcn_wire += 2 * shard * 4 * (n_dcn - 1) // n_dcn
+                dcn_launch += 1
+    return {"ici": (ici_bytes, ici_launch, ici_wire),
+            "dcn": (dcn_bytes, dcn_launch, dcn_wire)}
+
+
+def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
+                  *, bucket_mb: float = strat.BUCKET_CAP_MB,
+                  dcn_compress: str | None = None,
+                  overlap: bool = False) -> dict | None:
+    """Predicted cost of running ``name`` (a registry strategy, or
+    'none') for this census on this profile: ``{"ms_total", "ms_exposed",
+    "per_axis": [AxisPlan, ...]}``; None for strategies the model does
+    not cover.  ``ms_exposed`` discounts wire time hidden under backward
+    compute when ``overlap`` (all but one bucket's wire hides — the
+    exposed tail + every launch), and is what the chooser minimizes;
+    ``ms_total`` is the undiscounted sum (what a post-backward step
+    serializes — scripts/bench_strategies.py's predicted_ms column)."""
+    bucket_bytes = int(bucket_mb * 1024 * 1024)
+    B = census.total_bytes
+    nl = census.n_leaves
+    axes = list(profile.axes.items())
+    links = profile.links
+
+    def axis_plan(axis, algo, launches, op_bytes, wire, n):
+        link = links[axis]
+        ms = (launches * link.alpha_s + wire * link.beta_s_per_byte) * 1e3
+        return AxisPlan(axis=axis, algorithm=algo, launches=int(launches),
+                        predicted_bytes=int(op_bytes), predicted_ms=ms)
+
+    per_axis: list[AxisPlan] = []
+    n_buckets = 1
+    can_overlap = name in ("ddp", "bucketed", "quantized",
+                           "quantized_ring", "quantized_ring_ef",
+                           "hierarchical")
+
+    if name == "none":
+        per_axis = []
+    elif name in ("ddp", "bucketed", "all_reduce", "quantized",
+                  "gather_scatter_symmetric", "gather_scatter",
+                  "quantized_ring", "quantized_ring_ef"):
+        # flat strategies: one emitted axis ('data'); on a factored
+        # profile the payload crosses EVERY link at full width, so the
+        # time sums the per-link costs while the operand bytes stay one
+        # row (the emitted program has one axis).
+        if name == "ddp":
+            algo, op_bytes, launches, wire_f = "flat fused psum", B, 1, 2.0
+        elif name == "bucketed":
+            sizes = census.bucket_plan(bucket_bytes)
+            n_buckets = len(sizes)
+            algo, op_bytes, launches, wire_f = ("flat bucketed psum", B,
+                                                n_buckets, 2.0)
+        elif name == "all_reduce":
+            algo, op_bytes, launches, wire_f = ("per-leaf sequential psum",
+                                                B, nl, 2.0)
+        elif name == "quantized":
+            # pmax (scalar) + int32 psum per leaf: full-width wire
+            algo, op_bytes, launches, wire_f = ("per-leaf int32 psum", B,
+                                                2 * nl, 2.0)
+        elif name == "gather_scatter_symmetric":
+            # all_gather(leaf) + psum(leaf) per leaf
+            algo, op_bytes, launches, wire_f = ("all_gather + masked psum",
+                                                2 * B, 2 * nl, 3.0)
+        elif name == "gather_scatter":
+            n_tot = int(np.prod([s for _, s in axes]))
+            algo = "rank-0 gather/scatter (ppermute)"
+            op_bytes = 2 * (n_tot - 1) * B
+            launches = 2 * (n_tot - 1) * nl
+            wire_f = 2.0 * (n_tot - 1)
+        else:  # the int8 rings
+            sizes = census.bucket_plan(bucket_bytes)
+            n_buckets = len(sizes)
+            n_tot = int(np.prod([s for _, s in axes]))
+            op_bytes = launches = 0
+            for b in sizes:
+                bb, ll = _int8_ring_bytes(b // 4, n_tot)
+                op_bytes += bb
+                launches += ll
+            algo = "int8 ring reduce-scatter/all-gather"
+            wire_f = None  # wire == operand bytes for ppermute payloads
+        # time: cross every link of the profile at the strategy's width
+        ms = 0.0
+        for axis, n in axes:
+            if n < 2:
+                continue
+            link = links[axis]
+            if wire_f is None:
+                wire = op_bytes
+            elif name == "gather_scatter":
+                wire = 2.0 * (np.prod([s for _, s in axes]) - 1) * B
+            else:
+                wire = wire_f / 2.0 * 2.0 * B * (n - 1) / n
+            ms += (launches * link.alpha_s
+                   + wire * link.beta_s_per_byte) * 1e3
+        emitted = "data" if len(axes) > 1 or axes[0][0] == "data" \
+            else axes[0][0]
+        per_axis = [AxisPlan(axis=emitted, algorithm=algo,
+                             launches=int(launches),
+                             predicted_bytes=int(op_bytes),
+                             predicted_ms=ms)]
+    elif name == "hierarchical":
+        # the two-level reduction: slow hop is the 'dcn' axis, the fast
+        # hop is whatever inner axis the profile carries ('ici' on the
+        # VGG factored mesh, 'data' on the LM multislice mesh)
+        sizes = {a: s for a, s in axes}
+        fast = next((a for a, _ in axes if a != "dcn"), "ici")
+        n_dcn, n_fast = sizes.get("dcn", 1), sizes.get(fast, 1)
+        if overlap or dcn_compress == "int8":
+            bucket_elems = [b // 4 for b in census.bucket_plan(bucket_bytes)]
+        else:
+            # the post-backward plain path flattens the WHOLE tree once
+            bucket_elems = [B // 4]
+        n_buckets = len(bucket_elems)
+        costs = _two_level_axis_costs(bucket_elems, n_fast, n_dcn,
+                                      dcn_compress)
+        for axis, row in (("dcn", costs["dcn"]), (fast, costs["ici"])):
+            ob, la, wi = row
+            algo = ("int8 ring exchange" if axis == "dcn"
+                    and dcn_compress == "int8" else
+                    "shard-sized psum" if axis == "dcn" else
+                    "reduce-scatter + gather")
+            per_axis.append(axis_plan(axis, algo, la, ob, wi,
+                                      sizes.get(axis, 1)))
+    else:
+        return None
+
+    ms_total = sum(ap.predicted_ms for ap in per_axis)
+    launch_ms = sum(ap.launches * links.get(
+        ap.axis, links[axes[0][0]]).alpha_s for ap in per_axis) * 1e3 \
+        if per_axis else 0.0
+    if len(axes) > 1 and per_axis and per_axis[0].axis == "data":
+        # flat-on-factored: the launch term crossed every link above
+        launch_ms = sum(per_axis[0].launches * links[a].alpha_s
+                        for a, s in axes if s > 1) * 1e3
+    wire_ms = ms_total - launch_ms
+    if overlap and can_overlap and n_buckets > 0:
+        # all but the last bucket's wire hides under backward compute
+        ms_exposed = launch_ms + wire_ms / n_buckets
+    else:
+        ms_exposed = ms_total
+    return {"ms_total": ms_total, "ms_exposed": ms_exposed,
+            "per_axis": per_axis, "n_buckets": n_buckets}
+
+
+# ---------------------------------------------------------------------------
+# the chooser
+
+
+def _mk_plan(name, pred, *, bucket_mb, dcn_compress, dcn_size, overlap,
+             profile, census) -> SyncPlan:
+    return SyncPlan(
+        strategy=name, bucket_mb=bucket_mb, dcn_compress=dcn_compress,
+        dcn_size=dcn_size, overlap=overlap,
+        predicted_ms=pred["ms_exposed"],
+        per_axis=tuple(pred["per_axis"]),
+        profile_source=profile.source, census_bytes=census.total_bytes)
+
+
+def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
+                      dcn_size: int = 1, overlap: bool = False,
+                      ladder: tuple = BUCKET_LADDER_MB) -> SyncPlan:
+    """Pick the VGG trainer's sync plan: flat fused psum (``ddp``) vs
+    bucketed psum vs the int8+EF ring on flat topologies; flat psum vs
+    two-level (``hierarchical``) with an optional int8 DCN hop on
+    factored ones — each at every ``ladder`` bucket size — by minimum
+    predicted exposed sync time.  Pure function of its arguments
+    (deterministic given a profile; candidate order breaks exact ties
+    toward the simpler plan).  A caller with a pinned bucket size
+    passes a one-rung ladder so the recorded prediction describes the
+    config that will actually run."""
+    factored = dcn_size > 1 and "dcn" in profile.axes
+    default_mb = float(ladder[0])
+    candidates: list[tuple[str, str | None, float]] = []
+    if factored:
+        candidates.append(("ddp", None, default_mb))
+        for mb in ladder:
+            candidates.append(("hierarchical", None, mb))
+            candidates.append(("hierarchical", "int8", mb))
+        if overlap:
+            for mb in ladder:
+                candidates.append(("bucketed", None, mb))
+    else:
+        candidates.append(("ddp", None, default_mb))
+        for mb in ladder:
+            candidates.append(("bucketed", None, mb))
+            candidates.append(("quantized_ring_ef", None, mb))
+    best: SyncPlan | None = None
+    for name, compress, mb in candidates:
+        pred = predict_named(name, census, profile, bucket_mb=mb,
+                             dcn_compress=compress, overlap=overlap)
+        if pred is None:
+            continue
+        plan = _mk_plan(name, pred, bucket_mb=mb, dcn_compress=compress,
+                        dcn_size=dcn_size if name == "hierarchical" else 1,
+                        overlap=overlap, profile=profile, census=census)
+        if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
+            best = plan
+    assert best is not None
+    return best
+
+
+def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
+                   dcn_size: int = 1, overlap: bool = False,
+                   grad_accum: int = 1, allow_compress: bool = True,
+                   ladder: tuple = BUCKET_LADDER_MB) -> SyncPlan:
+    """Pick the LM trainer's sync knobs.  The LM data-axis algorithm is
+    structurally fixed (autodiff cotangent psums on flat meshes, the
+    explicit two-level reduction when ``dcn_size > 1``); what the
+    profile decides is the slow-hop compression (none vs int8+EF —
+    ``allow_compress=False`` removes the int8 candidates for configs
+    whose step has no sync-state channel, e.g. the pipeline paths) and
+    the streaming bucket size.  Deterministic given a profile.
+
+    Stated approximation: leaves are costed as if they all ride the
+    grouped two-level path; under fsdp the shard-sized leaves skip the
+    ici reduce-scatter/gather and ring the shard directly over dcn —
+    same dcn magnitude, slightly overstated ici bytes (the per-axis
+    BYTE cross-check in debug.assert_plan_bytes_match is scoped to the
+    VGG programs, where the prediction is exact)."""
+    if dcn_size <= 1 or "dcn" not in profile.axes:
+        pred = predict_named("ddp", census, profile, overlap=overlap)
+        plan = _mk_plan("flat_autodiff_psum", pred,
+                        bucket_mb=float(ladder[0]),
+                        dcn_compress=None, dcn_size=1, overlap=overlap,
+                        profile=profile, census=census)
+        return plan
+    best: SyncPlan | None = None
+    for compress in ((None, "int8") if allow_compress else (None,)):
+        for mb in ladder:
+            pred = predict_named("hierarchical", census, profile,
+                                 bucket_mb=mb, dcn_compress=compress,
+                                 overlap=overlap and grad_accum == 1)
+            plan = _mk_plan(
+                "two_level" if compress is None else "two_level_int8",
+                pred, bucket_mb=mb, dcn_compress=compress,
+                dcn_size=dcn_size, overlap=overlap,
+                profile=profile, census=census)
+            if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
+                best = plan
+    assert best is not None
+    return best
+
+
+# ---------------------------------------------------------------------------
+# config resolution (the ``strategy="auto"`` / ``sync_plan="auto"`` entry)
+
+
+def train_topology_axes(dcn_size: int, n_devices: int) -> dict[str, int]:
+    """The link topology a TrainConfig describes: ``dcn_size > 1`` (and
+    divisible) factors the fleet into Mesh(('dcn', 'ici')); otherwise
+    one flat 'data' link."""
+    if dcn_size > 1 and n_devices % dcn_size == 0 and n_devices > dcn_size:
+        return {"dcn": dcn_size, "ici": n_devices // dcn_size}
+    return {"data": n_devices}
+
+
+def resolve_train_auto(cfg, *, num_devices: int | None = None):
+    """Resolve ``TrainConfig(strategy="auto")``: calibrate-or-load the
+    profile (``cfg.autotune_profile`` injects one), census the model's
+    grad tree, choose, and return ``(resolved_cfg, SyncPlan)`` — the
+    resolved config names an existing strategy plus its knobs, so the
+    Trainer routes through the bitwise-pinned named paths unchanged."""
+    import jax
+
+    from ..models import vgg
+
+    if cfg.dcn_compress is not None:
+        raise ValueError(
+            "strategy='auto' resolves dcn_compress itself; an explicit "
+            "dcn_compress alongside auto is ambiguous — set one, not "
+            "both (a named strategy honors the explicit knob)")
+    n = num_devices if num_devices is not None else len(jax.devices())
+    if n < 2:
+        plan = SyncPlan(strategy="none", bucket_mb=float(strat.BUCKET_CAP_MB),
+                        dcn_compress=None, dcn_size=1, overlap=False,
+                        predicted_ms=0.0, per_axis=(),
+                        profile_source="single-device", census_bytes=0)
+        return dataclasses.replace(cfg, strategy="none", overlap=False,
+                                   dcn_compress=None), plan
+    census = grad_census(jax.eval_shape(
+        lambda k: vgg.init(k, cfg.model)[0], jax.random.key(0)))
+    axes = train_topology_axes(cfg.dcn_size, n)
+    profile = get_profile(cfg.autotune_profile, axes)
+    # an explicitly pinned bucket size constrains the ladder, so the
+    # recorded prediction describes the config that actually runs
+    ladder = (BUCKET_LADDER_MB if cfg.overlap_bucket_mb is None
+              else (float(cfg.overlap_bucket_mb),))
+    plan = choose_train_plan(census, profile,
+                             dcn_size=axes.get("dcn", 1),
+                             overlap=cfg.overlap, ladder=ladder)
+    resolved = dataclasses.replace(
+        cfg, strategy=plan.strategy,
+        dcn_size=plan.dcn_size if plan.strategy == "hierarchical"
+        else cfg.dcn_size,
+        dcn_compress=plan.dcn_compress,
+        overlap_bucket_mb=(cfg.overlap_bucket_mb
+                           if cfg.overlap_bucket_mb is not None
+                           else plan.bucket_mb))
+    return resolved, plan
+
+
+def lm_topology_axes(cfg) -> dict[str, int]:
+    """The LM config's data-sync links: the factored (dcn, data) pair on
+    multislice configs, one flat 'data' link otherwise.  (tp/sp/ep axes
+    carry activation traffic the sync chooser does not own.)"""
+    if cfg.dcn_size > 1:
+        return {"dcn": cfg.dcn_size, "data": cfg.dp // cfg.dcn_size}
+    return {"data": max(cfg.dp, 1)}
+
+
+def resolve_lm_auto(cfg):
+    """Resolve ``LMTrainConfig(sync_plan="auto")`` into explicit
+    ``dcn_compress`` / ``bucket_mb`` knobs (the LM side's tunables);
+    returns ``(resolved_cfg, SyncPlan)``."""
+    import jax
+
+    from ..models import transformer as tfm
+
+    if cfg.dcn_compress is not None:
+        raise ValueError(
+            "sync_plan='auto' resolves dcn_compress itself; an explicit "
+            "dcn_compress alongside auto is ambiguous — set one, not "
+            "both (drop sync_plan to pin the knob by hand)")
+    census = grad_census(jax.eval_shape(
+        lambda k: tfm.init(k, cfg.model), jax.random.key(0)))
+    axes = lm_topology_axes(cfg)
+    profile = get_profile(cfg.autotune_profile, axes)
+    plan = choose_lm_plan(
+        census, profile, dcn_size=cfg.dcn_size, overlap=cfg.overlap,
+        grad_accum=cfg.grad_accum,
+        # the pipeline steps have no sync-state channel (validate_lm_cfg
+        # rejects dcn_compress there): keep int8 out of the candidates
+        # instead of choosing a plan the trainer would then refuse
+        allow_compress=cfg.pp_size == 0 and cfg.pp == 1,
+        ladder=(BUCKET_LADDER_MB if cfg.bucket_mb is None
+                else (float(cfg.bucket_mb),)))
+    resolved = dataclasses.replace(
+        cfg, sync_plan=None, dcn_compress=plan.dcn_compress,
+        bucket_mb=cfg.bucket_mb if cfg.bucket_mb is not None
+        else plan.bucket_mb)
+    return resolved, plan

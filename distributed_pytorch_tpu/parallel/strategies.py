@@ -103,6 +103,22 @@ class StatefulStrategy(Protocol):
                  sync_state: jax.Array) -> tuple[PyTree, jax.Array]: ...
 
 
+class SizedLeaf:
+    """The two attributes ``make_bucket_plan`` reads (``size`` and
+    ``dtype.itemsize``), without a device array — the shared stand-in
+    for planning buckets from shapes alone (the autotuner's census,
+    lm.py's EF-residual sizing).  Lives here, next to the planner whose
+    contract it mirrors, so a change to the planner's leaf requirements
+    has ONE stand-in to update."""
+
+    __slots__ = ("size", "dtype")
+
+    def __init__(self, size: int, dtype):
+        import numpy as np
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+
+
 def make_bucket_plan(leaves: list, bucket_bytes: int) -> list[list[int]]:
     """Pack leaf indices into ~``bucket_bytes`` buckets in REVERSE flatten
     order (torch DDP's Reducer packing, reference main_ddp.py:137's engine:
@@ -1022,6 +1038,16 @@ def get(name: str) -> Strategy:
     try:
         return _REGISTRY[name]()
     except KeyError:
+        if name == "auto":
+            # "auto" is not a strategy: it resolves TO one.  The Trainer
+            # does that (parallel/autotune.resolve_train_auto) before any
+            # registry lookup; reaching here means a caller skipped it.
+            raise ValueError(
+                "strategy 'auto' must be resolved to a named strategy "
+                "first (train.Trainer does this via "
+                "parallel/autotune.resolve_train_auto); the registry "
+                f"holds only concrete strategies: {sorted(_REGISTRY)}"
+            ) from None
         raise ValueError(
             f"unknown strategy {name!r}; available: {sorted(_REGISTRY)}"
         ) from None

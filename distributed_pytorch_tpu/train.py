@@ -52,6 +52,15 @@ class TrainConfig:
     momentum: float = 0.9         # main.py:104
     weight_decay: float = 1e-4    # main.py:104
     batch_size: int = 256         # per replica (main.py:18)
+    # Gradient-sync strategy (parallel/strategies.py), or "auto" (round
+    # 11): calibrate the topology's per-axis links (or take an injected
+    # profile — ``autotune_profile``), census the model's grad tree, and
+    # resolve to the named strategy + bucket/compression knobs that
+    # minimize predicted step-sync time (parallel/autotune.py).  The
+    # resolved plan routes through the existing strategies unchanged, so
+    # auto under a forced profile trains bitwise-identically to the
+    # named strategy it resolves to (test-pinned); the Trainer records
+    # the explainable plan as ``trainer.sync_plan``.
     strategy: str = "ddp"
     # Backward-overlapped gradient sync (round 8): emit each ~25 MB
     # bucket's collective INSIDE the backward graph at the bucket's layer-
@@ -80,6 +89,13 @@ class TrainConfig:
     # keeps the exact full-precision psum.  Rejected for strategies with
     # no DCN hop.
     dcn_compress: str | None = None
+    # Profile source for strategy="auto" (parallel/autotune.py): None =
+    # load the repo-local cached profile for this topology or calibrate
+    # and cache one; a synthetic preset name ("uniform",
+    # "fast_ici_slow_dcn", ...) or a profile-JSON path or a
+    # TopologyProfile instance forces the chooser's inputs (CPU tests,
+    # the dryrun).  Ignored unless strategy="auto".
+    autotune_profile: Any = None
     steps_per_loop: int = 1       # K optimizer steps per device dispatch
     sync_bn: bool = False         # reference never syncs BN (SURVEY.md 2.3)
     # torch DDP's broadcast_buffers=True: BN running stats follow rank 0
@@ -456,6 +472,26 @@ class Trainer:
 
     def __init__(self, cfg: TrainConfig, mesh: Mesh | None = None,
                  num_devices: int | None = None):
+        # strategy="auto" (round 11): resolve FIRST, to a named strategy
+        # plus bucket/dcn knobs, so everything below — including the
+        # bitwise-pinned step builders — runs the exact named path.  The
+        # explainable plan (predicted ms + per-axis bytes) is kept on
+        # the trainer; pass mesh=None so the resolved strategy's own
+        # mesh recipe applies.
+        self.sync_plan = None
+        if cfg.strategy == "auto":
+            if mesh is not None:
+                # resolution decides the topology (flat vs factored) and
+                # hence the mesh shape; a pre-built mesh could disagree
+                # with whatever the chooser picks, which would only
+                # surface as a cryptic trace-time sharding error
+                raise ValueError(
+                    "strategy='auto' builds its own mesh from the "
+                    "resolved plan; pass mesh=None (use num_devices to "
+                    "bound the fleet)")
+            from .parallel import autotune
+            cfg, self.sync_plan = autotune.resolve_train_auto(
+                cfg, num_devices=num_devices)
         self.cfg = cfg
         self.strategy = strat.get(cfg.strategy)
         self.data_axes = getattr(self.strategy, "axes", None) or DATA_AXIS

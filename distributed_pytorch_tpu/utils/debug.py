@@ -264,6 +264,51 @@ class ConsistencyError(AssertionError):
     """A data-parallel training invariant was violated."""
 
 
+def plan_bytes_vs_schedule(plan, sched: list[dict], *,
+                           min_bytes: int = 1024) -> dict[str, dict]:
+    """Predicted-vs-measured wire accounting for an autotuner SyncPlan
+    (parallel/autotune.py) against a traced step's schedule: for each
+    axis the plan predicts traffic on, pair its ``predicted_bytes``
+    (operand-payload, scan-trip-weighted — the same accounting as
+    ``collective_stats``'s ``bytes_executed``) with the measured
+    ``bytes_executed`` of that axis's collectives (``min_bytes`` filters
+    the scalar loss/health reductions, as everywhere).  Returns
+    ``{axis: {"predicted": int, "measured": int, "ratio": float}}`` —
+    the cost model's ground-truth check (round 11)."""
+    per_axis = per_axis_collective_stats(sched, min_bytes=min_bytes)
+    out: dict[str, dict] = {}
+    for ap in plan.per_axis:
+        if ap.predicted_bytes <= 0:
+            continue
+        measured = per_axis.get(ap.axis, {}).get("bytes_executed", 0)
+        out[ap.axis] = {"predicted": int(ap.predicted_bytes),
+                        "measured": int(measured),
+                        "ratio": measured / ap.predicted_bytes}
+    return out
+
+
+def assert_plan_bytes_match(plan, sched: list[dict], *, rtol: float = 0.5,
+                            min_bytes: int = 1024) -> dict[str, dict]:
+    """Assert every axis the plan predicts traffic on measures within
+    ``rtol`` relative tolerance of the prediction — the autotuner's
+    cost model is only trustworthy while its byte predictions track the
+    emitted program (the measured side may run slightly over: the
+    schedule also carries non-sync collectives like BN-buffer
+    broadcasts above ``min_bytes``).  Returns the comparison rows."""
+    rows = plan_bytes_vs_schedule(plan, sched, min_bytes=min_bytes)
+    if not rows:
+        raise ConsistencyError(
+            f"plan {plan.strategy!r} predicts no per-axis traffic to "
+            f"check (per_axis={plan.per_axis!r})")
+    bad = {a: r for a, r in rows.items()
+           if abs(r["ratio"] - 1.0) > rtol}
+    if bad:
+        raise ConsistencyError(
+            f"predicted per-axis bytes diverge from the measured "
+            f"schedule beyond rtol={rtol}: {bad} (all rows: {rows})")
+    return rows
+
+
 def pipeline_schedule_stats(clocks: list[dict], *, n_stages: int) -> dict:
     """Summary statistics of a 1F1B timetable (the ``pp_clocks`` data a
     ``make_lm_1f1b_train_step`` step carries): measured ``bubble_fraction``

@@ -41,6 +41,7 @@ import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributed_pytorch_tpu.parallel import autotune  # noqa: E402
 from distributed_pytorch_tpu.parallel import strategies as strat  # noqa: E402
 from distributed_pytorch_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
@@ -49,6 +50,55 @@ from distributed_pytorch_tpu.utils import debug as dbg  # noqa: E402
 PER_DEV_BATCH = int(os.environ.get("BENCH_PER_DEV_BATCH", "4"))
 WINDOW = int(os.environ.get("BENCH_WINDOW", "20"))
 OVERLAP = os.environ.get("BENCH_STRATEGY_OVERLAP", "0") == "1"
+
+# Round 11: calibrate this CPU mesh's links ONCE per topology (flat and
+# factored) so every row gains a predicted_ms column from the autotune
+# cost model — the same table then holds the model's prediction NEXT TO
+# the inspector's measured per-axis bytes, making the cost model
+# auditable from one command.  (CPU-mesh absolute times are rough; the
+# point is that the BYTE predictions are exact and the ms ordering is
+# sane.)
+_PROFILES: dict[str, autotune.TopologyProfile] = {}
+
+
+def _profile_for(dcn_size: int) -> autotune.TopologyProfile:
+    key = "factored" if dcn_size > 1 else "flat"
+    if key not in _PROFILES:
+        axes = autotune.train_topology_axes(dcn_size, N_DEV)
+        mesh = make_mesh(N_DEV, axis_names=tuple(axes),
+                         axis_shape=tuple(axes.values()))
+        _PROFILES[key] = autotune.calibrate(
+            mesh, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
+            inner=2, reps=2)
+    return _PROFILES[key]
+
+
+_CENSUS: list = []
+
+
+def _census() -> autotune.GradCensus:
+    if not _CENSUS:  # one abstract init for all rows (pure fn of model)
+        import jax
+
+        from distributed_pytorch_tpu.models import vgg
+        _CENSUS.append(autotune.grad_census(jax.eval_shape(
+            lambda k: vgg.init(k, "VGG11")[0], jax.random.key(0))))
+    return _CENSUS[0]
+
+
+def predicted_ms(name: str, compress: str | None, overlap: bool,
+                 factored: bool,
+                 bucket_mb: float | None = None) -> float | None:
+    """The autotune cost model's predicted SYNC ms/step for this row
+    (None where the model has no formula — e.g. the pipeline row)."""
+    prof = _profile_for(2 if factored else 1)
+    pred = autotune.predict_named(
+        name, _census(), prof, dcn_compress=compress, overlap=overlap,
+        bucket_mb=bucket_mb if bucket_mb is not None
+        else strat.BUCKET_CAP_MB)
+    if pred is None:
+        return None
+    return pred["ms_exposed" if overlap else "ms_total"]
 
 
 def comm_profile(tr: Trainer, images, labels) -> dict:
@@ -101,14 +151,28 @@ def bench_strategy(name: str) -> tuple[float, dict, bool]:
     compress = None
     if name == "hierarchical_int8":
         name, compress = "hierarchical", "int8"
-    # Factored-axis strategies (hierarchical): mesh=None lets the Trainer
-    # build the right ('dcn', 'ici') mesh from cfg.dcn_size — one recipe.
-    factored = getattr(strat.get(name), "axes", None) is not None
-    mesh = make_mesh(N_DEV) if (name != "none" and not factored) else None
-    overlap = OVERLAP and name in strat.overlap_capable() and name != "none"
-    cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH, augment=False,
-                      overlap=overlap, dcn_compress=compress)
-    tr = Trainer(cfg, mesh=mesh)
+    if name == "auto":
+        # the autotuner row (round 11): resolve from the CPU-calibrated
+        # factored profile, then measure the resolved plan like any row
+        factored = True
+        cfg = TrainConfig(strategy="auto", batch_size=PER_DEV_BATCH,
+                          augment=False, dcn_size=2,
+                          autotune_profile=_profile_for(2))
+        tr = Trainer(cfg)
+        overlap = tr.cfg.overlap
+    else:
+        # Factored-axis strategies (hierarchical): mesh=None lets the
+        # Trainer build the ('dcn', 'ici') mesh from cfg.dcn_size — one
+        # recipe.
+        factored = getattr(strat.get(name), "axes", None) is not None
+        mesh = make_mesh(N_DEV) if (name != "none"
+                                    and not factored) else None
+        overlap = (OVERLAP and name in strat.overlap_capable()
+                   and name != "none")
+        cfg = TrainConfig(strategy=name, batch_size=PER_DEV_BATCH,
+                          augment=False, overlap=overlap,
+                          dcn_compress=compress)
+        tr = Trainer(cfg, mesh=mesh)
     n = tr.n_replicas
     rng = np.random.default_rng(0)
     images = rng.integers(
@@ -117,6 +181,14 @@ def bench_strategy(name: str) -> tuple[float, dict, bool]:
 
     tr.train_step(images, labels)  # compile + warm-up (excluded)
     comm = comm_profile(tr, images, labels)
+    # the cost-model column (round 11): predicted sync ms for the row's
+    # ACTUAL resolved strategy/knobs, from the CPU-calibrated profile
+    comm["predicted_ms"] = predicted_ms(
+        tr.cfg.strategy, tr.cfg.dcn_compress, tr.cfg.overlap,
+        getattr(tr.strategy, "axes", None) is not None,
+        tr.cfg.overlap_bucket_mb)
+    if name == "auto":
+        comm["resolved"] = tr.sync_plan.summary()
     times = []
     for _ in range(WINDOW):
         t0 = time.perf_counter()
@@ -169,6 +241,7 @@ def bench_lm_pp(pp_size: int = 2,
             "collective_count_by_axis": {a: s["executions"]
                                          for a, s in per_axis.items()},
             "hlo_collective_count": None, "hlo_collectives": None,
+            "predicted_ms": None,  # no cost-model formula for the pp row
             "pp_bubble_fraction": pp_stats["bubble_fraction"],
             "pp_bubble_bound": pp_stats["analytic_bound"]}
     times = []
@@ -183,7 +256,7 @@ def bench_lm_pp(pp_size: int = 2,
 def main() -> None:
     names = ["none", "ddp", "bucketed", "hierarchical", "hierarchical_int8",
              "all_reduce", "gather_scatter_symmetric", "gather_scatter",
-             "quantized", "quantized_ring", "quantized_ring_ef"]
+             "quantized", "quantized_ring", "quantized_ring_ef", "auto"]
     results: dict[str, float] = {}
     comms: dict[str, dict] = {}
     for name in names:
@@ -222,19 +295,25 @@ def main() -> None:
                 f" (<= {c['pp_bubble_bound']:.3f})")
 
     ddp = results["ddp"]
-    print("\n| Strategy | s/step | vs ddp | comm MB/step | dcn/ici MB | "
-          "bubble | collectives (interleaved) | HLO collectives |",
+    print("\n| Strategy | s/step | vs ddp | predicted sync ms | "
+          "comm MB/step | dcn/ici MB | bubble | "
+          "collectives (interleaved) | HLO collectives |",
           file=sys.stderr)
-    print("|---|---|---|---|---|---|---|---|", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|---|---|", file=sys.stderr)
     for name in names:
         c = comms[name]
         hlo = c["hlo_collective_count"]
+        pred = c.get("predicted_ms")
         print(f"| {name} | {results[name]:.3f} | "
               f"{results[name] / ddp:.2f}x | "
+              f"{f'{pred:.3f}' if pred is not None else '-'} | "
               f"{c['comm_bytes_per_step'] / 1e6:.2f} | "
               f"{axis_mb(c)} | {bubble(c)} | "
               f"{c['collective_count']} ({c['collectives_interleaved']}) | "
               f"{hlo if hlo is not None else '-'} |", file=sys.stderr)
+    if "auto" in comms and "resolved" in comms["auto"]:
+        print(f"\nauto resolved: {comms['auto']['resolved']}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -220,6 +220,50 @@ def test_bench_pp_env_knobs_fail_loudly():
     assert bench.canon_microbatches_env("3", 0) == 3
 
 
+def test_bench_autotune_env_knob_fails_loudly():
+    """A typo'd BENCH_AUTOTUNE must raise before any measurement (the
+    BENCH_KV_DTYPE contract); unset/''/'0' skip cleanly, '1' runs."""
+    assert bench.canon_autotune_env(None) is False
+    assert bench.canon_autotune_env("") is False
+    assert bench.canon_autotune_env("0") is False
+    assert bench.canon_autotune_env("1") is True
+    for bad in ("yes", "true", "2", " 1", "auto"):
+        with pytest.raises(ValueError, match="BENCH_AUTOTUNE"):
+            bench.canon_autotune_env(bad)
+
+
+def test_bench_json_keys_include_autotune_gate():
+    """Round-11 schema: the autotune A/B keys ride the JSON, the knob is
+    canonicalized pre-bench, and the leg calibrates -> chooses -> A/Bs
+    with the hardened-window discipline (>= 5 alternating reps, median,
+    precompile outside the window) against the hand-picked default."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("train_autotune_speedup", "train_autotune_plan"):
+        assert key in src, key
+    assert "canon_autotune_env" in src and "BENCH_AUTOTUNE" in src
+    sig = inspect.signature(bench.bench_train_autotune)
+    assert sig.parameters["reps"].default >= 5
+    atsrc = inspect.getsource(bench.bench_train_autotune)
+    assert "get_profile" in atsrc          # calibrate-or-cache
+    assert "precompile_steps" in atsrc     # compile outside the window
+    assert "plan.summary()" in atsrc       # the explainable plan rides
+    assert 'strategy="auto" if auto else "ddp"' in atsrc  # the A/B pair
+
+
+def test_bench_strategies_emits_predicted_ms_and_auto_row():
+    """scripts/bench_strategies.py (round 11): every row gains the cost
+    model's predicted_ms next to the measured per-axis byte columns,
+    and an 'auto' row resolves from a CPU-calibrated profile."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "bench_strategies.py")
+    with open(path) as f:
+        src = f.read()
+    for key in ("predicted_ms", "autotune.calibrate", "predict_named",
+                '"auto"', "resolved"):
+        assert key in src, key
+
+
 def test_bench_json_keys_include_pp_gate():
     """Round-10 schema: the interleaved-1F1B A/B keys ride the JSON, the
     knobs are canonicalized pre-bench, and the A/B reads its bubble from

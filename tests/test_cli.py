@@ -157,3 +157,34 @@ def test_parser_pp_size_flags():
     with pytest.raises(ValueError, match="one, not both"):
         validate_lm_cfg(LMTrainConfig(model=model, pp_size=2, pp=2))
     validate_lm_cfg(LMTrainConfig(model=model, pp_size=2, microbatches=4))
+
+
+def test_parser_autotune_flags():
+    """Round-11 surface: the autotuner knobs reach both CLIs — VGG
+    --strategy auto / --autotune-profile, LM --sync-plan auto /
+    --dcn-compress / --bucket-mb — with None defaults so historical
+    invocations are byte-identical."""
+    from distributed_pytorch_tpu import lm_cli
+
+    args = cli.build_parser().parse_args([])
+    assert args.autotune_profile is None and args.strategy == "ddp"
+    args = cli.build_parser().parse_args(
+        ["--strategy", "auto", "--autotune-profile", "fast_ici_slow_dcn"])
+    assert args.strategy == "auto"
+    assert args.autotune_profile == "fast_ici_slow_dcn"
+
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.sync_plan is None and lm_args.dcn_compress is None
+    assert lm_args.bucket_mb is None and lm_args.autotune_profile is None
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--dp", "4", "--dcn-size", "2", "--dcn-compress", "int8",
+         "--bucket-mb", "4", "--sync-plan", "auto",
+         "--autotune-profile", "uniform"])
+    assert lm_args.dcn_compress == "int8" and lm_args.bucket_mb == 4.0
+    assert lm_args.sync_plan == "auto"
+    assert lm_args.autotune_profile == "uniform"
+
+    # incoherent combos refuse through the ONE validation path
+    from distributed_pytorch_tpu.lm import LMTrainConfig, validate_lm_cfg
+    with pytest.raises(ValueError, match="no DCN hop"):
+        validate_lm_cfg(LMTrainConfig(dp=4, dcn_compress="int8"))
