@@ -164,19 +164,29 @@ def bench_tpu(batch_per_replica: int, warmup: int,
     return sps_chip, mfu
 
 
-def canon_overlap_env(value: str | None) -> bool:
-    """Validate the BENCH_OVERLAP knob ('1' = run the overlap A/B, the
-    default; '0' = skip it).  A typo must fail HERE, before any
+def _canon_bool_env(name: str, value: str | None, *, default: bool,
+                    guess: str) -> bool:
+    """The ONE '0'/'1' env-knob validation (the BENCH_KV_DTYPE
+    fail-loudly contract): a typo must raise HERE, before any
     measurement — inside the benches it would be swallowed by their
-    catch-alls while the JSON silently omitted the A/B (same contract as
-    BENCH_KV_DTYPE's pre-bench canonicalization)."""
-    if value is None or value == "" or value == "1":
+    catch-alls while the JSON silently omitted (or silently ran) the
+    gate.  Unset/'' takes the knob's ``default``."""
+    if value is None or value == "":
+        return default
+    if value == "1":
         return True
     if value == "0":
         return False
     raise ValueError(
-        f"BENCH_OVERLAP must be '0' or '1', got {value!r} — refusing to "
-        f"guess which A/B you meant")
+        f"{name} must be '0' or '1', got {value!r} — refusing to guess "
+        f"{guess}")
+
+
+def canon_overlap_env(value: str | None) -> bool:
+    """Validate the BENCH_OVERLAP knob ('1' = run the overlap A/B, the
+    default; '0' = skip it)."""
+    return _canon_bool_env("BENCH_OVERLAP", value, default=True,
+                           guess="which A/B you meant")
 
 
 def bench_train_overlap(batch_per_replica: int = 64, iters: int = 30,
@@ -358,17 +368,10 @@ def bench_train_dcn(dcn_size: int, compress: str | None,
 def canon_autotune_env(value: str | None) -> bool:
     """Validate the BENCH_AUTOTUNE knob: '1' runs the round-11
     calibrate->choose->A/B leg, unset/''/'0' skips it (the default —
-    calibration takes real device time).  A typo must fail HERE, before
-    any measurement (the BENCH_KV_DTYPE contract): inside the bench it
-    would be swallowed by the catch-all while the JSON silently omitted
-    the autotune keys."""
-    if value is None or value in ("", "0"):
-        return False
-    if value == "1":
-        return True
-    raise ValueError(
-        f"BENCH_AUTOTUNE must be '0' or '1', got {value!r} — refusing to "
-        f"guess whether to run the calibrate->choose->A/B leg")
+    calibration takes real device time)."""
+    return _canon_bool_env(
+        "BENCH_AUTOTUNE", value, default=False,
+        guess="whether to run the calibrate->choose->A/B leg")
 
 
 def bench_train_autotune(batch_per_replica: int = 64, iters: int = 30,
@@ -444,6 +447,78 @@ def bench_train_autotune(batch_per_replica: int = 64, iters: int = 30,
          f"default-ddp -> {speedup:.3f}x ({reps} reps median)")
     return {"speedup": speedup, "ms_auto": med[True],
             "ms_default": med[False], "plan": plan.summary()}
+
+
+def canon_elastic_env(value: str | None) -> bool:
+    """Validate the BENCH_ELASTIC knob: '1' runs the round-12 elastic
+    shrink->reshard->grow recovery gate, unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_ELASTIC", value, default=False,
+        guess="whether to run the elastic-recovery gate")
+
+
+def bench_elastic(steps: int = 2, seq: int = 128, batch: int = 8) -> dict:
+    """Elastic-resize recovery gate (round 12, BENCH_ELASTIC=1): measure
+    the detect->resume gap a gang pays when it loses a member — the
+    in-process leg (mesh rebuild + cross-topology ``load_resharded`` +
+    one proving step at the smaller size), which is everything except
+    the re-rendezvous the launcher layer adds on top.
+
+    Shrink-and-grow on the bench LM config: train ``steps`` at the full
+    fleet (ZeRO-3 so the reshard is real — params/Adam state change
+    layout with the world size), checkpoint SHARDED, then time
+    ``rebuild(dp=half)`` + ``load_resharded`` + one step; then grow back
+    to the full fleet the same way.  Returns the recovery wall ms and
+    the resize-event count (shrink + grow = 2) for the JSON keys
+    ``elastic_recovery_ms`` / ``elastic_resize_events``."""
+    import tempfile
+
+    import jax
+
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.parallel import elastic as el
+    from distributed_pytorch_tpu.utils.checkpoint import ShardedCheckpointer
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        raise RuntimeError(
+            f"elastic gate needs >= 2 devices (have {n_dev}): a 1-chip "
+            f"fleet has no smaller world size to reshard onto")
+    dp = n_dev if n_dev % 2 == 0 else n_dev - 1
+    half = dp // 2
+    cfg = LMTrainConfig(model=_lm_cfg(), dp=dp, fsdp=True,
+                        compute_dtype="bfloat16")
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+
+    def lm_batch():
+        t = rng.integers(0, 256, (batch, seq)).astype(np.int32)
+        return t, np.roll(t, -1, 1)
+
+    for _ in range(steps):
+        float(tr.train_step(*lm_batch()))
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+    ck = ShardedCheckpointer(ckpt_dir)
+    ck.save({"params": tr.params, "opt": tr.opt_state}, tr._step)
+    events = 0
+    # SHRINK: rebuild at half the fleet + reshard-restore + prove a step
+    t0 = time.perf_counter()
+    start = el.reshard_from_checkpoint(tr, ckpt_dir, dp=half,
+                                       fsdp=half > 1)
+    loss = float(tr.train_step(*lm_batch()))
+    recovery_ms = (time.perf_counter() - t0) * 1e3
+    events += 1
+    assert start == steps and np.isfinite(loss), (start, loss)
+    # GROW back to the full fleet through the same machinery
+    ck.save({"params": tr.params, "opt": tr.opt_state}, tr._step)
+    el.reshard_from_checkpoint(tr, ckpt_dir, dp=dp, fsdp=True)
+    float(tr.train_step(*lm_batch()))
+    events += 1
+    _log(f"[bench] elastic gate: {dp}->{half}->{dp} devices, recovery "
+         f"(rebuild + load_resharded + 1 step) {recovery_ms:.0f} ms, "
+         f"{events} resize events, reshard stats "
+         f"{getattr(tr._ckptr, 'last_reshard_stats', None)}")
+    return {"recovery_ms": recovery_ms, "resize_events": events}
 
 
 def canon_pp_size_env(value: str | None) -> int:
@@ -881,6 +956,9 @@ def main() -> None:
     # BENCH_AUTOTUNE=1 runs calibrate->choose->A/B vs the hand-picked
     # default and stamps the chosen plan into the JSON.
     run_autotune = canon_autotune_env(os.environ.get("BENCH_AUTOTUNE"))
+    # Elastic-recovery knob (round 12), validated loudly pre-bench:
+    # BENCH_ELASTIC=1 measures the shrink->reshard->grow recovery gap.
+    run_elastic = canon_elastic_env(os.environ.get("BENCH_ELASTIC"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -932,6 +1010,15 @@ def main() -> None:
             autotune_ab = bench_train_autotune()
         except Exception as e:
             _log(f"[bench] train-autotune A/B failed ({e}); omitting")
+
+    # Elastic-recovery gate (round 12): shrink -> load_resharded -> grow
+    # on the LM trainer; optional like the other gates.
+    elastic_ab = None
+    if run_elastic:
+        try:
+            elastic_ab = bench_elastic()
+        except Exception as e:
+            _log(f"[bench] elastic gate failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -1024,6 +1111,16 @@ def main() -> None:
                                    if autotune_ab is not None else None),
         "train_autotune_plan": (autotune_ab["plan"]
                                 if autotune_ab is not None else None),
+        # elastic-recovery gate (round 12, BENCH_ELASTIC=1): wall-clock
+        # of the in-process shrink recovery (mesh rebuild + cross-
+        # topology load_resharded + one proving step at the smaller
+        # world size — everything except the launcher's re-rendezvous)
+        # and the resize events exercised (shrink + grow back = 2).
+        # Null when the gate is skipped.
+        "elastic_recovery_ms": (round(elastic_ab["recovery_ms"], 1)
+                                if elastic_ab is not None else None),
+        "elastic_resize_events": (elastic_ab["resize_events"]
+                                  if elastic_ab is not None else None),
         # transformer-stack gates (BASELINE.md is the prose companion;
         # these keys are the regression source of truth since round 4)
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)

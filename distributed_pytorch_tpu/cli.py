@@ -127,6 +127,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fold BatchNorm statistics into the conv weights "
                         "for evaluation (mathematically identical, one "
                         "fewer normalize pass per conv)")
+    p.add_argument("--elastic", action="store_true",
+                   help="run as an elastic-gang member (launch.py "
+                        "--elastic agent): publish heartbeats at DISPATCH "
+                        "cadence (a long epoch never reads as a hang) and "
+                        "honor the agent's drain signal at EPOCH "
+                        "boundaries — flush a checkpoint and exit with "
+                        "the drain code so the resized gang resumes "
+                        "resharded (requires --checkpoint-dir; the LM "
+                        "CLI drains at step granularity)")
+    p.add_argument("--min-nodes", type=int, default=1,
+                   help="elastic: smallest world size this config can "
+                        "train at (validation/visibility; the agent "
+                        "enforces the bound)")
+    p.add_argument("--max-nodes", type=int, default=None,
+                   help="elastic: largest world size (default: the "
+                        "launch world size)")
     p.add_argument("--debug-checks", action="store_true",
                    help="after each epoch, verify DP invariants: replicated "
                         "params/opt-state bitwise-identical on every device "
@@ -167,7 +183,25 @@ def build_loaders(args, n_replicas: int, replica_offset: int,
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.elastic:
+        if not args.checkpoint_dir:
+            parser.error(
+                "--elastic requires --checkpoint-dir: the drain sync "
+                "point must flush a checkpoint for the resized gang to "
+                "resume from")
+        if args.strategy == "none":
+            parser.error(
+                "--elastic needs a mesh-backed strategy (there is no "
+                "topology to resize under --strategy none)")
+        if args.min_nodes < 1 or (args.max_nodes is not None
+                                  and args.max_nodes < args.min_nodes):
+            parser.error("--min-nodes/--max-nodes must satisfy "
+                         "1 <= min <= max")
+    elif args.min_nodes != 1 or args.max_nodes is not None:
+        parser.error("--min-nodes/--max-nodes configure --elastic; pass "
+                     "it (or drop the bounds)")
 
     # Rendezvous FIRST: jax.distributed.initialize must run before anything
     # touches a backend (even jax.process_index()), mirroring the reference's
@@ -227,10 +261,32 @@ def main(argv: list[str] | None = None) -> int:
         if start_epoch:
             log.info("resumed from checkpoint at epoch %d", start_epoch)
 
+    heartbeat = drain_guard = None
+    if args.elastic:
+        # elastic membership (round 12): heartbeats when an elastic agent
+        # launched us, drain-with-checkpoint on SIGTERM either way.  The
+        # VGG trainer's sync points are EPOCH boundaries (train_epoch is
+        # one dispatch pipeline); the LM CLI drains per step.
+        from .parallel import elastic as elastic_mod
+        drain_guard = elastic_mod.DrainGuard().install()
+        ectx = elastic_mod.ElasticContext.from_env()
+        if ectx is not None:
+            heartbeat = elastic_mod.Heartbeat(
+                ectx.run_dir, ectx.rank, ectx.generation)
+
     for epoch in range(start_epoch, args.epochs):
+        if drain_guard is not None and drain_guard.sync():
+            from .parallel import elastic as elastic_mod
+            log.info("drain requested: flushing checkpoint at epoch %d "
+                     "and leaving at the sync point", epoch)
+            elastic_mod.drain_exit(lambda: ckpt.save(trainer, epoch))
         if args.profile_dir and epoch == start_epoch:
             jax.profiler.start_trace(args.profile_dir)
-        trainer.train_epoch(train_loaders, epoch)
+        # heartbeat at DISPATCH cadence (not per epoch: an epoch longer
+        # than the agent's staleness bound must not read as a hang)
+        trainer.train_epoch(
+            train_loaders, epoch,
+            on_step=(heartbeat.beat if heartbeat is not None else None))
         if args.profile_dir and epoch == start_epoch:
             jax.profiler.stop_trace()
         if args.debug_checks:

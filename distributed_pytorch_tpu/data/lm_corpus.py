@@ -145,6 +145,19 @@ class LMDataLoader:
     for corpora whose window COUNT is itself too large to index in host
     RAM (pairs with ``load_corpus(mmap=True)``).  Weaker statistical
     shuffle (a strided walk), same determinism and sharding guarantees.
+
+    ``elastic_order`` (round 12, the ``data.sampler.ElasticSampler``
+    convention): the default rank assignment interleaves padded-order
+    positions by rank (``p = j * num_replicas + rank``), so the GLOBAL
+    consumption order depends on ``num_replicas`` — resume a checkpoint
+    at a different world size and windows are silently dropped and
+    double-consumed.  With ``elastic_order=True`` the epoch order is
+    consumed in CONTIGUOUS global-batch blocks per step and rank ``r``
+    takes the ``r``-th contiguous stripe: the global order is a pure
+    function of (seed, epoch, step) — never the world size — so an
+    elastic resize mid-run (the recorded (epoch, offset) replayed into
+    a re-strided loader) loses and repeats nothing.  ``lm_cli
+    --elastic`` sets it.
     """
 
     def __init__(
@@ -159,6 +172,7 @@ class LMDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         shuffle_mode: str = "permutation",
+        elastic_order: bool = False,
     ):
         if len(corpus) < seq_len + 1:
             raise ValueError(
@@ -176,6 +190,7 @@ class LMDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.shuffle_mode = shuffle_mode
+        self.elastic_order = elastic_order
         self._epoch = 0
         # -1: the last window must have a next-byte target available
         self.n_windows = (len(corpus) - 1) // seq_len
@@ -229,7 +244,17 @@ class LMDataLoader:
                if self.drop_last else self.per_rank)
         for start in range(0, end, self.batch_size):
             js = np.arange(start, min(start + self.batch_size, end))
-            p = js * self.num_replicas + self.rank
+            if self.elastic_order:
+                # world-size-independent global order (ElasticSampler
+                # convention): step s consumes the contiguous block
+                # [s*GB, (s+1)*GB) of the padded epoch order, rank r the
+                # r-th contiguous stripe — a resize repartitions the
+                # SAME stream instead of re-interleaving it
+                step = start // self.batch_size
+                p = (step * self.batch_size * self.num_replicas
+                     + self.rank * self.batch_size + (js - start))
+            else:
+                p = js * self.num_replicas + self.rank
             idx = bij(p % max(self.n_windows, 1))
             batch = np.stack([
                 toks[i * self.seq_len: i * self.seq_len + self.seq_len + 1]

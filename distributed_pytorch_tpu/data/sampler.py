@@ -80,3 +80,104 @@ class DistributedSampler:
 
     def __len__(self) -> int:
         return self.num_samples
+
+
+class ElasticSampler:
+    """Resize-stable sampler for the elastic gang (round 12).
+
+    ``DistributedSampler`` keys the WHOLE epoch split on a fixed
+    ``num_replicas`` — resize mid-epoch and every rank's stride changes,
+    so examples silently drop or double-count.  This sampler splits per
+    STEP instead, around one invariant: the global consumption order is
+    a pure function of ``(seed, epoch, step)`` and NEVER of the world
+    size.  Per optimizer step, the global batch is the next
+    ``global_batch`` indices of the epoch permutation (padded by
+    repeating the permutation head, exactly the torch ``drop_last=False``
+    convention); rank ``r`` of ``W`` takes the ``r``-th contiguous
+    stripe — the same order the trainers assemble the global array from
+    per-process shards, so the optimizer sees ONE canonical batch at any
+    world size.
+
+    Shard assignment re-keys off ``(epoch, generation, world_size)``
+    through ``set_generation`` — the elastic re-rendezvous calls it with
+    the new membership, and from that step on the stripes repartition
+    the SAME global order.  Hence across a resize no example is dropped
+    or double-counted: the union of all ranks' indices over any step
+    range equals the world-size-independent global order over that range
+    (test-pinned, including a mid-epoch shrink and grow-back).
+
+    ``global_batch % world_size != 0`` refuses loudly: an uneven stripe
+    would silently skew the per-rank batch the compiled step was traced
+    for.  (The agent shrinks to the survivor count; a count that cannot
+    divide the batch is a config the gang CANNOT resize to, and the
+    worker must say so rather than mis-shard.)
+    """
+
+    def __init__(self, dataset_size: int, global_batch: int, *,
+                 seed: int = 0, shuffle: bool = True):
+        if dataset_size <= 0 or global_batch <= 0:
+            raise ValueError(
+                f"dataset_size/global_batch must be positive, got "
+                f"{dataset_size}/{global_batch}")
+        self.dataset_size = dataset_size
+        self.global_batch = global_batch
+        self.seed = seed
+        self.shuffle = shuffle
+        self.steps_per_epoch = math.ceil(dataset_size / global_batch)
+        self.generation = 0
+        self.world_size = 1
+        self.rank = 0
+        self._order: tuple[int, np.ndarray] | None = None  # epoch memo
+
+    def set_generation(self, generation: int, world_size: int,
+                       rank: int) -> None:
+        """Re-key the shard assignment for a new gang membership (the
+        elastic analog of ``set_epoch``): called after every
+        re-rendezvous with the new ``(generation, world_size, rank)``."""
+        if not 0 <= rank < world_size:
+            raise ValueError(
+                f"rank {rank} out of range for world size {world_size}")
+        if self.global_batch % world_size:
+            raise ValueError(
+                f"cannot resize to world size {world_size}: global batch "
+                f"{self.global_batch} does not divide evenly — the gang "
+                f"must shrink/grow to a divisor of the batch")
+        self.generation = generation
+        self.world_size = world_size
+        self.rank = rank
+
+    # -- the world-size-independent global order ---------------------------
+    def epoch_of(self, step: int) -> int:
+        return step // self.steps_per_epoch
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        # memoized per epoch: the O(n) shuffle + pad must cost once per
+        # epoch (the DistributedSampler cadence), not once per step
+        if self._order is not None and self._order[0] == epoch:
+            return self._order[1]
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + epoch)
+            order = rng.permutation(self.dataset_size)
+        else:
+            order = np.arange(self.dataset_size)
+        pad = self.steps_per_epoch * self.global_batch - self.dataset_size
+        if pad > 0:
+            order = np.concatenate([order, order[:pad]])
+        self._order = (epoch, order)
+        return order
+
+    def global_indices(self, step: int) -> np.ndarray:
+        """THE global batch for optimizer step ``step`` — identical at
+        every world size (the property that makes resize lossless)."""
+        epoch = self.epoch_of(step)
+        offset = (step - epoch * self.steps_per_epoch) * self.global_batch
+        return self._epoch_order(epoch)[offset:offset + self.global_batch]
+
+    def indices(self, step: int) -> np.ndarray:
+        """This rank's stripe of ``global_indices(step)`` under the
+        current ``(generation, world_size)`` assignment: contiguous, in
+        rank order, so per-process shards concatenate back into the
+        canonical global batch."""
+        per = self.global_batch // self.world_size
+        g = self.global_indices(step)
+        return g[self.rank * per:(self.rank + 1) * per]

@@ -34,6 +34,27 @@ Two deliberate upgrades over the reference's setup:
   (JAX single-controller-per-host), so ``--nproc-per-node`` defaults to 1 and
   values >1 are for CPU simulation/testing, where each worker is given a
   disjoint slice of fake devices.
+- **Elastic resize** (``--elastic --min-nodes M --max-nodes N``, round 12).
+  Restart-at-the-same-size costs the whole gang for one lost member; elastic
+  mode makes a worker loss cost a RESHARD instead.  The agent gains
+  heartbeat-based liveness (workers publish ``hb_rank<R>.json`` into
+  ``ELASTIC_DIR`` each step — a HUNG straggler is detected by heartbeat
+  staleness, not just a dead PID), and on worker loss with at least
+  ``min_nodes`` survivors it drives a GENERATION BUMP instead of a restart:
+  survivors are drained gracefully (SIGTERM -> they exit the step loop at a
+  sync point, flush a checkpoint, and exit ``ELASTIC_DRAIN_EXIT_CODE``),
+  then the gang re-rendezvouses at the smaller world size and resumes from
+  the last-good checkpoint, resharded across the new topology
+  (parallel/elastic.py is the worker-side half; utils/checkpoint.py
+  ``load_resharded`` is the reshard).  When the lost slot becomes eligible
+  again (``rejoin_delay_s``) and the shrunk gang has provably advanced
+  (heartbeat steps moved >= ``grow_after_steps``), the same machinery GROWS
+  the gang back at the next boundary.  Both transitions are recorded as
+  ``GangResult.resize_events``; drain outcomes (how many workers flushed vs
+  needed SIGKILL) land in ``GangResult.drain``.  Elastic mode currently
+  drives ONE agent's workers (``--nnodes 1``, the CPU-simulation topology
+  every gang test uses; one worker == one "node"); coordinated multi-agent
+  membership is the carried-forward half (ROADMAP).
 """
 
 from __future__ import annotations
@@ -45,6 +66,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -55,6 +77,28 @@ from dataclasses import dataclass, field
 # it must never compete with them for chips or import time).  Pinned by
 # tests/test_faults.py::test_fault_exit_code_constants_agree.
 FAULT_EXIT_CODE = 77
+
+# Elastic-gang exit codes (round 12).  Workers use them to tell the agent
+# HOW they left; the agent must never confuse either with a failure.
+# Defined here (the jax-free side) and imported by parallel/elastic.py —
+# the worker-side half — so the two can never drift.
+#
+# DRAIN: the worker honored an agent-initiated drain (SIGTERM) at a step
+# boundary — it flushed its checkpoint and exited ready to re-rendezvous.
+ELASTIC_DRAIN_EXIT_CODE = 78
+# RESIZE: the worker itself REQUESTS a gang resize (the training sentry's
+# escalation rung between rollback-and-skip and abort): it rolled back to
+# last-good, checkpointed, and left at a sync point.  The agent treats the
+# exit like a lost worker — survivors drain and the gang re-rendezvouses
+# one smaller — but classifies the event as "requested".
+ELASTIC_RESIZE_EXIT_CODE = 79
+
+# Env contract the elastic agent exports to workers (beyond the torchrun
+# vars): the heartbeat/run directory and the resize bounds.
+ELASTIC_DIR_ENV = "ELASTIC_DIR"
+ELASTIC_MIN_ENV = "ELASTIC_MIN_NODES"
+ELASTIC_MAX_ENV = "ELASTIC_MAX_NODES"
+HEARTBEAT_PREFIX = "hb_rank"  # hb_rank<R>.json, written atomically
 
 DEFAULT_PORT = 6585  # reference start_ddp.sh:1 / main_all_reduce.py:96
 TERM_GRACE_S = 10.0
@@ -197,6 +241,49 @@ class WorkerSpec:
 
 
 @dataclass
+class ElasticConfig:
+    """Elastic-gang policy for one agent (round 12).
+
+    ``min_workers``/``max_workers`` bound the gang size (one worker == one
+    "node" in the single-agent topology).  ``heartbeat_timeout_s`` is the
+    hung-straggler bound: a worker whose newest CURRENT-GENERATION
+    heartbeat is older than this is killed and treated as lost (a worker
+    that never beat — e.g. still compiling — is judged by PID only, so a
+    long cold compile cannot be misread as a hang).  ``drain_grace_s`` is
+    how long survivors get to reach a sync point, flush their checkpoint
+    and exit ``ELASTIC_DRAIN_EXIT_CODE`` before SIGKILL.  A lost slot
+    becomes respawn-eligible ``rejoin_delay_s`` after the loss, and the
+    gang grows back only once every live worker's heartbeat step has
+    advanced >= ``grow_after_steps`` within the current generation — the
+    shrunk gang must provably train (and hence checkpoint) before the
+    grow-back costs another reshard."""
+
+    min_workers: int = 1
+    max_workers: int = 1
+    heartbeat_timeout_s: float = 300.0
+    drain_grace_s: float = 30.0
+    rejoin_delay_s: float = 0.0
+    grow_after_steps: int = 1
+    # Resize budget: total SHRINKS the run may absorb before the gang is
+    # declared failed (grow-backs are free).  Without a cap, a slot that
+    # deterministically crashes (bad host, poisoned env) would drive an
+    # unbounded shrink/grow oscillation; with one, the repeated loss
+    # eventually surfaces as the failure it is.  ``--max-restarts`` is
+    # NOT consulted in elastic mode — resizes replace restarts.
+    max_resizes: int = 16
+    run_dir: str | None = None  # heartbeat dir (default: mkdtemp)
+
+    def __post_init__(self):
+        if not 1 <= self.min_workers <= self.max_workers:
+            raise ValueError(
+                f"elastic bounds must satisfy 1 <= min <= max, got "
+                f"[{self.min_workers}, {self.max_workers}]")
+        if self.max_resizes < 1:
+            raise ValueError(
+                f"max_resizes must be >= 1, got {self.max_resizes}")
+
+
+@dataclass
 class GangResult:
     """Outcome of one gang attempt.
 
@@ -206,13 +293,22 @@ class GangResult:
     they feed the same ``--max-restarts`` budget as genuine failures
     (an injected crash must exercise the REAL restart path), but the
     classification separates "the chaos test fired" from "production
-    fell over" in logs and results."""
+    fell over" in logs and results.
+
+    ``resize_events`` (elastic mode) records every world-size change as
+    ``{"gen", "kind" ("shrink"/"grow"), "from_size", "to_size",
+    "reason", "rank"}``; ``drain`` accumulates graceful-drain outcomes
+    across all teardowns: how many workers exited the step loop cleanly
+    on SIGTERM ("drained" = flushed-checkpoint DRAIN exits, "exited" =
+    other voluntary exits) versus had to be SIGKILLed ("killed")."""
 
     returncode: int
     failed_rank: int | None = None
     restarts_used: int = 0
     per_rank: dict[int, int] = field(default_factory=dict)
     injected_failures: int = 0
+    resize_events: list = field(default_factory=list)
+    drain: dict = field(default_factory=dict)
 
     @property
     def injected(self) -> bool:
@@ -239,6 +335,7 @@ class LocalAgent:
         max_restarts: int = 0,
         monitor_interval_s: float = 0.1,
         agent_port: int | None = None,
+        elastic: ElasticConfig | None = None,
         log=print,
     ):
         self.argv = argv
@@ -252,44 +349,68 @@ class LocalAgent:
         # coordinator endpoint (nnodes > 1): node 0 hosts, everyone dials
         self.agent_port = (agent_port if agent_port is not None
                            else master_port + 1)
+        self.elastic = elastic
+        if elastic is not None and nnodes > 1:
+            raise ValueError(
+                "elastic resize drives one agent's workers (nnodes=1, the "
+                "worker-per-'node' CPU-simulation topology); coordinated "
+                "multi-agent membership is the carried-forward half "
+                "(ROADMAP 'Elastic gang + async relaxations')")
         self.log = log
         self._procs: dict[int, subprocess.Popen] = {}
         self._gen = 0  # current rendezvous generation (RESTART_ATTEMPT)
+        # graceful-drain accounting across every teardown of this run
+        # (satellite: _terminate_all outcome rides GangResult.drain)
+        self._drain_stats = {"drained": 0, "exited": 0, "killed": 0}
 
     def specs(self) -> list[WorkerSpec]:
-        world = self.nnodes * self.nproc
+        return self._specs_for(self.nproc)
+
+    def _specs_for(self, nproc: int) -> list[WorkerSpec]:
+        world = self.nnodes * nproc
         return [
             WorkerSpec(
-                rank=self.node_rank * self.nproc + lr,
+                rank=self.node_rank * nproc + lr,
                 local_rank=lr,
                 node_rank=self.node_rank,
                 world_size=world,
-                local_world_size=self.nproc,
+                local_world_size=nproc,
                 master_addr=self.master_addr,
                 master_port=self.master_port,
             )
-            for lr in range(self.nproc)
+            for lr in range(nproc)
         ]
 
     # -- process management ------------------------------------------------
-    def _spawn(self) -> None:
-        for spec in self.specs():
+    def _spawn(self, nproc: int | None = None,
+               extra_env: dict[str, str] | None = None) -> None:
+        for spec in self._specs_for(nproc if nproc is not None
+                                    else self.nproc):
             cmd = [sys.executable] + self.argv
             env = spec.env()
             env["RESTART_ATTEMPT"] = str(self._gen)
+            if extra_env:
+                env.update(extra_env)
             self._procs[spec.rank] = subprocess.Popen(cmd, env=env)
             self.log(f"[launch] node {self.node_rank}: started rank "
                      f"{spec.rank} (pid {self._procs[spec.rank].pid})")
 
-    def _terminate_all(self) -> None:
-        """SIGTERM the gang, escalate to SIGKILL after a grace period."""
+    def _terminate_all(self, grace_s: float = TERM_GRACE_S) -> dict:
+        """Graceful drain: SIGTERM the gang first (workers may reach a
+        sync point, flush their last checkpoint, and exit — the elastic
+        contract exits ``ELASTIC_DRAIN_EXIT_CODE``), escalate to SIGKILL
+        only after ``grace_s``.  Returns this teardown's outcome counts
+        and accumulates them into the run-wide ``GangResult.drain``
+        accounting: {"drained": DRAIN-code exits, "exited": other
+        voluntary exits under SIGTERM, "killed": needed SIGKILL}."""
+        outcome = {"drained": 0, "exited": 0, "killed": 0}
         live = [p for p in self._procs.values() if p.poll() is None]
         for p in live:
             try:
                 p.send_signal(signal.SIGTERM)
             except OSError:
                 pass
-        deadline = time.monotonic() + TERM_GRACE_S
+        deadline = time.monotonic() + grace_s
         for p in live:
             while p.poll() is None and time.monotonic() < deadline:
                 time.sleep(0.05)
@@ -299,6 +420,14 @@ class LocalAgent:
                 except OSError:
                     pass
                 p.wait()
+                outcome["killed"] += 1
+            elif p.returncode == ELASTIC_DRAIN_EXIT_CODE:
+                outcome["drained"] += 1
+            else:
+                outcome["exited"] += 1
+        for k, v in outcome.items():
+            self._drain_stats[k] += v
+        return outcome
 
     def _monitor(self, watch_remote: bool = False) -> GangResult:
         """Block until the gang finishes or any worker fails.
@@ -364,6 +493,200 @@ class LocalAgent:
                     )
             time.sleep(self.monitor_interval_s)
 
+    # -- elastic resize (round 12) ----------------------------------------
+    def _heartbeats(self, run_dir: str) -> dict[int, dict]:
+        """Read every rank's newest heartbeat: {rank: {"step", "gen",
+        "age_s"}}.  Heartbeats are single-JSON files written atomically
+        by parallel/elastic.py Heartbeat; unreadable/half-written files
+        are skipped (the next beat lands whole)."""
+        out: dict[int, dict] = {}
+        try:
+            names = os.listdir(run_dir)
+        except OSError:
+            return out
+        now = time.time()
+        for name in names:
+            if not (name.startswith(HEARTBEAT_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(run_dir, name)
+            try:
+                with open(path) as f:
+                    hb = json.load(f)
+                out[int(hb["rank"])] = {
+                    "step": int(hb["step"]), "gen": int(hb["gen"]),
+                    "age_s": now - os.path.getmtime(path)}
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def _clear_heartbeats(self, run_dir: str) -> None:
+        try:
+            for name in os.listdir(run_dir):
+                if name.startswith(HEARTBEAT_PREFIX):
+                    try:
+                        os.remove(os.path.join(run_dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+    def _run_elastic(self) -> GangResult:
+        """Elastic supervision: worker loss (dead PID, hung heartbeat, or
+        a worker-requested resize) within [min, max] costs a generation
+        bump — drain survivors at a sync point, re-rendezvous smaller,
+        resume from the resharded checkpoint — instead of the job; the
+        gang grows back once the lost slot is eligible again and the
+        shrunk gang has provably advanced."""
+        cfg = self.elastic
+        run_dir = cfg.run_dir or tempfile.mkdtemp(prefix="elastic_gang_")
+        os.makedirs(run_dir, exist_ok=True)
+        size = cfg.max_workers
+        lost_at: list[float] = []   # when each currently-lost slot died
+        injected = 0
+        events: list[dict] = []
+
+        def finish(code: int, failed_rank=None, per_rank=None) -> GangResult:
+            return GangResult(
+                returncode=code, failed_rank=failed_rank,
+                restarts_used=self._gen,
+                per_rank=per_rank if per_rank is not None else
+                {r: p.returncode for r, p in self._procs.items()},
+                injected_failures=injected, resize_events=events)
+
+        while True:
+            self._clear_heartbeats(run_dir)
+            self._procs = {}
+            self._spawn(size, extra_env={
+                ELASTIC_DIR_ENV: run_dir,
+                ELASTIC_MIN_ENV: str(cfg.min_workers),
+                ELASTIC_MAX_ENV: str(cfg.max_workers),
+            })
+            try:
+                kind, info = self._monitor_elastic(run_dir, size, lost_at)
+            except BaseException:
+                # Ctrl-C / SIGTERM to the agent: workers still get the
+                # CONFIGURED drain window to flush their checkpoint (an
+                # operator who set --drain-grace 60 for slow saves must
+                # not have teardown SIGKILL them at the 10 s default)
+                self._terminate_all(grace_s=cfg.drain_grace_s)
+                raise
+            if kind == "done":
+                return finish(0, per_rank=info)
+            if kind == "grow":
+                n_back = info
+                self.log(f"[launch] elastic: {n_back} lost slot(s) "
+                         f"rejoining; draining gang of {size} to grow to "
+                         f"{size + n_back}")
+                self._terminate_all(grace_s=cfg.drain_grace_s)
+                events.append({"gen": self._gen, "kind": "grow",
+                               "from_size": size, "to_size": size + n_back,
+                               "reason": "rejoin", "rank": None})
+                size += n_back
+                del lost_at[:n_back]
+                self._gen += 1
+                continue
+            # kind == "lost": a worker died / hung / requested a resize.
+            # Shrink by exactly the ONE lost slot: survivors may be mid-
+            # collective with the dead peer and exit messily during the
+            # drain (a broken psum is a symptom, not a second loss) —
+            # every slot respawns fresh at the new world size anyway.
+            rank, code, reason = info
+            injected += int(code == FAULT_EXIT_CODE)
+            new_size = size - 1
+            shrinks = sum(1 for e in events if e["kind"] == "shrink")
+            if shrinks >= cfg.max_resizes:
+                self.log(f"[launch] elastic: rank {rank} lost ({reason}) "
+                         f"after {shrinks} shrinks — resize budget "
+                         f"max_resizes={cfg.max_resizes} exhausted; "
+                         f"terminating gang")
+                self._terminate_all(grace_s=cfg.drain_grace_s)
+                return finish(code or 1, failed_rank=rank)
+            if new_size < cfg.min_workers:
+                self.log(f"[launch] elastic: rank {rank} lost ({reason}) "
+                         f"leaves {new_size} < min_nodes="
+                         f"{cfg.min_workers}; terminating gang")
+                self._terminate_all(grace_s=cfg.drain_grace_s)
+                return finish(code or 1, failed_rank=rank)
+            self.log(f"[launch] elastic: rank {rank} lost ({reason}); "
+                     f"draining survivors and resharding to world size "
+                     f"{new_size}")
+            self._terminate_all(grace_s=cfg.drain_grace_s)
+            events.append({"gen": self._gen, "kind": "shrink",
+                           "from_size": size, "to_size": new_size,
+                           "reason": reason, "rank": rank})
+            lost_at.append(time.monotonic())
+            size = new_size
+            self._gen += 1
+
+    def _monitor_elastic(self, run_dir: str, size: int,
+                         lost_at: list[float]):
+        """Supervise one elastic generation.  Returns one of
+        ("done", per_rank), ("lost", (rank, code, reason)), or
+        ("grow", n_slots_rejoining)."""
+        cfg = self.elastic
+        gen_start_step: dict[int, int] = {}   # rank -> first hb step seen
+        last_step: dict[int, int] = {}
+        while True:
+            per_rank: dict[int, int] = {}
+            running = []
+            for rank, p in self._procs.items():
+                code = p.poll()
+                per_rank[rank] = code
+                if code is None:
+                    running.append(rank)
+                elif code == ELASTIC_RESIZE_EXIT_CODE:
+                    self.log(f"[launch] rank {rank} requested a gang "
+                             f"resize (exit {code})")
+                    return "lost", (rank, 0, "requested")
+                elif code not in (0,):
+                    kind = ("injected fault" if code == FAULT_EXIT_CODE
+                            else "failure")
+                    self.log(f"[launch] rank {rank} FAILED with exit code "
+                             f"{code} ({kind})")
+                    return "lost", (rank, code, kind)
+            if not running:
+                return "done", per_rank
+            # heartbeat staleness: only ranks that have beaten in THIS
+            # generation are eligible (a cold compile never beats and
+            # must not be misread as a hang)
+            beats = self._heartbeats(run_dir)
+            for rank in running:
+                hb = beats.get(rank)
+                if hb is None or hb["gen"] != self._gen:
+                    continue
+                gen_start_step.setdefault(rank, hb["step"])
+                last_step[rank] = hb["step"]
+                if hb["age_s"] > cfg.heartbeat_timeout_s:
+                    self.log(f"[launch] rank {rank} heartbeat stale "
+                             f"({hb['age_s']:.1f}s > "
+                             f"{cfg.heartbeat_timeout_s}s); killing hung "
+                             f"worker")
+                    try:
+                        self._procs[rank].kill()
+                    except OSError:
+                        pass
+                    self._procs[rank].wait()
+                    return "lost", (rank, 1, "heartbeat")
+            # grow back: lost slots past the rejoin delay, once every
+            # live rank's heartbeat advanced grow_after_steps in-gen
+            if size < cfg.max_workers and lost_at:
+                now = time.monotonic()
+                eligible = sum(1 for t in lost_at
+                               if now - t >= cfg.rejoin_delay_s)
+                eligible = min(eligible, cfg.max_workers - size)
+                # every still-RUNNING rank must have beaten this gen and
+                # advanced enough (ranks that finished and exited 0 no
+                # longer gate growth; a rank still compiling does)
+                advanced = bool(running) and all(
+                    r in last_step
+                    and last_step[r] - gen_start_step[r]
+                    >= cfg.grow_after_steps
+                    for r in running)
+                if eligible > 0 and advanced:
+                    return "grow", eligible
+            time.sleep(self.monitor_interval_s)
+
     # -- gang orchestration -------------------------------------------------
     def _rpc_coord(self, msg: dict, timeout: float) -> dict:
         return _rpc(self.master_addr, self.agent_port, msg, timeout)
@@ -388,11 +711,19 @@ class LocalAgent:
 
         Single node: plain supervise-and-restart.  Multi node: every
         (re)start passes a coordinator barrier per generation, so all nodes
-        always run the same generation (see module docstring).
+        always run the same generation (see module docstring).  Elastic
+        mode (an ``ElasticConfig``): resize instead of restart — worker
+        loss within [min, max] shrinks the gang at a drain boundary; the
+        lost slot growing back is the same machinery in reverse.
         """
-        if self.nnodes == 1:
-            return self._run_local()
-        return self._run_coordinated()
+        if self.elastic is not None:
+            result = self._run_elastic()
+        elif self.nnodes == 1:
+            result = self._run_local()
+        else:
+            result = self._run_coordinated()
+        result.drain = dict(self._drain_stats)
+        return result
 
     def _run_local(self) -> GangResult:
         attempt = 0
@@ -500,6 +831,45 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--agent-port", type=int, default=None,
                    help="coordinator port for multi-node restarts "
                         "(default master_port+1; node 0 hosts)")
+    # elastic resize (round 12): detect worker loss, shrink the gang at a
+    # drain boundary, reshard from checkpoint, keep training; grow back
+    # when the slot rejoins.
+    p.add_argument("--elastic", action="store_true",
+                   help="resize instead of restart: a worker loss within "
+                        "[--min-nodes, --max-nodes] drains the survivors "
+                        "at a sync point and re-rendezvouses one smaller "
+                        "(resuming from the resharded checkpoint); the "
+                        "gang grows back when the slot rejoins")
+    p.add_argument("--min-nodes", type=int, default=1,
+                   help="elastic: smallest world size worth training at "
+                        "(fewer survivors fails the gang)")
+    p.add_argument("--max-nodes", type=int, default=None,
+                   help="elastic: largest world size (default "
+                        "--nproc-per-node); the gang starts here and "
+                        "grows back to it")
+    p.add_argument("--heartbeat-timeout", type=float, default=300.0,
+                   help="elastic: a worker whose newest heartbeat is "
+                        "older than this is a HUNG straggler — killed "
+                        "and treated as lost (workers that never beat "
+                        "are judged by PID only)")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="elastic: seconds survivors get to reach a sync "
+                        "point and flush their checkpoint on SIGTERM "
+                        "before SIGKILL")
+    p.add_argument("--rejoin-delay", type=float, default=0.0,
+                   help="elastic: seconds after a loss before the slot "
+                        "is respawn-eligible (grow-back)")
+    p.add_argument("--grow-after-steps", type=int, default=1,
+                   help="elastic: grow back only after every live "
+                        "worker's heartbeat advanced this many steps in "
+                        "the shrunk generation")
+    p.add_argument("--max-resizes", type=int, default=16,
+                   help="elastic: total shrinks the run may absorb "
+                        "before the gang is declared failed (grow-backs "
+                        "are free) — bounds the shrink/grow oscillation "
+                        "a deterministically-crashing slot would "
+                        "otherwise drive forever; replaces "
+                        "--max-restarts, which elastic mode ignores")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command: a script path or '-m module', "
                         "optionally preceded by '--'")
@@ -513,21 +883,58 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         build_parser().error("no worker command given")
-    agent = LocalAgent(
-        cmd,
-        nnodes=args.nnodes,
-        node_rank=args.node_rank,
-        nproc_per_node=args.nproc_per_node,
-        master_addr=args.master_addr,
-        master_port=args.master_port,
-        max_restarts=args.max_restarts,
-        monitor_interval_s=args.monitor_interval,
-        agent_port=args.agent_port,
-    )
+    elastic = None
+    if args.elastic:
+        max_workers = (args.max_nodes if args.max_nodes is not None
+                       else args.nproc_per_node)
+        if (args.max_nodes is not None and args.nproc_per_node != 1
+                and args.nproc_per_node != args.max_nodes):
+            build_parser().error(
+                f"--elastic: --nproc-per-node {args.nproc_per_node} "
+                f"conflicts with --max-nodes {args.max_nodes} (the gang "
+                f"starts at max-nodes workers; set one, not both)")
+        try:
+            elastic = ElasticConfig(
+                min_workers=args.min_nodes,
+                max_workers=max_workers,
+                heartbeat_timeout_s=args.heartbeat_timeout,
+                drain_grace_s=args.drain_grace,
+                rejoin_delay_s=args.rejoin_delay,
+                grow_after_steps=args.grow_after_steps,
+                max_resizes=args.max_resizes,
+            )
+        except ValueError as e:
+            build_parser().error(str(e))
+        args.nproc_per_node = max_workers
+    elif args.max_nodes is not None or args.min_nodes != 1:
+        build_parser().error(
+            "--min-nodes/--max-nodes configure elastic resize; pass "
+            "--elastic (or drop the bounds)")
+    try:
+        agent = LocalAgent(
+            cmd,
+            nnodes=args.nnodes,
+            node_rank=args.node_rank,
+            nproc_per_node=args.nproc_per_node,
+            master_addr=args.master_addr,
+            master_port=args.master_port,
+            max_restarts=args.max_restarts,
+            monitor_interval_s=args.monitor_interval,
+            agent_port=args.agent_port,
+            elastic=elastic,
+        )
+    except ValueError as e:  # e.g. --elastic with --nnodes > 1
+        build_parser().error(str(e))
     # A scheduler's SIGTERM must tear down the gang, not orphan it; raising
     # SystemExit routes through run()'s BaseException cleanup.
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     result = agent.run()
+    for ev in result.resize_events:
+        print(f"[launch] resize: gen {ev['gen']} {ev['kind']} "
+              f"{ev['from_size']} -> {ev['to_size']} ({ev['reason']})",
+              flush=True)
+    if result.drain:
+        print(f"[launch] drain outcome: {result.drain}", flush=True)
     if result.returncode != 0:
         print(f"[launch] gang failed: rank {result.failed_rank} exit "
               f"{result.returncode} after {result.restarts_used} restarts",

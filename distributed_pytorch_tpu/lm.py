@@ -1874,6 +1874,90 @@ class LMTrainer:
         self._multi_fn = None
         return self.cfg.grad_clip
 
+    # -- elastic resize (round 12) ----------------------------------------
+    def rebuild(self, mesh: Mesh | None = None, **overrides) -> None:
+        """Re-create the compiled step at a NEW parallel degree, carrying
+        the live training state across — the in-process half of the
+        elastic gang (parallel/elastic.py).  ``overrides`` are
+        ``LMTrainConfig`` field replacements (typically ``dp=...`` and
+        ``fsdp=...`` after the fleet shrank or grew); the mesh rebuilds
+        from the new config unless supplied.  Params and optimizer state
+        are resharded onto the new layout (host-fetched owned copies,
+        then placed by the new ``param_specs`` — restoring a checkpoint
+        through ``load_resharded`` afterwards is the elastic resume
+        path, see ``reshard_from_checkpoint``); the sync-state carry
+        re-initializes (safe to drop); compiled step/eval functions are
+        discarded; the step counter survives.
+
+        Pipeline meshes refuse: pp/pp_size stage placement is baked into
+        the hand-emitted step, so a pipelined gang resizes by relaunch,
+        not rebuild (the lm_cli --elastic refusal mirrors this).
+        Single-controller only — a multi-process gang resizes via the
+        elastic agent's drain + re-rendezvous."""
+        if jax.process_count() > 1:
+            raise ValueError(
+                "in-process rebuild is single-controller; multi-process "
+                "gangs resize via the elastic agent's drain + "
+                "re-rendezvous (launch.py --elastic)")
+        import dataclasses
+        cfg = (dataclasses.replace(self.cfg, **overrides) if overrides
+               else self.cfg)
+        if cfg.pp > 1 or cfg.pp_size > 0:
+            raise ValueError(
+                "cannot resize a pipeline (pp/pp_size) config for now: "
+                "stage placement is baked into the hand-emitted step — "
+                "relaunch at the new size instead")
+        validate_lm_cfg(cfg)
+        new_mesh = mesh if mesh is not None else make_lm_mesh(cfg)
+        want = cfg.dp * cfg.ep * cfg.sp * cfg.tp
+        if new_mesh.devices.size != want:
+            raise ValueError(
+                f"resized mesh has {new_mesh.devices.size} devices, "
+                f"config wants {want}")
+        from .utils.checkpoint import _fetch  # owned copies (donation)
+
+        params_host = jax.tree.map(_fetch, self.params)
+        opt_host = jax.tree.map(
+            lambda x: _fetch(x) if isinstance(x, jax.Array) else x,
+            self.opt_state)
+        self.cfg = cfg
+        self.mesh = new_mesh
+        self._batch_spec = _lm_batch_spec(cfg)
+        specs = param_specs(cfg)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(new_mesh, s)),
+            params_host, specs)
+        # target opt-state shardings come from re-initializing on the
+        # resharded params (exactly the __init__ recipe, including the
+        # single-device -> replicated normalization); the live VALUES
+        # then re-place onto those shardings leaf by leaf
+        tx = make_optimizer(cfg)
+        rep = NamedSharding(new_mesh, P())
+        target = jax.tree.map(
+            lambda leaf: (jax.device_put(leaf, rep)
+                          if isinstance(leaf, jax.Array)
+                          and len(leaf.sharding.device_set) == 1
+                          and new_mesh.devices.size > 1 else leaf),
+            jax.jit(tx.init)(self.params))
+        self.opt_state = jax.tree.map(
+            lambda old, tgt: (jax.device_put(np.asarray(old), tgt.sharding)
+                              if isinstance(tgt, jax.Array) else old),
+            opt_host, target)
+        self.step_fn = make_lm_train_step(cfg, new_mesh)
+        self.sync_state = None
+        if cfg.dcn_compress is not None:
+            n_dev = new_mesh.devices.size
+            self.sync_state = jax.device_put(
+                jnp.zeros((n_dev, lm_sync_state_len(cfg, new_mesh)),
+                          jnp.float32),
+                NamedSharding(new_mesh, P(tuple(new_mesh.axis_names))))
+        self._eval_fn = None
+        self._multi_fn = None
+        self.last_ok = None
+        # a cached checkpointer keeps working (directory-keyed), but the
+        # next restore must re-template against the new shardings — which
+        # maybe_restore does by passing the live (resharded) trees
+
     def evaluate(self, batches) -> dict[str, float]:
         """Held-out loss/perplexity over an iterable of (tokens, targets).
 
@@ -1942,7 +2026,14 @@ class LMTrainer:
         resume from (0 = fresh).  The format (whole-tree npz vs per-shard
         directory) is auto-detected, so resume works regardless of which
         saver wrote it.  The full checkpoint meta (including any
-        ``extra_meta`` recorded at save) lands in ``self.restored_meta``."""
+        ``extra_meta`` recorded at save) lands in ``self.restored_meta``.
+
+        Per-shard checkpoints restore through ``load_resharded`` (round
+        12): a layout that matches the save still moves only its own
+        shard's bytes, and a DIFFERENT topology (the elastic-resize case
+        — the gang shrank or grew since the save) is mapped saved-shard
+        -> new-mesh per leaf without any host materializing a full
+        array.  Values are bitwise-identical either way (test-pinned)."""
         from .utils.checkpoint import PyTreeCheckpointer, ShardedCheckpointer
         sh_list = ShardedCheckpointer(directory).list()
         npz_list = PyTreeCheckpointer(directory).list()
@@ -1952,8 +2043,9 @@ class LMTrainer:
         # step (a run that switched formats must not resurrect stale state).
         sharded = bool(sh_list) and (
             not npz_list or sh_list[-1][0] >= npz_list[-1][0])
-        got = self._checkpointer(directory, sharded).restore(
-            {"params": self.params, "opt": self.opt_state})
+        ckptr = self._checkpointer(directory, sharded)
+        load = ckptr.load_resharded if sharded else ckptr.restore
+        got = load({"params": self.params, "opt": self.opt_state})
         if got is None:
             return 0
         trees, meta = got

@@ -113,6 +113,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: cached/calibrated for this topology)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
+    # elastic gang membership (round 12; launch.py --elastic is the agent
+    # side): the worker publishes heartbeats and honors drain sync points.
+    p.add_argument("--elastic", action="store_true",
+                   help="run as an elastic-gang member (launch.py "
+                        "--elastic agent): publish per-step heartbeats, "
+                        "and on the agent's drain signal exit the step "
+                        "loop at a SYNC POINT — flush a checkpoint and "
+                        "leave with the drain exit code so the resized "
+                        "gang resumes resharded (requires "
+                        "--checkpoint-dir; refuses pipeline configs, "
+                        "which cannot resize for now)")
+    p.add_argument("--min-nodes", type=int, default=1,
+                   help="elastic: smallest world size this config can "
+                        "train at (validation/visibility; the agent "
+                        "enforces the bound)")
+    p.add_argument("--max-nodes", type=int, default=None,
+                   help="elastic: largest world size (default: the "
+                        "launch world size)")
     p.add_argument("--overlap", action="store_true",
                    help="stream the step's bulk communication through the "
                         "layer-group boundaries: per-group ZeRO-3 weight "
@@ -199,6 +217,28 @@ def main(argv: list[str] | None = None) -> int:
     if args.mmap_corpus and not args.corpus:
         parser.error("--mmap-corpus requires --corpus (the synthetic "
                      "fallback is generated in RAM)")
+    if args.elastic:
+        # refuse loudly anything that CANNOT resize: a pipeline's stage
+        # placement is baked into the hand-emitted step, so a resized
+        # world has no program to resume into (LMTrainer.rebuild refuses
+        # for the same reason)
+        if args.pp_size > 1 or args.pp > 1:
+            parser.error(
+                "--elastic cannot resize pipeline configs (--pp/--pp-size "
+                "> 1): stage placement is baked into the compiled step; "
+                "drop the pipeline axis or --elastic")
+        if not args.checkpoint_dir:
+            parser.error(
+                "--elastic requires --checkpoint-dir: the drain sync "
+                "point must flush a checkpoint for the resized gang to "
+                "resume from")
+        if args.min_nodes < 1 or (args.max_nodes is not None
+                                  and args.max_nodes < args.min_nodes):
+            parser.error("--min-nodes/--max-nodes must satisfy "
+                         "1 <= min <= max")
+    elif args.min_nodes != 1 or args.max_nodes is not None:
+        parser.error("--min-nodes/--max-nodes configure --elastic; pass "
+                     "it (or drop the bounds)")
     if args.rendezvous == "env":
         dist_init.init_from_env()
     else:
@@ -219,6 +259,22 @@ def main(argv: list[str] | None = None) -> int:
         dcn_compress=args.dcn_compress, bucket_mb=args.bucket_mb,
         sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
     trainer = LMTrainer(cfg)
+    heartbeat = drain_guard = None
+    if args.elastic:
+        # elastic membership: install the drain handler EARLY (a SIGTERM
+        # before the first sync point must still be honored there) and
+        # publish heartbeats when an elastic agent launched us (the
+        # ELASTIC_DIR contract); standalone --elastic runs still get the
+        # graceful drain-with-checkpoint on SIGTERM.
+        from .parallel import elastic as elastic_mod
+        drain_guard = elastic_mod.DrainGuard().install()
+        ectx = elastic_mod.ElasticContext.from_env()
+        if ectx is not None:
+            heartbeat = elastic_mod.Heartbeat(
+                ectx.run_dir, ectx.rank, ectx.generation)
+            log.info("elastic member: rank %d/%d gen %d bounds [%d, %d]",
+                     ectx.rank, ectx.world_size, ectx.generation,
+                     ectx.min_nodes, ectx.max_nodes)
     log.info("model: %s | mesh: dp=%d (dcn=%d) ep=%d sp=%d tp=%d pp=%d "
              "pp_size=%d over %d devices",
              cfg.model, args.dp, args.dcn_size, args.ep, args.sp, args.tp,
@@ -264,7 +320,11 @@ def main(argv: list[str] | None = None) -> int:
     loader = lm_corpus.LMDataLoader(
         corpus, args.batch_size // procs, args.seq_len,
         num_replicas=procs, rank=jax.process_index(), seed=args.seed,
-        shuffle_mode=shuffle_mode)
+        shuffle_mode=shuffle_mode,
+        # elastic: world-size-independent global order, so the recorded
+        # (epoch, offset) resumes losslessly after a resize re-strides
+        # the loader at the new world size
+        elastic_order=args.elastic)
     if len(loader) == 0:
         raise SystemExit(
             f"corpus yields 0 batches: {loader.per_rank} windows/process "
@@ -297,6 +357,24 @@ def main(argv: list[str] | None = None) -> int:
         for i, (tokens, targets) in enumerate(loader):
             if i < skip:
                 continue
+            if heartbeat is not None:
+                heartbeat.beat(step)
+            if drain_guard is not None and drain_guard.sync():
+                # the agent asked for a drain: every rank agreed on THIS
+                # boundary (DrainGuard.sync is a collective), so the
+                # checkpoint fetch below is deadlock-free; the resized
+                # gang resumes from it, resharded
+                pos = {"epoch": epoch, "offset": i,
+                       "steps_per_epoch": steps_per_epoch}
+                from .parallel import elastic as elastic_mod
+                log.info("drain requested: flushing checkpoint at step "
+                         "%d and leaving at the sync point", step)
+                elastic_mod.drain_exit(lambda: (
+                    trainer.save_checkpoint(
+                        args.checkpoint_dir,
+                        extra_meta={"loader": pos},
+                        sharded=args.checkpoint_sharded),
+                    trainer.flush_checkpoints()))
             if args.profile_dir and step == start + 1:
                 jax.profiler.start_trace(args.profile_dir)
                 tracing = True

@@ -1,0 +1,203 @@
+"""Elastic gang, worker side: heartbeats, drain sync points, resize.
+
+launch.py's elastic agent (round 12) turns a worker loss into a reshard
+instead of a dead job; this module is the half that runs INSIDE the
+workers.  Three pieces:
+
+- **Heartbeat** — each worker publishes ``hb_rank<R>.json`` into the
+  agent's ``ELASTIC_DIR`` once per step (atomic tmp+rename, so the agent
+  never reads a torn file).  The agent's liveness check reads the file's
+  age: a HUNG straggler (stuck collective, wedged host thread) goes
+  stale and is detected even though its PID is alive — the upgrade over
+  PR 1's dead-PID-only detection.
+
+- **DrainGuard** — converts the agent's SIGTERM into "exit the step
+  loop at the next SYNC POINT".  The subtlety is agreement: ranks
+  observe the signal skewed by up to a step, and a rank that drains
+  (its checkpoint fetch is a collective) while a peer proceeds into the
+  next step's collectives deadlocks both.  ``sync()`` therefore
+  all-gathers the local flag across processes every step and drains on
+  the MAX — every rank leaves at the same boundary, signal skew
+  notwithstanding.  After the flush the worker exits
+  ``ELASTIC_DRAIN_EXIT_CODE``; the agent counts it as a graceful drain
+  and re-rendezvouses the gang at the new world size.
+
+- **reshard_from_checkpoint** — the in-process resize leg: rebuild the
+  trainer's mesh/compiled step at a new parallel degree
+  (``LMTrainer.rebuild``) and restore the last-good checkpoint through
+  ``ShardedCheckpointer.load_resharded``, which maps the SAVED shard
+  layout onto the NEW mesh per leaf without any host materializing a
+  full array (the memory-efficient array-redistribution recipe of
+  arXiv 2112.01075).  The gang path gets the same effect across
+  processes: drained workers re-exec their init at the new WORLD_SIZE
+  and restore through the same resharding loader.
+
+What the gang may tolerate versus what must stay synchronous follows
+BAGUA's system-relaxation framing (arXiv 2107.01499): membership and
+data assignment may relax between sync points (this module); the
+optimizer step itself stays fully synchronous — bounded-staleness
+relaxations are the carried-forward half (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+# The jax-free agent side owns the constants (launch.py imports nothing
+# from this package's jax-importing modules); importing them here means
+# the two halves can never drift.
+from ..launch import (  # noqa: F401  (re-exported for workers)
+    ELASTIC_DIR_ENV,
+    ELASTIC_DRAIN_EXIT_CODE,
+    ELASTIC_MAX_ENV,
+    ELASTIC_MIN_ENV,
+    ELASTIC_RESIZE_EXIT_CODE,
+    HEARTBEAT_PREFIX,
+)
+
+
+@dataclass
+class ElasticContext:
+    """The elastic env contract as one object (None fields when the
+    worker was not launched by an elastic agent)."""
+
+    run_dir: str
+    rank: int
+    world_size: int
+    generation: int
+    min_nodes: int
+    max_nodes: int
+
+    @classmethod
+    def from_env(cls) -> "ElasticContext | None":
+        run_dir = os.environ.get(ELASTIC_DIR_ENV)
+        if not run_dir:
+            return None
+        return cls(
+            run_dir=run_dir,
+            rank=int(os.environ.get("RANK", "0")),
+            world_size=int(os.environ.get("WORLD_SIZE", "1")),
+            generation=int(os.environ.get("RESTART_ATTEMPT", "0")),
+            min_nodes=int(os.environ.get(ELASTIC_MIN_ENV, "1")),
+            max_nodes=int(os.environ.get(ELASTIC_MAX_ENV, "1")),
+        )
+
+
+class Heartbeat:
+    """Per-step liveness beacon: ``beat(step)`` atomically publishes
+    {rank, step, gen, time} to ``hb_rank<R>.json``.  ``min_interval_s``
+    rate-limits rewrites for fast step loops (0 = every call); the
+    FIRST beat always lands (the agent keys staleness off beats of the
+    current generation, so silence before the first beat reads as
+    "still compiling", never as "hung")."""
+
+    def __init__(self, run_dir: str, rank: int, generation: int,
+                 *, min_interval_s: float = 0.0):
+        self.run_dir = run_dir
+        self.rank = rank
+        self.generation = generation
+        self.min_interval_s = min_interval_s
+        self._last = 0.0
+        os.makedirs(run_dir, exist_ok=True)
+        self.path = os.path.join(run_dir,
+                                 f"{HEARTBEAT_PREFIX}{rank}.json")
+
+    def beat(self, step: int) -> None:
+        now = time.time()
+        if self._last and now - self._last < self.min_interval_s:
+            return
+        self._last = now
+        tmp = self.path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"rank": self.rank, "step": int(step),
+                           "gen": self.generation, "time": now}, f)
+            os.replace(tmp, self.path)  # atomic: the agent never sees torn
+        except OSError:
+            pass  # a missed beat is a late detection, not a crash
+
+
+class DrainGuard:
+    """SIGTERM -> drain-at-next-sync-point flag, with cross-process
+    agreement.
+
+    ``install()`` chains the previous SIGTERM disposition (a worker that
+    already exits on SIGTERM keeps doing so only if it installed AFTER
+    us; install early).  ``sync()`` is the per-step sync point: it
+    combines the local flag across all jax processes (max over an
+    allgather), so every rank agrees on the SAME drain boundary even
+    though the signal lands skewed — a rank draining mid-collective
+    while peers run on would deadlock the gang."""
+
+    def __init__(self):
+        self._requested = False
+        self._installed = False
+
+    def install(self) -> "DrainGuard":
+        signal.signal(signal.SIGTERM, self._handler)
+        self._installed = True
+        return self
+
+    def _handler(self, signum, frame):
+        self._requested = True
+
+    @property
+    def requested(self) -> bool:
+        """The LOCAL flag (no agreement) — for single-process drivers."""
+        return self._requested
+
+    def sync(self) -> bool:
+        """True when ANY process has seen the drain signal: all ranks
+        receive the same answer at the same step boundary, so the whole
+        gang leaves together.  One tiny allgather per step — the price
+        of a deadlock-free drain, paid only in elastic mode."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return self._requested
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([1.0 if self._requested else 0.0], np.float32))
+        return bool(np.max(flags) > 0.0)
+
+
+def drain_exit(save_fn, *, log=print, code: int = ELASTIC_DRAIN_EXIT_CODE):
+    """Flush the last-good state and leave at this sync point: runs
+    ``save_fn`` (the caller's checkpoint-and-flush closure; it may be a
+    collective — every rank calls ``drain_exit`` at the same boundary,
+    that is what ``DrainGuard.sync`` guarantees) and hard-exits with the
+    drain code.  ``os._exit`` on purpose: the distributed teardown of a
+    half-dismantled gang can hang, and the checkpoint is already on
+    disk."""
+    try:
+        save_fn()
+    except Exception as e:  # noqa: BLE001 — the agent's grace covers us
+        if log:
+            log(f"[elastic] drain checkpoint failed ({e}); exiting anyway")
+    if log:
+        log(f"[elastic] drained at sync point (exit {code})", )
+    os._exit(code)
+
+
+def reshard_from_checkpoint(trainer, directory: str, **rebuild_kw) -> int:
+    """In-process resize: rebuild the trainer on a new topology and
+    restore the latest checkpoint RESHARDED onto it.
+
+    ``rebuild_kw`` goes to ``trainer.rebuild`` (e.g. ``dp=2`` /
+    ``mesh=...``); the restore goes through the cross-topology loader
+    (``ShardedCheckpointer.load_resharded`` for per-shard checkpoints —
+    no host materializes more than its target shards plus one in-flight
+    leaf), which ``LMTrainer.maybe_restore`` / ``Checkpointer`` already
+    route.  Returns the step resumed from."""
+    trainer.rebuild(**rebuild_kw)
+    if hasattr(trainer, "maybe_restore"):
+        return trainer.maybe_restore(directory)
+    from ..utils.checkpoint import Checkpointer
+
+    return Checkpointer(directory).maybe_restore(trainer)

@@ -43,6 +43,41 @@ CONNECT_ATTEMPTS = 5     # rendezvous dials before giving up
 BACKOFF_BASE_S = 1.0     # first retry delay (doubles per attempt)
 BACKOFF_CAP_S = 30.0     # ceiling on any single delay
 
+# Env overrides (round 12): long coordinator flaps — e.g. an elastic
+# re-rendezvous racing a slow teardown — need a bigger retry budget than
+# the code default, and operators tuning it must not have to edit code.
+# Both parse ONCE per dial and fail loudly on typos (a silently-ignored
+# budget would surface as an unexplained early give-up mid-incident).
+ATTEMPTS_ENV = "JAX_GRAFT_RDZV_ATTEMPTS"
+BACKOFF_CAP_ENV = "JAX_GRAFT_RDZV_BACKOFF_CAP_S"
+
+
+def _env_positive(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        val = cast(raw)
+    except ValueError:
+        val = None
+    if val is None or val <= 0:
+        raise ValueError(
+            f"{name} must be a positive {cast.__name__}, got {raw!r}")
+    return val
+
+
+def rdzv_attempts_from_env(default: int = CONNECT_ATTEMPTS) -> int:
+    """The retry budget: JAX_GRAFT_RDZV_ATTEMPTS, else ``default``."""
+    return _env_positive(ATTEMPTS_ENV, default, int)
+
+
+def rdzv_backoff_cap_from_env(default: float = BACKOFF_CAP_S) -> float:
+    """The per-delay ceiling: JAX_GRAFT_RDZV_BACKOFF_CAP_S, else
+    ``default`` — the exponential growth is CAPPED here, so a long flap
+    costs a bounded, predictable wait per retry instead of runaway
+    doubling."""
+    return _env_positive(BACKOFF_CAP_ENV, default, float)
+
 
 class RendezvousError(RuntimeError):
     """Multi-host initialization failed (peer missing / coordinator down)."""
@@ -66,8 +101,9 @@ def init_distributed(
     *,
     port: int = DEFAULT_PORT,
     timeout_s: int | None = DEFAULT_TIMEOUT_S,
-    connect_attempts: int = CONNECT_ATTEMPTS,
+    connect_attempts: int | None = None,
     backoff_base_s: float = BACKOFF_BASE_S,
+    backoff_cap_s: float | None = None,
     _initialize=None,
 ) -> None:
     """Explicit-rendezvous mode (reference main_all_reduce.py:96 contract).
@@ -76,12 +112,19 @@ def init_distributed(
     same entry point serves the single-process baseline (reference main.py).
 
     Transient connection failures retry up to ``connect_attempts`` times
-    with exponential backoff + jitter; ``_initialize`` is a test seam
-    (defaults to ``jax.distributed.initialize``)."""
+    (default: ``JAX_GRAFT_RDZV_ATTEMPTS`` env, else 5) with exponential
+    backoff + jitter, each delay capped at ``backoff_cap_s`` (default:
+    ``JAX_GRAFT_RDZV_BACKOFF_CAP_S`` env, else 30 s — bounded growth on
+    long flaps); ``_initialize`` is a test seam (defaults to
+    ``jax.distributed.initialize``)."""
     if num_nodes <= 1:
         return
     if master_ip is None:
         raise ValueError("--master-ip is required when --num-nodes > 1")
+    if connect_attempts is None:
+        connect_attempts = rdzv_attempts_from_env()
+    if backoff_cap_s is None:
+        backoff_cap_s = rdzv_backoff_cap_from_env()
     coordinator = f"{master_ip}:{port}"
     initialize = _initialize if _initialize is not None else (
         jax.distributed.initialize)
@@ -106,12 +149,18 @@ def init_distributed(
                 process_id=rank,
                 initialization_timeout=max(int(remaining), 1),
             )
+            # attempts-used surfaced in the ONE init log line: a gang
+            # that needed retries should say so without log spelunking
+            print(f"[rendezvous] rank {rank}/{num_nodes}: connected to "
+                  f"{coordinator} after {attempt + 1}/{attempts} "
+                  f"attempt(s)", flush=True)
             return
         except Exception as e:
             last = e
             if attempt + 1 >= attempts:
                 break
-            delay = _backoff_delay(attempt, rank, base_s=backoff_base_s)
+            delay = _backoff_delay(attempt, rank, base_s=backoff_base_s,
+                                   cap_s=backoff_cap_s)
             print(f"[rendezvous] rank {rank}: attempt {attempt + 1}/"
                   f"{attempts} to {coordinator} failed ({e}); "
                   f"retrying in {delay:.2f}s", flush=True)

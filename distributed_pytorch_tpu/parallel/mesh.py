@@ -41,6 +41,28 @@ def make_mesh(
     return Mesh(dev_array, axis_names)
 
 
+def resize_mesh(mesh: Mesh, num_devices: int,
+                devices: list[jax.Device] | None = None) -> Mesh:
+    """Rebuild ``mesh`` over ``num_devices`` devices, keeping its axis
+    names and every INNER axis extent (elastic resize, round 12): the
+    leading axis absorbs the size change — the data/dcn axis is the one
+    that shrinks when the gang loses a member and grows back when it
+    rejoins.  ``num_devices`` must be divisible by the inner-axes
+    product (you cannot shrink a dpxtp mesh below its tp extent)."""
+    inner = int(np.prod(mesh.devices.shape[1:])) or 1
+    if num_devices % inner:
+        raise ValueError(
+            f"cannot resize mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+            f"to {num_devices} devices: inner axes fix a multiple of "
+            f"{inner}")
+    return make_mesh(
+        num_devices,
+        axis_names=tuple(mesh.axis_names),
+        axis_shape=(num_devices // inner,) + tuple(mesh.devices.shape[1:]),
+        devices=devices,
+    )
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a global batch: leading dim split over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))

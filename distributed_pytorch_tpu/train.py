@@ -691,12 +691,15 @@ class Trainer:
             self.check_consistency()
         return losses
 
-    def train_epoch(self, loaders, epoch: int, *, log=print):
+    def train_epoch(self, loaders, epoch: int, *, log=print, on_step=None):
         """One epoch over per-replica loaders, with the reference's metric
         windows (loss/20 iters, time/40 iters excl. iter 0 — SURVEY.md 2.3).
 
         ``loaders``: one DataLoader per replica (the global batch is their
         concatenation), or a single loader for the single-process baseline.
+        ``on_step(step)`` fires once per device dispatch (before compile) —
+        the elastic CLI's heartbeat cadence, so a long epoch cannot be
+        misread as a hung worker (launch.py heartbeat staleness).
         """
         if not isinstance(loaders, (list, tuple)):
             loaders = [loaders]
@@ -752,6 +755,8 @@ class Trainer:
 
         batch_idx = 0
         for k, images, labels in pipeline.prefetch(staged(), depth=2):
+            if on_step is not None:
+                on_step(self._step)
             # Compile outside the timed window: the reference's metric
             # excludes warm-up (iter 0, main.py:43-48); with a K-step scan
             # the compile would otherwise smear across K counted iters.
@@ -767,6 +772,92 @@ class Trainer:
 
     def eval_state(self) -> PyTree:
         return rank0_state(self.state, self.mesh)
+
+    # -- elastic resize (round 12) ----------------------------------------
+    def rebuild(self, mesh: Mesh | None = None,
+                num_devices: int | None = None) -> None:
+        """Re-create the compiled step on a NEW mesh, carrying the live
+        training state across — the in-process half of the elastic gang
+        (parallel/elastic.py): when the fleet shrinks or grows, the step
+        is re-built rather than the whole process.
+
+        Params/optimizer state are replicated, so they re-place exactly;
+        replica-stacked BN state takes rank 0's stats re-stacked to the
+        new replica count (the same convention as the cross-topology
+        ``Checkpointer.maybe_restore``, so a rebuilt trainer and a fresh
+        one restored from the last checkpoint continue BITWISE-equal —
+        test-pinned); the EF sync residual re-initializes (dropping it
+        is safe — residuals re-accumulate within one step).  Compiled
+        executables are discarded; the step counter survives.
+
+        Single-controller only: a multi-process gang resizes by drain +
+        re-rendezvous (the worker re-runs init at the new WORLD_SIZE),
+        not by in-process rebuild."""
+        if jax.process_count() > 1:
+            raise ValueError(
+                "in-process rebuild is single-controller; multi-process "
+                "gangs resize via the elastic agent's drain + "
+                "re-rendezvous (launch.py --elastic)")
+        if not self.strategy.needs_mesh:
+            raise ValueError(
+                f"strategy {self.strategy.name!r} runs without a mesh; "
+                f"there is no topology to resize")
+        if mesh is None:
+            if isinstance(self.data_axes, tuple):
+                n = num_devices or len(jax.devices())
+                if n % self.cfg.dcn_size:
+                    raise ValueError(
+                        f"dcn_size {self.cfg.dcn_size} must divide the "
+                        f"resized {n}-device fleet")
+                mesh = make_mesh(n, axis_names=self.data_axes,
+                                 axis_shape=(self.cfg.dcn_size,
+                                             n // self.cfg.dcn_size))
+            else:
+                mesh = make_mesh(num_devices)
+        if isinstance(self.data_axes, tuple):
+            if tuple(mesh.axis_names) != self.data_axes:
+                raise ValueError(
+                    f"strategy {self.strategy.name!r} needs a mesh with "
+                    f"axes {self.data_axes}, got {mesh.axis_names}")
+            # same extent check as __init__: the EF residual layout and
+            # bench accounting are sized from cfg.dcn_size, and a
+            # mismatched caller-supplied mesh would only surface as a
+            # cryptic reshape at trace time
+            dcn_axis = self.data_axes[0]
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes[dcn_axis] != self.cfg.dcn_size:
+                raise ValueError(
+                    f"resized mesh {dcn_axis!r} axis has size "
+                    f"{sizes[dcn_axis]} but cfg.dcn_size is "
+                    f"{self.cfg.dcn_size}; pass a matching mesh (or "
+                    f"mesh=None to build one)")
+        from .utils.checkpoint import _fetch  # owned copies (donation)
+
+        params_host = jax.tree.map(_fetch, self.params)
+        opt_host = jax.tree.map(_fetch, self.opt_state)
+        state0 = rank0_state(self.state, self.mesh)  # rank-0 authoritative
+
+        self.mesh = mesh
+        self.n_replicas = mesh.devices.size
+        rep = replicated(mesh)
+        shd = NamedSharding(mesh, P(self.data_axes))
+        self.params = jax.device_put(params_host, rep)
+        self.opt_state = jax.device_put(opt_host, rep)
+        self.state = jax.device_put(
+            replicate_state(jax.tree.map(jnp.asarray, state0),
+                            self.n_replicas), shd)
+        if getattr(self.strategy, "stateful", False):
+            sync_state = self.strategy.init_state(params_host,
+                                                  self.n_replicas)
+        else:
+            sync_state = jnp.zeros((0,), jnp.float32)
+        self.sync_state = jax.device_put(
+            jnp.broadcast_to(sync_state[None],
+                             (self.n_replicas,) + sync_state.shape), shd)
+        self._multi_fn = None
+        self._compiled = {}
+        self._unverified_exes = set()
+        self.last_ok = None
 
     def check_consistency(self) -> None:
         """Verify the DP invariants (utils/debug.py): params and optimizer

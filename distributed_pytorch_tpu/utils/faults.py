@@ -126,9 +126,15 @@ def _rank_live(plan: FaultPlan) -> bool:
     if plan.rank < 0:
         return True
     try:
-        return jax.process_index() == plan.rank
+        if jax.process_count() > 1:
+            return jax.process_index() == plan.rank
     except RuntimeError:  # pragma: no cover - uninitialized backend
-        return plan.rank == 0
+        pass
+    # Gangs of SINGLE-process-jax members (the elastic CPU simulation:
+    # every worker is jax process 0 of its own world): the gang rank is
+    # the launcher's env contract, not the jax process index.  Outside
+    # any gang RANK is unset and this degrades to the old `rank == 0`.
+    return int(os.environ.get("RANK", "0")) == plan.rank
 
 
 def armed(kind: str) -> FaultPlan | None:

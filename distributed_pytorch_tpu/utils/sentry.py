@@ -21,9 +21,17 @@ the backward):
 3. **Escalate** — triggers inside one recovery horizon climb a ladder:
    skip the window (level <= ``skip_budget``); then also tighten the
    gradient clip via the trainer's ``tighten_grad_clip`` hook (LM
-   trainer) by ``clip_factor`` per level; past ``max_rollbacks``, abort
-   with a full diagnostic (``SentryAbort``).  ``checkpoint_every`` clean
-   steps reset the ladder — recovery that holds is recovery.
+   trainer) by ``clip_factor`` per level; past ``max_rollbacks``, a NEW
+   rung (round 12) sits between rollback-and-skip and abort: with an
+   ``on_resize`` hook installed, the sentry rolls back to last-good ONCE
+   more and requests a GANG RESIZE — in a gang worker the hook
+   checkpoints and exits ``ELASTIC_RESIZE_EXIT_CODE`` so the elastic
+   agent re-rendezvouses the gang one smaller (parallel/elastic.py); in
+   a single-controller run it may rebuild the trainer on a smaller mesh
+   (``trainer.rebuild``) and return True to continue.  Only past THAT —
+   no hook, or the hook declined — does the sentry abort with a full
+   diagnostic (``SentryAbort``).  ``checkpoint_every`` clean steps reset
+   the ladder — recovery that holds is recovery.
 
 Event accounting lives in ``self.stats`` (steps, nonfinite, spikes,
 rollbacks, skipped_steps, clip_tightened, stragglers) — the train-stats
@@ -83,9 +91,18 @@ class TrainingSentry:
     """
 
     def __init__(self, trainer, cfg: SentryConfig | None = None, *,
-                 log=print):
+                 on_resize=None, log=print):
         self.trainer = trainer
         self.cfg = cfg or SentryConfig()
+        # the resize escalation rung (round 12): called ONCE per run,
+        # after rollback/skip/clip-tightening all failed but before
+        # aborting — ``on_resize(stats)`` returning truthy means the
+        # resize happened in-process (e.g. trainer.rebuild onto a
+        # smaller mesh) and training continues; a gang worker's hook
+        # checkpoints and exits ELASTIC_RESIZE_EXIT_CODE instead (the
+        # elastic agent then reshards the gang one smaller).
+        self.on_resize = on_resize
+        self._resize_used = False
         self.log = log
         self.detector = SpikeDetector(
             window=self.cfg.spike_window,
@@ -98,7 +115,7 @@ class TrainingSentry:
             min_sigma=1e-4)
         self.stats = dict(steps=0, nonfinite=0, spikes=0, rollbacks=0,
                           skipped_steps=0, clip_tightened=0, stragglers=0,
-                          snapshots=0)
+                          snapshots=0, resizes=0)
         self._ladder = 0
         self._snap = None
         self._snap_step = 0
@@ -191,6 +208,25 @@ class TrainingSentry:
         self.log(f"[sentry] step {self.trainer._step - 1}: {trigger} "
                  f"(loss={loss_val:.6g}); escalation level {self._ladder}")
         if self._ladder > self.cfg.max_rollbacks:
+            # resize rung (round 12): the rollback/skip/clip ladder is
+            # exhausted — before aborting, roll back to last-good once
+            # more and hand the decision to the resize hook (a gang
+            # worker exits ELASTIC_RESIZE_EXIT_CODE from inside it; an
+            # in-process hook rebuilds the trainer and returns True)
+            if self.on_resize is not None and not self._resize_used:
+                self._resize_used = True
+                self.stats["resizes"] += 1
+                rewound = self.rollback()
+                self.stats["skipped_steps"] += rewound
+                self.log(f"[sentry] escalation ladder exhausted at step "
+                         f"{self.trainer._step}: requesting gang RESIZE "
+                         f"(rolled back {rewound} step(s) to last-good)")
+                if self.on_resize(dict(self.stats)):
+                    # resized in-process: the rebuilt trainer's state is
+                    # the new last-good; give recovery a fresh horizon
+                    self._ladder = 0
+                    self.snapshot()
+                    return None
             raise SentryAbort(
                 f"{trigger} at step {self.trainer._step - 1} after "
                 f"{self.stats['rollbacks']} rollbacks — escalation "

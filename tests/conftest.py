@@ -122,3 +122,12 @@ def pytest_configure(config):
         "classes and recovery paths documented in README.md).  Fast chaos "
         "tests ride tier-1 via `-m 'not slow'`; gang-level injections "
         "carry `slow` too and run with the full suite.")
+    config.addinivalue_line(
+        "markers",
+        "elastic: elastic-gang lane (round 12) — `pytest -m elastic` "
+        "runs the resize machinery (tests/test_elastic.py: sampler "
+        "re-keying, cross-topology load_resharded, trainer rebuild, "
+        "sentry resize rung, agent shrink/grow).  Fast tests ride "
+        "tier-1 via `-m 'not slow'`; the gang-level "
+        "kill->shrink->resume->rejoin->grow test carries `slow` too "
+        "and runs with the full suite (wired like the `faults` lane).")

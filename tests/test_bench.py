@@ -264,6 +264,35 @@ def test_bench_strategies_emits_predicted_ms_and_auto_row():
         assert key in src, key
 
 
+def test_bench_elastic_env_knob_fails_loudly():
+    """A typo'd BENCH_ELASTIC must raise before any measurement (the
+    BENCH_KV_DTYPE contract); unset/''/'0' skip cleanly, '1' runs."""
+    assert bench.canon_elastic_env(None) is False
+    assert bench.canon_elastic_env("") is False
+    assert bench.canon_elastic_env("0") is False
+    assert bench.canon_elastic_env("1") is True
+    for bad in ("yes", "true", "2", " 1", "elastic"):
+        with pytest.raises(ValueError, match="BENCH_ELASTIC"):
+            bench.canon_elastic_env(bad)
+
+
+def test_bench_json_keys_include_elastic_gate():
+    """Round-12 schema: the elastic-recovery keys ride the JSON, the
+    knob is canonicalized pre-bench, and the gate's recovery leg goes
+    through the real resize machinery — trainer rebuild + the
+    cross-topology reshard loader — on a SHARDED checkpoint, with a
+    proving step inside the timed window."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("elastic_recovery_ms", "elastic_resize_events"):
+        assert key in src, key
+    assert "canon_elastic_env" in src and "BENCH_ELASTIC" in src
+    esrc = inspect.getsource(bench.bench_elastic)
+    assert "reshard_from_checkpoint" in esrc  # rebuild + load_resharded
+    assert "ShardedCheckpointer" in esrc
+    assert "train_step" in esrc               # the proving step is timed
+
+
 def test_bench_json_keys_include_pp_gate():
     """Round-10 schema: the interleaved-1F1B A/B keys ride the JSON, the
     knobs are canonicalized pre-bench, and the A/B reads its bubble from

@@ -188,3 +188,50 @@ def test_parser_autotune_flags():
     from distributed_pytorch_tpu.lm import LMTrainConfig, validate_lm_cfg
     with pytest.raises(ValueError, match="no DCN hop"):
         validate_lm_cfg(LMTrainConfig(dp=4, dcn_compress="int8"))
+
+
+def test_parser_elastic_flags():
+    """Round-12 surface: --elastic/--min-nodes/--max-nodes reach both
+    CLIs (defaults off so historical invocations are byte-identical),
+    and configs that CANNOT resize refuse loudly at parse/validate time
+    — pipeline axes (pp/pp_size > 1), a missing checkpoint dir (the
+    drain sync point must flush one), bounds without --elastic, and the
+    meshless VGG strategy."""
+    from distributed_pytorch_tpu import lm_cli
+
+    args = cli.build_parser().parse_args([])
+    assert args.elastic is False
+    assert args.min_nodes == 1 and args.max_nodes is None
+    args = cli.build_parser().parse_args(
+        ["--elastic", "--min-nodes", "1", "--max-nodes", "4"])
+    assert args.elastic and args.max_nodes == 4
+
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.elastic is False
+    assert lm_args.min_nodes == 1 and lm_args.max_nodes is None
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--elastic", "--min-nodes", "2", "--max-nodes", "4",
+         "--checkpoint-dir", "/tmp/x"])
+    assert lm_args.elastic and lm_args.min_nodes == 2
+
+    # refusals (argparse SystemExit, before any jax/rendezvous work)
+    with pytest.raises(SystemExit):  # pipeline cannot resize (for now)
+        lm_cli.main(["--elastic", "--checkpoint-dir", "/tmp/x",
+                     "--pp-size", "2", "--microbatches", "4"])
+    with pytest.raises(SystemExit):  # wave-pp either
+        lm_cli.main(["--elastic", "--checkpoint-dir", "/tmp/x",
+                     "--pp", "2"])
+    with pytest.raises(SystemExit):  # no checkpoint dir to drain into
+        lm_cli.main(["--elastic"])
+    with pytest.raises(SystemExit):  # bounds without --elastic
+        lm_cli.main(["--min-nodes", "2"])
+    with pytest.raises(SystemExit):  # min > max
+        lm_cli.main(["--elastic", "--checkpoint-dir", "/tmp/x",
+                     "--min-nodes", "3", "--max-nodes", "2"])
+    with pytest.raises(SystemExit):  # VGG: no checkpoint dir
+        cli.main(["--elastic"])
+    with pytest.raises(SystemExit):  # VGG: nothing to resize
+        cli.main(["--elastic", "--checkpoint-dir", "/tmp/x",
+                  "--strategy", "none"])
+    with pytest.raises(SystemExit):  # VGG: bounds without --elastic
+        cli.main(["--max-nodes", "4"])
