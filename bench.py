@@ -449,6 +449,78 @@ def bench_train_autotune(batch_per_replica: int = 64, iters: int = 30,
             "ms_default": med[False], "plan": plan.summary()}
 
 
+def canon_telemetry_env(value: str | None) -> bool:
+    """Validate the BENCH_TELEMETRY knob: '1' runs the round-13
+    telemetry on/off A/B (CPU overhead of the unified event stream),
+    unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_TELEMETRY", value, default=False,
+        guess="whether to run the telemetry-overhead A/B")
+
+
+def bench_train_telemetry(batch_per_replica: int = 64, iters: int = 30,
+                          reps: int = 5) -> dict:
+    """Telemetry-overhead gate (round 13, BENCH_TELEMETRY=1): the SAME
+    trainer measured with the unified telemetry registry off (the
+    default) and on (streaming JSONL to a throwaway run dir), >=
+    ``reps`` alternating timed windows per mode with median-of-reps —
+    the hardened-window discipline of the other gates.  The compiled
+    program is IDENTICAL in both modes (the per-step scalars ride the
+    in-scan health-flag output; test-pinned), so the delta is pure
+    host-side cost: the registry reads, the JSONL appends, and the
+    per-dispatch metric fetch.  The acceptance bound is <= 2% CPU step
+    overhead (``telemetry_overhead_pct`` in the JSON)."""
+    import tempfile
+
+    import jax
+
+    from distributed_pytorch_tpu.parallel.mesh import make_mesh
+    from distributed_pytorch_tpu.train import TrainConfig, Trainer
+    from distributed_pytorch_tpu.utils import telemetry
+
+    n_dev = len(jax.devices())
+    cfg = TrainConfig(strategy="ddp" if n_dev > 1 else "none",
+                      batch_size=batch_per_replica,
+                      steps_per_loop=iters, compute_dtype="bfloat16")
+    tr = Trainer(cfg, mesh=make_mesh(n_dev) if n_dev > 1 else None)
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+    if tr.mesh is None:
+        images, labels = jax.device_put((images, labels))
+
+    tr.precompile_steps(images, labels)
+    float(tr.train_steps(images, labels)[-1])  # warm outside timed reps
+
+    run_dir = tempfile.mkdtemp(prefix="bench_telemetry_")
+    times: dict[bool, list[float]] = {False: [], True: []}
+    try:
+        for _ in range(reps):
+            for on in (False, True):  # alternate: drift hits both modes
+                if on:
+                    telemetry.enable(run_dir)
+                t0 = time.perf_counter()
+                losses = tr.train_steps(images, labels)
+                float(losses[-1])  # fetch forces the whole donated chain
+                times[on].append((time.perf_counter() - t0) / iters * 1e3)
+                if on:
+                    telemetry.disable()
+    finally:
+        telemetry.disable()
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    overhead_pct = (med[True] / max(med[False], 1e-9) - 1.0) * 100.0
+    n_records = sum(
+        1 for _, recs in telemetry.read_run(run_dir) for _ in recs)
+    _log(f"[bench] telemetry A/B ({cfg.strategy}, VGG-11, {n_dev} dev): "
+         f"{med[True]:.2f} ms/step on vs {med[False]:.2f} off -> "
+         f"{overhead_pct:+.2f}% ({n_records} records, {reps} reps "
+         f"median)")
+    return {"overhead_pct": overhead_pct, "ms_on": med[True],
+            "ms_off": med[False], "records": n_records}
+
+
 def canon_elastic_env(value: str | None) -> bool:
     """Validate the BENCH_ELASTIC knob: '1' runs the round-12 elastic
     shrink->reshard->grow recovery gate, unset/''/'0' skips it."""
@@ -959,6 +1031,9 @@ def main() -> None:
     # Elastic-recovery knob (round 12), validated loudly pre-bench:
     # BENCH_ELASTIC=1 measures the shrink->reshard->grow recovery gap.
     run_elastic = canon_elastic_env(os.environ.get("BENCH_ELASTIC"))
+    # Telemetry-overhead knob (round 13), validated loudly pre-bench:
+    # BENCH_TELEMETRY=1 A/Bs the unified event stream on vs off.
+    run_telemetry = canon_telemetry_env(os.environ.get("BENCH_TELEMETRY"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -1019,6 +1094,16 @@ def main() -> None:
             elastic_ab = bench_elastic()
         except Exception as e:
             _log(f"[bench] elastic gate failed ({e}); omitting")
+
+    # Telemetry-overhead gate (round 13): the unified event stream's
+    # measured CPU step cost (same compiled program both sides);
+    # optional like the other gates.
+    telemetry_ab = None
+    if run_telemetry:
+        try:
+            telemetry_ab = bench_train_telemetry()
+        except Exception as e:
+            _log(f"[bench] telemetry A/B failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -1121,6 +1206,18 @@ def main() -> None:
                                 if elastic_ab is not None else None),
         "elastic_resize_events": (elastic_ab["resize_events"]
                                   if elastic_ab is not None else None),
+        # telemetry-overhead gate (round 13, BENCH_TELEMETRY=1): median
+        # ms/step with the unified event stream on vs off (identical
+        # compiled programs — the delta is host-side registry + JSONL
+        # cost; acceptance bound <= 2%).  Null when the gate is skipped.
+        "telemetry_overhead_pct": (round(telemetry_ab["overhead_pct"], 3)
+                                   if telemetry_ab is not None else None),
+        "train_step_ms_telemetry_on": (round(telemetry_ab["ms_on"], 3)
+                                       if telemetry_ab is not None
+                                       else None),
+        "train_step_ms_telemetry_off": (round(telemetry_ab["ms_off"], 3)
+                                        if telemetry_ab is not None
+                                        else None),
         # transformer-stack gates (BASELINE.md is the prose companion;
         # these keys are the regression source of truth since round 4)
         "lm_tokens_per_sec_per_chip": (round(lm_tps, 1)

@@ -119,6 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "automatically if a checkpoint exists")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the first epoch")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="unified run telemetry (round 13): stream "
+                        "rank-tagged JSONL events (step spans, loss/"
+                        "grad-norm/param-norm gauges, checkpoint IO, "
+                        "sentry escalations) into this run directory; "
+                        "defaults from the launcher-exported "
+                        "TELEMETRY_DIR; off (and free) when neither is "
+                        "set.  Merge/inspect with "
+                        "scripts/telemetry_summary.py")
     p.add_argument("--shard-eval", action="store_true",
                    help="shard the test set over the mesh (psum'd metrics) "
                         "instead of the reference's redundant per-rank "
@@ -215,6 +224,10 @@ def main(argv: list[str] | None = None) -> int:
             port=args.port, timeout_s=args.rendezvous_timeout)
     setup_logging(args.log_level)
     log = get_logger("cli")
+    from .utils import telemetry
+    tel = telemetry.enable_from_cli(args.telemetry_dir)
+    if tel is not None:
+        log.info("telemetry: streaming to %s", tel.run_dir)
     if args.shard_eval and args.batch_size % max(jax.device_count(), 1):
         raise SystemExit(
             f"--shard-eval: --batch-size {args.batch_size} must divide "

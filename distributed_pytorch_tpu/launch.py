@@ -71,6 +71,27 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+# jax-free by design (the agent must never compete with workers for
+# chips): utils/__init__ resolves submodules lazily (PEP 562), and both
+# utils.telemetry and utils.logging import no jax — the agent's gang
+# lifecycle events and structured logs ride the same machinery as the
+# workers' without breaking the process-model contract above.
+from .utils import telemetry
+from .utils.logging import get_logger, setup_logging
+
+
+def _tel_event(name: str, **args) -> None:
+    """Gang lifecycle on the unified timeline (round 13): worker
+    start/exit, heartbeat staleness, drain outcomes, and resize
+    generations land as events in the 'gang' lane when the agent runs
+    with --telemetry-dir; free otherwise.  The agent registers as
+    pid -1 ("agent") in the merged trace; its CURRENT generation rides
+    in args (the registry's gen is per-process, and the agent spans
+    every generation)."""
+    tel = telemetry.active()
+    if tel is not None:
+        tel.event(name, phase="gang", **args)
+
 # Exit code of chaos-harness-injected crashes.  Kept in sync with
 # utils/faults.FAULT_EXIT_CODE rather than imported: faults.py imports
 # jax, and the agent process must stay jax-free (it supervises workers;
@@ -394,6 +415,9 @@ class LocalAgent:
             self._procs[spec.rank] = subprocess.Popen(cmd, env=env)
             self.log(f"[launch] node {self.node_rank}: started rank "
                      f"{spec.rank} (pid {self._procs[spec.rank].pid})")
+            _tel_event("worker_start", rank=spec.rank, gen=self._gen,
+                       pid=self._procs[spec.rank].pid,
+                       world_size=spec.world_size)
 
     def _terminate_all(self, grace_s: float = TERM_GRACE_S) -> dict:
         """Graceful drain: SIGTERM the gang first (workers may reach a
@@ -427,6 +451,8 @@ class LocalAgent:
                 outcome["exited"] += 1
         for k, v in outcome.items():
             self._drain_stats[k] += v
+        if live:
+            _tel_event("gang_drain", gen=self._gen, **outcome)
         return outcome
 
     def _monitor(self, watch_remote: bool = False) -> GangResult:
@@ -450,6 +476,8 @@ class LocalAgent:
                             else "failure")
                     self.log(f"[launch] rank {rank} FAILED with exit code "
                              f"{code} ({kind}); terminating gang")
+                    _tel_event("worker_exit", rank=rank, gen=self._gen,
+                               code=code, kind=kind)
                     self._terminate_all()
                     return GangResult(
                         returncode=code,
@@ -582,6 +610,7 @@ class LocalAgent:
                 events.append({"gen": self._gen, "kind": "grow",
                                "from_size": size, "to_size": size + n_back,
                                "reason": "rejoin", "rank": None})
+                _tel_event("gang_resize", **events[-1])
                 size += n_back
                 del lost_at[:n_back]
                 self._gen += 1
@@ -615,6 +644,7 @@ class LocalAgent:
             events.append({"gen": self._gen, "kind": "shrink",
                            "from_size": size, "to_size": new_size,
                            "reason": reason, "rank": rank})
+            _tel_event("gang_resize", **events[-1])
             lost_at.append(time.monotonic())
             size = new_size
             self._gen += 1
@@ -638,12 +668,16 @@ class LocalAgent:
                 elif code == ELASTIC_RESIZE_EXIT_CODE:
                     self.log(f"[launch] rank {rank} requested a gang "
                              f"resize (exit {code})")
+                    _tel_event("worker_exit", rank=rank, gen=self._gen,
+                               code=code, kind="requested resize")
                     return "lost", (rank, 0, "requested")
                 elif code not in (0,):
                     kind = ("injected fault" if code == FAULT_EXIT_CODE
                             else "failure")
                     self.log(f"[launch] rank {rank} FAILED with exit code "
                              f"{code} ({kind})")
+                    _tel_event("worker_exit", rank=rank, gen=self._gen,
+                               code=code, kind=kind)
                     return "lost", (rank, code, kind)
             if not running:
                 return "done", per_rank
@@ -662,6 +696,9 @@ class LocalAgent:
                              f"({hb['age_s']:.1f}s > "
                              f"{cfg.heartbeat_timeout_s}s); killing hung "
                              f"worker")
+                    _tel_event("heartbeat_stale", rank=rank,
+                               gen=self._gen, age_s=hb["age_s"],
+                               timeout_s=cfg.heartbeat_timeout_s)
                     try:
                         self._procs[rank].kill()
                     except OSError:
@@ -870,6 +907,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "a deterministically-crashing slot would "
                         "otherwise drive forever; replaces "
                         "--max-restarts, which elastic mode ignores")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="unified run telemetry (round 13): the agent "
+                        "logs gang lifecycle events (worker start/exit, "
+                        "heartbeat staleness, drains, resize "
+                        "generations) into this shared run directory "
+                        "and exports it to the workers (TELEMETRY_DIR), "
+                        "so every rank's JSONL stream merges into ONE "
+                        "Chrome trace (scripts/telemetry_summary.py)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command: a script path or '-m module', "
                         "optionally preceded by '--'")
@@ -878,6 +923,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    setup_logging()
+    log = get_logger("launch")
+    if args.telemetry_dir:
+        # the agent's own events (rank -1, "agent" in the merged trace)
+        # plus the worker env contract: every rank's stream lands in the
+        # same run directory, one timeline for the whole gang
+        telemetry.enable(args.telemetry_dir, rank=-1, gen=0,
+                         label="agent")
+        os.environ[telemetry.TELEMETRY_DIR_ENV] = args.telemetry_dir
     cmd = args.cmd
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
@@ -929,16 +983,22 @@ def main(argv: list[str] | None = None) -> int:
     # SystemExit routes through run()'s BaseException cleanup.
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     result = agent.run()
+    # round 13: agent reporting routes through the structured logger
+    # (greppable, timestamped, rank-tagged like everything else) instead
+    # of bare prints; the per-event telemetry already landed live.
     for ev in result.resize_events:
-        print(f"[launch] resize: gen {ev['gen']} {ev['kind']} "
-              f"{ev['from_size']} -> {ev['to_size']} ({ev['reason']})",
-              flush=True)
+        log.info("resize: gen %d %s %d -> %d (%s)", ev["gen"], ev["kind"],
+                 ev["from_size"], ev["to_size"], ev["reason"])
     if result.drain:
-        print(f"[launch] drain outcome: {result.drain}", flush=True)
+        log.info("drain outcome: %s", result.drain)
     if result.returncode != 0:
-        print(f"[launch] gang failed: rank {result.failed_rank} exit "
-              f"{result.returncode} after {result.restarts_used} restarts",
-              file=sys.stderr)
+        log.error("gang failed: rank %s exit %d after %d restarts",
+                  result.failed_rank, result.returncode,
+                  result.restarts_used)
+    _tel_event("gang_done", returncode=result.returncode,
+               restarts_used=result.restarts_used,
+               resizes=len(result.resize_events), drain=result.drain)
+    telemetry.disable()  # flush before the agent exits
     return result.returncode
 
 

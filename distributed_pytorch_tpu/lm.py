@@ -24,6 +24,7 @@ are pre-shifted host-side so sequence shards never need neighbor tokens.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -37,8 +38,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .models import transformer as tfm
 from .utils import faults
 from .utils import compat
+from .utils import telemetry
 from .utils.compat import shard_map
-from .ops.nn import IGNORE_INDEX, masked_ce
+from .ops.nn import IGNORE_INDEX, masked_ce, step_metrics
 from .parallel import context as ctx
 from .parallel.mesh import make_mesh
 
@@ -1015,14 +1017,22 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
         check_vma=False)
 
 
+# the ONE implementation of the round-13 [grad-norm, param-norm]
+# telemetry vector lives next to the loss primitives (ops/nn.py
+# step_metrics) — train.py's in-scan body uses the same function
+_step_metrics = step_metrics
+
+
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
     """Compiled step: (params, opt_state, tokens, targets[, step_no]) ->
-    (params, opt_state, loss, ok).  tokens/targets are (global_batch,
-    global_seq) int32, sharded (data+expert, seq).  ``ok`` is the
-    per-step health flag (1.0 = loss and synced grads finite — one
-    sum-of-squares pass, the training sentry's in-scan detection
-    signal); ``step_no`` (default 0) only matters to the chaos-harness
-    taps, which trace to nothing without an installed FaultPlan.
+    (params, opt_state, loss, ok, met).  tokens/targets are
+    (global_batch, global_seq) int32, sharded (data+expert, seq).
+    ``ok`` is the per-step health flag (1.0 = loss and synced grads
+    finite — one sum-of-squares pass, the training sentry's in-scan
+    detection signal); ``met`` is the (2,) [grad-norm, param-norm]
+    telemetry vector (``_step_metrics``); ``step_no`` (default 0) only
+    matters to the chaos-harness taps, which trace to nothing without
+    an installed FaultPlan.
     With ``cfg.grad_accum = A > 1``
     the batch is split into A microbatches scanned with gradient
     accumulation and ONE optimizer update — peak activation memory drops
@@ -1068,7 +1078,12 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
         ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, ok
+        # round-13 telemetry scalars riding the health-flag channel:
+        # grad global-norm (gsq already computed for `ok`) + post-update
+        # param global-norm — always emitted, so telemetry on/off never
+        # changes the compiled program
+        met = _step_metrics(gsq, params)
+        return params, opt_state, loss, ok, met
 
     if compress:
         # stateful signature (round 11): the per-device EF residual is a
@@ -1087,9 +1102,9 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
                 loss, grads, sync_state = accum_step(
                     params, sync_state, micro_t, micro_y, n_total,
                     coef / a)
-            params, opt_state, loss, ok = _finish(
+            params, opt_state, loss, ok, met = _finish(
                 params, opt_state, loss, grads, step_no, fault_arm)
-            return params, opt_state, sync_state, loss, ok
+            return params, opt_state, sync_state, loss, ok, met
 
         return step_st
 
@@ -1118,9 +1133,9 @@ def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 (loss, grads), _ = jax.lax.scan(
                     body, (jnp.float32(0), zeros), (micro_t, micro_y))
-        params, opt_state, loss, ok = _finish(
+        params, opt_state, loss, ok, met = _finish(
             params, opt_state, loss, grads, step_no, fault_arm)
-        return params, opt_state, loss, ok
+        return params, opt_state, loss, ok, met
 
     return step
 
@@ -1193,7 +1208,8 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
         ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, ok
+        met = _step_metrics(gsq, params)
+        return params, opt_state, loss, ok, met
 
     return step
 
@@ -1613,7 +1629,8 @@ def make_lm_1f1b_train_step(cfg: LMTrainConfig, mesh: Mesh):
         ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, ok
+        met = _step_metrics(gsq, params)
+        return params, opt_state, loss, ok, met
 
     # surface the timetable for the schedule inspector / bench: the
     # emitted order IS this data (utils/debug.assert_pipeline_schedule)
@@ -1659,9 +1676,10 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
 def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
     """Compiled K-step training loop for the (data, expert, seq, model)
     layout: ``(params, opt_state, tokens, targets) -> (params, opt_state,
-    losses, oks)`` with tokens/targets carrying a leading scan axis of
-    length K — ONE dispatch executes K optimizer steps (``oks``: per-step
-    health flags, as in ``make_lm_train_step``).  Shares
+    losses, oks, mets)`` with tokens/targets carrying a leading scan axis
+    of length K — ONE dispatch executes K optimizer steps (``oks``:
+    per-step health flags, ``mets``: (K, 2) per-step [grad-norm,
+    param-norm], as in ``make_lm_train_step``).  Shares
     ``_make_grad_step`` with the single-step path, so loss semantics
     cannot drift; see LMTrainer.train_steps for when the scan actually
     helps (measured)."""
@@ -1689,11 +1707,12 @@ def make_lm_multi_step(cfg: LMTrainConfig, mesh: Mesh):
                 jnp.float32)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), (loss, ok)
+            met = _step_metrics(gsq, params)
+            return (params, opt_state), (loss, ok, met)
 
-        (params, opt_state), (losses, oks) = jax.lax.scan(
+        (params, opt_state), (losses, oks, mets) = jax.lax.scan(
             body, (params, opt_state), (tokens, targets))
-        return params, opt_state, losses, oks
+        return params, opt_state, losses, oks, mets
 
     return steps
 
@@ -1853,6 +1872,9 @@ class LMTrainer:
         self._multi_fn = None
         self._step = 0
         self.last_ok = None     # health flag(s) of the last dispatch
+        # [grad gnorm, param gnorm] of the last dispatch (round-13
+        # telemetry scalars; (K, 2) from train_steps), fetched lazily
+        self.last_metrics = None
         self._ckptr = None
         self._ckptr_key = None
         self.restored_meta: dict = {}
@@ -1954,6 +1976,7 @@ class LMTrainer:
         self._eval_fn = None
         self._multi_fn = None
         self.last_ok = None
+        self.last_metrics = None
         # a cached checkpointer keeps working (directory-keyed), but the
         # next restore must re-template against the new shardings — which
         # maybe_restore does by passing the live (resharded) trees
@@ -2071,18 +2094,25 @@ class LMTrainer:
         extra = ((jnp.int32(self._step),
                   jnp.float32(faults.arm_window(self._step)))
                  if faults.step_plan() is not None else ())
+        t0 = time.perf_counter()
         if self.sync_state is not None:
             # stateful (dcn_compress) signature: the EF residual is a
             # donated carry next to params/opt-state (round 11)
             (self.params, self.opt_state, self.sync_state, loss,
-             self.last_ok) = self.step_fn(
+             self.last_ok, self.last_metrics) = self.step_fn(
                 self.params, self.opt_state, self.sync_state, tokens,
                 targets, *extra)
         else:
-            self.params, self.opt_state, loss, self.last_ok = self.step_fn(
+            (self.params, self.opt_state, loss, self.last_ok,
+             self.last_metrics) = self.step_fn(
                 self.params, self.opt_state, tokens, targets, *extra)
         self._step += 1
         faults.maybe_crash(self._step)  # chaos: injected process death
+        tel = telemetry.active()
+        if tel is not None:
+            telemetry.emit_train_steps(
+                tel, t0, self._step - 1, 1, loss, self.last_ok,
+                self.last_metrics, span_name="lm_train_step")
         return loss
 
     def train_steps(self, tokens: np.ndarray, targets: np.ndarray):
@@ -2120,8 +2150,16 @@ class LMTrainer:
         else:
             tokens = jax.device_put(tokens, shd)
             targets = jax.device_put(targets, shd)
-        self.params, self.opt_state, losses, self.last_ok = self._multi_fn(
+        t0 = time.perf_counter()
+        (self.params, self.opt_state, losses, self.last_ok,
+         self.last_metrics) = self._multi_fn(
             self.params, self.opt_state, tokens, targets)
         self._step += tokens.shape[0]
         faults.maybe_crash(self._step, tokens.shape[0])
+        tel = telemetry.active()
+        if tel is not None:
+            telemetry.emit_train_steps(
+                tel, t0, self._step - tokens.shape[0], tokens.shape[0],
+                losses, self.last_ok, self.last_metrics,
+                span_name="lm_train_steps")
         return losses

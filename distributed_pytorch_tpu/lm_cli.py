@@ -193,6 +193,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace (TensorBoard-loadable) "
                         "covering steps 2-11 (step 1 excluded: compile)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="unified run telemetry (round 13): stream "
+                        "rank-tagged JSONL events (step spans, loss/"
+                        "grad-norm/param-norm gauges, checkpoint IO, "
+                        "autotune plans, sentry escalations) into this "
+                        "run directory; defaults from the launcher-"
+                        "exported TELEMETRY_DIR; off (and free) when "
+                        "neither is set.  Merge/inspect with "
+                        "scripts/telemetry_summary.py")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -246,6 +255,10 @@ def main(argv: list[str] | None = None) -> int:
                                    port=args.port)
     setup_logging(args.log_level)
     log = get_logger("lm_cli")
+    from .utils import telemetry
+    tel = telemetry.enable_from_cli(args.telemetry_dir)
+    if tel is not None:
+        log.info("telemetry: streaming to %s", tel.run_dir)
 
     cfg = LMTrainConfig(
         model=model_config(args), lr=args.lr, seed=args.seed,

@@ -284,3 +284,17 @@ def masked_ce(logits: Array, targets: Array) -> tuple[Array, Array]:
     true_logit = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
     ce = jnp.where(mask, logz - true_logit, 0.0)
     return jnp.sum(ce), jnp.sum(mask)
+
+
+def step_metrics(grad_sq_sum: Array, params: Any) -> Array:
+    """(2,) f32 [grad global-norm, param global-norm] — the round-13
+    per-step device-side telemetry scalars, shared by BOTH trainers
+    (train.py's in-scan body and lm.py's step finishers).  Computed from
+    the SAME gradient sum-of-squares the sentry health flag already
+    needs plus one reduction over the (updated) params, and returned
+    through the same output channel as the flag — so telemetry on/off
+    is never a program property."""
+    psq = sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+              for p in jax.tree.leaves(params))
+    return jnp.stack([jnp.sqrt(grad_sq_sum.astype(jnp.float32)),
+                      jnp.sqrt(psq)])

@@ -65,6 +65,7 @@ from typing import Any
 import numpy as np
 
 from . import strategies as strat
+from ..utils import telemetry
 
 PROFILE_VERSION = 1
 
@@ -321,8 +322,11 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
     """Fit a ``TopologyProfile`` by timing real collectives per axis of
     ``mesh`` (the calibration pass).  Axes of size 1 get a zero-cost
     link (nothing ever crosses them)."""
+    import time
+
     import jax
 
+    t0 = time.perf_counter()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     links: dict[str, LinkModel] = {}
     measured: dict[str, dict] = {}
@@ -342,6 +346,15 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
                 raw[algo][str(b)] = t
         links[axis] = fit_alpha_beta(obs)
         measured[axis] = raw
+    tel = telemetry.active()
+    if tel is not None:
+        # calibration on the unified timeline (round 13): when, how
+        # long, and which links it fitted
+        tel.span_at("autotune_calibrate", t0, time.perf_counter() - t0,
+                    phase="autotune", axes=sizes,
+                    links={a: {"alpha_s": l.alpha_s,
+                               "beta_s_per_byte": l.beta_s_per_byte}
+                           for a, l in links.items()})
     return TopologyProfile(
         version=PROFILE_VERSION,
         device_kind=getattr(jax.devices()[0], "device_kind", "cpu"),
@@ -841,6 +854,7 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
                         dcn_compress=None, dcn_size=1, overlap=False,
                         predicted_ms=0.0, per_axis=(),
                         profile_source="single-device", census_bytes=0)
+        _emit_plan(plan, side="train")
         return dataclasses.replace(cfg, strategy="none", overlap=False,
                                    dcn_compress=None), plan
     census = grad_census(jax.eval_shape(
@@ -862,7 +876,19 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
         overlap_bucket_mb=(cfg.overlap_bucket_mb
                            if cfg.overlap_bucket_mb is not None
                            else plan.bucket_mb))
+    _emit_plan(plan, side="train")
     return resolved, plan
+
+
+def _emit_plan(plan: "SyncPlan", *, side: str) -> None:
+    """The chosen SyncPlan on the unified timeline (round 13): the
+    explainable decision — strategy/bucket/compression + predicted ms —
+    as one 'autotune' event, so a run's telemetry records WHY its sync
+    path looks the way it does."""
+    tel = telemetry.active()
+    if tel is not None:
+        tel.event("sync_plan", phase="autotune", side=side,
+                  **plan.summary())
 
 
 def lm_topology_axes(cfg) -> dict[str, int]:
@@ -904,4 +930,5 @@ def resolve_lm_auto(cfg):
         cfg, sync_plan=None, dcn_compress=plan.dcn_compress,
         bucket_mb=cfg.bucket_mb if cfg.bucket_mb is not None
         else plan.bucket_mb)
+    _emit_plan(plan, side="lm")
     return resolved, plan

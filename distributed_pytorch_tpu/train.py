@@ -36,7 +36,7 @@ from .models import vgg
 from .ops import nn as ops
 from .parallel import strategies as strat
 from .parallel.mesh import DATA_AXIS, make_mesh, replicated
-from .utils import compat, debug as dbg, faults, tracing
+from .utils import compat, debug as dbg, faults, telemetry, tracing
 from .utils.compat import pcast, shard_map, vma_of
 from .utils.metrics import IterTimeMeter, LossMeter
 
@@ -219,7 +219,7 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
 
     def step(params, state, opt_state, sync_state, key, step0, images,
              labels):
-        params, state, opt_state, sync_state, losses, oks = multi(
+        params, state, opt_state, sync_state, losses, oks, mets = multi(
             params, state, opt_state, sync_state, key, step0,
             images[None], labels[None])
         return params, state, opt_state, sync_state, losses[0]
@@ -233,12 +233,17 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     batches): ONE dispatch executes K optimizer steps on device.
 
     Signature: ``fn(params, state, opt_state, key, step0, images, labels) ->
-    (params, state, opt_state, losses, oks)`` with ``images``/``labels``
-    carrying a leading scan axis of length K, ``losses`` shape (K,), and
-    ``oks`` (K,) f32 per-step health flags (1.0 = loss AND synced grads
-    finite) — the in-scan detection signal of the training sentry
+    (params, state, opt_state, losses, oks, mets)`` with ``images``/
+    ``labels`` carrying a leading scan axis of length K, ``losses`` shape
+    (K,), ``oks`` (K,) f32 per-step health flags (1.0 = loss AND synced
+    grads finite) — the in-scan detection signal of the training sentry
     (utils/sentry.py), one sum-of-squares pass over the gradient tree,
-    negligible next to the backward.
+    negligible next to the backward — and ``mets`` (K, 2) f32 per-step
+    device-side scalars [grad global-norm, post-update param
+    global-norm] (round 13): they RIDE the same in-scan output channel
+    as the health flag, so telemetry reads them from the step's normal
+    outputs and toggling telemetry on/off changes NO compiled program
+    (zero extra compiles, bitwise-identical losses — test-pinned).
 
     This is the TPU-native answer to per-step dispatch overhead: the
     reference's hot loop makes one eager dispatch per op (SURVEY.md 3.1);
@@ -364,14 +369,21 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                 jnp.float32)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, state, opt_state, sync_state, step + 1), (loss,
-                                                                      ok)
+            # per-step telemetry scalars (round 13) riding the SAME
+            # output channel as the health flag: grad global-norm (gsq
+            # is already computed for `ok`) and post-update param
+            # global-norm — device-side, so telemetry-on never adds a
+            # program or a compile (ops.step_metrics: the ONE
+            # implementation, shared with lm.py's step finishers)
+            met = ops.step_metrics(gsq, params)
+            return (params, state, opt_state, sync_state, step + 1), (
+                loss, ok, met)
 
-        (params, state, opt_state, sync_state, _), (losses, oks) = (
+        (params, state, opt_state, sync_state, _), (losses, oks, mets) = (
             jax.lax.scan(
                 body, (params, state, opt_state, sync_state, step0),
                 (images, labels)))
-        return params, state, opt_state, sync_state, losses, oks
+        return params, state, opt_state, sync_state, losses, oks, mets
 
     if mesh is None:
         if strategy.needs_mesh:
@@ -397,16 +409,25 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                   images, labels, fault_arm):
         local_state = jax.tree.map(lambda s: s[0], state)
         local_sync = jax.tree.map(lambda s: s[0], sync_state)
-        params, new_state, opt_state, new_sync, losses, oks = scan_steps(
+        (params, new_state, opt_state, new_sync, losses, oks,
+         mets) = scan_steps(
             params, local_state, opt_state, local_sync, key, step0,
             images, labels, fault_arm, axis=data_axes)
         new_state = jax.tree.map(lambda s: s[None], new_state)
         new_sync = jax.tree.map(lambda s: s[None], new_sync)
         # oks pmean: 1.0 iff EVERY replica's step was healthy (a poisoned
-        # shard pulls the mean below 1 even before its sync spreads it)
+        # shard pulls the mean below 1 even before its sync spreads it);
+        # mets pmean: synced grads/params are replica-identical, so the
+        # mean is the value — it just also PROVES invariance to the vma
+        # checker (a few scalar psums, excluded from the schedule pins
+        # by their min_bytes filter).  mets may arrive vma-INVARIANT
+        # (derived from post-psum grads and updated params), and modern
+        # runtimes reject reducing an invariant value — cast varying
+        # first (pass-through where already varying, no-op on legacy).
         return (params, new_state, opt_state, new_sync,
                 jax.lax.pmean(losses, data_axes),
-                jax.lax.pmean(oks, data_axes))
+                jax.lax.pmean(oks, data_axes),
+                jax.lax.pmean(_as_varying(mets, data_axes), data_axes))
 
     if fault_sig:
         def shard_multi_step(params, state, opt_state, sync_state, key,
@@ -426,7 +447,7 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
         mesh=mesh,
         in_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(),
                   P(None, data_axes), P(None, data_axes)) + extra_specs,
-        out_specs=(P(), P(data_axes), P(), P(data_axes), P(), P()),
+        out_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(), P()),
         # Ring-collective strategies assemble their result from ppermute
         # hops: bitwise replicated by construction, but not provably so to
         # the vma checker (no sanctioned varying->invariant downcast).
@@ -564,6 +585,9 @@ class Trainer:
         self._compiled = {}     # (images.shape, labels.shape) -> AOT executable
         self._step = 0
         self.last_ok = None     # (K,) health flags of the last dispatch
+        # (K, 2) [grad gnorm, param gnorm] of the last dispatch — the
+        # round-13 telemetry scalars, fetched lazily like last_ok
+        self.last_metrics = None
         # snapshot the chaos-tap signature decision NOW: the AOT
         # executables are cached, so a plan installed mid-run must not
         # change the compiled arg list (install plans before building)
@@ -679,13 +703,19 @@ class Trainer:
                           faults.arm_window(self._step, k)
                           if self._fault_sig else 0.0)
         key = (args[6].shape, args[7].shape)
+        t0 = time.perf_counter()
         (self.params, self.state, self.opt_state, self.sync_state,
-         losses, oks) = self._executable(args)(*args)
+         losses, oks, mets) = self._executable(args)(*args)
         # per-step health flags for the training sentry (1.0 = loss and
         # synced grads finite on every replica); fetched lazily by readers
         self.last_ok = oks
+        self.last_metrics = mets
         self._step += k
         faults.maybe_crash(self._step, k)  # chaos: injected process death
+        tel = telemetry.active()
+        if tel is not None:
+            telemetry.emit_train_steps(tel, t0, self._step - k, k, losses,
+                                       oks, mets)
         if key in self._unverified_exes:
             self._unverified_exes.discard(key)
             self.check_consistency()
@@ -858,6 +888,7 @@ class Trainer:
         self._compiled = {}
         self._unverified_exes = set()
         self.last_ok = None
+        self.last_metrics = None
 
     def check_consistency(self) -> None:
         """Verify the DP invariants (utils/debug.py): params and optimizer

@@ -28,15 +28,27 @@ import json
 import os
 import re
 import threading
+import time
 import zlib
 
 import jax
 import numpy as np
 
 from ..parallel.mesh import replicated
-from . import faults
+from . import faults, telemetry
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+def _tel_span(name: str, t0: float, **args) -> None:
+    """Checkpoint IO on the unified timeline (round 13): every
+    save/restore/reshard lands as a span in the 'ckpt' lane —
+    duration + bytes — when the process registry is active; one
+    registry read otherwise."""
+    tel = telemetry.active()
+    if tel is not None:
+        tel.span_at(name, t0, time.perf_counter() - t0, phase="ckpt",
+                    **args)
 
 
 class CorruptCheckpointError(RuntimeError):
@@ -236,6 +248,8 @@ def _atomic_write(directory: str, index: int, payload: dict,
                   meta: dict, keep: int) -> str:
     """Embed meta + per-leaf checksums, write ckpt_<index>.npz
     atomically, prune old ones."""
+    t0 = time.perf_counter()
+    nbytes = sum(v.nbytes for v in payload.values())
     payload = dict(payload)
     meta = dict(meta, __checksums__={k: _crc(v) for k, v in
                                      payload.items()})
@@ -249,6 +263,8 @@ def _atomic_write(directory: str, index: int, payload: dict,
     faults.maybe_corrupt_checkpoint(path)  # chaos hook (no-op unplanned)
     for _, old in _list_ckpts(directory)[:-keep]:
         os.remove(old)
+    _tel_span("ckpt_save", t0, step=int(index), bytes=int(nbytes),
+              fmt="npz")
     return path
 
 
@@ -320,6 +336,7 @@ class Checkpointer:
         checksums; a corrupt/truncated generation is QUARANTINED
         (renamed ``*.corrupt``) and restore falls back to the previous
         one instead of crashing mid-resume."""
+        t0 = time.perf_counter()
         got = None
         for epoch, path in reversed(self.list()):
             try:
@@ -365,6 +382,9 @@ class Checkpointer:
         trainer.params, trainer.state, trainer.opt_state = (
             params, state, opt_state)
         trainer._step = meta["step"]
+        _tel_span("ckpt_restore", t0, step=int(meta["step"]),
+                  bytes=int(sum(v.nbytes for v in flat.values())),
+                  fmt="npz")
         return meta["epoch"]
 
 
@@ -417,13 +437,18 @@ class PyTreeCheckpointer:
         exists.  Corrupt generations are quarantined and skipped —
         restore falls back to the newest one that passes its
         checksums."""
+        t0 = time.perf_counter()
         for _, path in reversed(self.list()):
             try:
                 flat, meta = _load_npz_verified(path)
             except CorruptCheckpointError as e:
                 _quarantine(path, e)
                 continue
-            return _place_like(like, flat), meta
+            out = _place_like(like, flat), meta
+            _tel_span("ckpt_restore", t0, step=int(meta.get("step", -1)),
+                      bytes=int(sum(v.nbytes for v in flat.values())),
+                      fmt="npz")
+            return out
         return None
 
 
@@ -550,6 +575,7 @@ class ShardedCheckpointer:
 
     # -- save -------------------------------------------------------------
     def save(self, trees: dict, step: int, meta: dict | None = None) -> str:
+        t0 = time.perf_counter()
         pid = jax.process_index()
         ckpt_dir = os.path.join(self.directory, f"ckpt_{step}")
         os.makedirs(ckpt_dir, exist_ok=True)
@@ -612,6 +638,9 @@ class ShardedCheckpointer:
             os.replace(os.path.join(ckpt_dir, "meta.json.tmp"),
                        os.path.join(ckpt_dir, "meta.json"))
             self._prune()
+        _tel_span("ckpt_save", t0, step=int(step),
+                  bytes=int(sum(v.nbytes for v in payload.values())),
+                  fmt="sharded")
         return ckpt_dir
 
     def _prune(self) -> None:
@@ -672,6 +701,7 @@ class ShardedCheckpointer:
 
     def _restore_dir(self, ckpt_dir: str, like: dict,
                      reshard: bool = False) -> tuple[dict, dict]:
+        t_restore = time.perf_counter()
         # JSON metadata is in the same bit-rot threat model as the shard
         # payloads: a corrupt meta/index must fail THIS generation (and
         # fall back), not crash the resume
@@ -801,6 +831,11 @@ class ShardedCheckpointer:
             for z in files.values():
                 z.close()
         self.last_reshard_stats = stats
+        _tel_span("ckpt_reshard" if reshard else "ckpt_restore",
+                  t_restore, step=int(meta.get("step", -1)),
+                  bytes=int(stats["read_bytes"]), fmt="sharded",
+                  exact_hits=stats["exact_hits"],
+                  intersections=stats["intersections"])
         return out, meta
 
 

@@ -5,30 +5,84 @@ everything via bare ``print`` (reference main.py:10, SURVEY.md section 5).
 Here one ``setup_logging`` call configures rank-aware stdlib logging; the
 training loop's printed windows (loss/20 iters, time/40 iters) route through
 it so output is greppable and per-process attributable on multi-host runs.
+
+The rank is resolved LAZILY, per record, by a ``logging.Filter`` (round
+13): it used to be baked into the format string at the first
+``setup_logging`` call, and the idempotent early-return then kept it
+stale forever — a gang worker configured before ``jax.distributed``
+init logged rank 0 for its whole life, and a rank respawned into a new
+generation after an elastic resize kept its old number.  ``_rank()``
+prefers the launcher env contract (``RANK`` — correct before jax init
+and refreshed per generation, since elastic resizes respawn the
+process) and falls back to ``jax.process_index()`` only when jax is
+ALREADY imported (launcher-less multi-host runs); it never imports jax
+itself — the launcher agent logs through this module and must stay
+jax-free.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 
+def current_rank() -> int:
+    """Current process rank, resolved at call time (never cached) — the
+    ONE launcher-rank precedence, shared with telemetry's CLI bootstrap
+    (utils/telemetry.enable_from_cli): env ``RANK`` first, then
+    ``jax.process_index()`` iff jax is already loaded, else 0."""
+    env = os.environ.get("RANK")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    jax = sys.modules.get("jax")  # only consult jax if someone loaded it
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+_rank = current_rank  # backward-friendly local alias
+
+
+class RankFilter(logging.Filter):
+    """Stamps ``record.rank`` on every record at emit time, so the
+    format string's ``%(rank)s`` always reflects the CURRENT rank."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank = _rank()
+        return True
+
+
 def setup_logging(level: str = "INFO") -> None:
-    """Configure root logging with a rank-aware format (idempotent)."""
-    try:
-        import jax
-        rank = jax.process_index()
-    except Exception:
-        rank = 0
+    """Configure root logging with a rank-aware format (idempotent; the
+    level still updates on repeat calls — only the handler install is
+    once-only).  INFO/WARNING go to stdout; ERROR and above go to
+    stderr — so a supervisor capturing stderr still sees failures
+    (launch.py's "gang failed" line routed there as a bare print before
+    round 13, and must keep doing so through the logger)."""
     root = logging.getLogger("distributed_pytorch_tpu")
     root.setLevel(level.upper())
-    if root.handlers:  # already configured
+    if root.handlers:  # already configured (rank stays fresh via the filter)
         return
-    handler = logging.StreamHandler(sys.stdout)
-    handler.setFormatter(logging.Formatter(
-        f"%(asctime)s rank{rank} %(name)s %(levelname)s: %(message)s",
-        datefmt="%H:%M:%S"))
-    root.addHandler(handler)
+    fmt = logging.Formatter(
+        "%(asctime)s rank%(rank)s %(name)s %(levelname)s: %(message)s",
+        datefmt="%H:%M:%S")
+    out = logging.StreamHandler(sys.stdout)
+    out.addFilter(RankFilter())
+    out.addFilter(lambda record: record.levelno < logging.ERROR)
+    out.setFormatter(fmt)
+    err = logging.StreamHandler(sys.stderr)
+    err.setLevel(logging.ERROR)
+    err.addFilter(RankFilter())
+    err.setFormatter(fmt)
+    root.addHandler(out)
+    root.addHandler(err)
     root.propagate = False
 
 

@@ -19,6 +19,8 @@ import math
 from collections import deque
 from dataclasses import dataclass, field
 
+from . import telemetry
+
 LOSS_WINDOW = 20
 TIME_WINDOW = 40
 
@@ -28,6 +30,18 @@ class WindowRecord:
     first_iter: int  # 1-based, matching the reference's printout
     last_iter: int
     value: float
+
+
+def _window_gauge(name: str, rec: WindowRecord) -> None:
+    """Round 13: a completed reference-semantics window also lands as a
+    gauge on the unified timeline when the process registry is active —
+    the SAME value the meter prints, so the reference's loss/20 and
+    time/40 windows become plottable next to the per-step scalars
+    instead of print-only.  Free while telemetry is off."""
+    tel = telemetry.active()
+    if tel is not None:
+        tel.gauge(name, rec.value, phase="train",
+                  first_iter=rec.first_iter, last_iter=rec.last_iter)
 
 
 @dataclass
@@ -45,6 +59,7 @@ class LossMeter:
                                self.running / self.window)
             self.records.append(rec)
             self.running = 0.0
+            _window_gauge("window_loss", rec)
             return rec
         return None
 
@@ -70,6 +85,7 @@ class IterTimeMeter:
                                self.total / divisor)
             self.records.append(rec)
             self.total = 0.0
+            _window_gauge("window_iter_seconds", rec)
             return rec
         return None
 

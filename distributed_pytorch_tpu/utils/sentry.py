@@ -51,7 +51,17 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from . import telemetry
 from .metrics import SpikeDetector
+
+
+def _tel_event(name: str, **args) -> None:
+    """Sentry escalations on the unified timeline (round 13): every
+    detect / rollback / tighten / resize / abort lands as an event in
+    the 'sentry' lane when the registry is active; free otherwise."""
+    tel = telemetry.active()
+    if tel is not None:
+        tel.event(name, phase="sentry", **args)
 
 
 @dataclass
@@ -167,6 +177,8 @@ class TrainingSentry:
             setattr(self.trainer, name, jax.tree.map(put, saved, live))
         self.trainer._step = self._snap_step
         self.stats["rollbacks"] += 1
+        _tel_event("sentry_rollback", to_step=self._snap_step,
+                   rewound=rewound)
         return rewound
 
     # -- the guarded step --------------------------------------------------
@@ -207,6 +219,9 @@ class TrainingSentry:
         self._ladder += 1
         self.log(f"[sentry] step {self.trainer._step - 1}: {trigger} "
                  f"(loss={loss_val:.6g}); escalation level {self._ladder}")
+        _tel_event("sentry_trigger", kind=trigger,
+                   step=self.trainer._step - 1, loss=loss_val,
+                   ladder=self._ladder)
         if self._ladder > self.cfg.max_rollbacks:
             # resize rung (round 12): the rollback/skip/clip ladder is
             # exhausted — before aborting, roll back to last-good once
@@ -221,12 +236,17 @@ class TrainingSentry:
                 self.log(f"[sentry] escalation ladder exhausted at step "
                          f"{self.trainer._step}: requesting gang RESIZE "
                          f"(rolled back {rewound} step(s) to last-good)")
+                _tel_event("sentry_resize", step=self.trainer._step,
+                           rewound=rewound)
                 if self.on_resize(dict(self.stats)):
                     # resized in-process: the rebuilt trainer's state is
                     # the new last-good; give recovery a fresh horizon
                     self._ladder = 0
                     self.snapshot()
                     return None
+            _tel_event("sentry_abort", kind=trigger,
+                       step=self.trainer._step - 1,
+                       rollbacks=self.stats["rollbacks"])
             raise SentryAbort(
                 f"{trigger} at step {self.trainer._step - 1} after "
                 f"{self.stats['rollbacks']} rollbacks — escalation "
@@ -237,6 +257,7 @@ class TrainingSentry:
                 new_clip = tighten(self.cfg.clip_factor)
                 self.stats["clip_tightened"] += 1
                 self.log(f"[sentry] grad clip tightened to {new_clip:g}")
+                _tel_event("sentry_clip_tightened", clip=float(new_clip))
         rewound = self.rollback()
         self.stats["skipped_steps"] += rewound
         self.log(f"[sentry] rolled back {rewound} step(s) to step "

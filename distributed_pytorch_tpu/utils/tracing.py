@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 
 import jax
 
+from . import telemetry
+
 
 @contextlib.contextmanager
 def trace(log_dir: str):
@@ -93,10 +95,19 @@ class PhaseTimer:
     long-lived servers: exact running aggregates (count / total / max)
     are kept per phase, while the percentile window holds only the most
     recent ``window`` segments (a month-long serving process must not
-    accumulate one float per block forever)."""
+    accumulate one float per block forever).
+
+    Round 13: when the process telemetry registry is active
+    (utils/telemetry.py), every segment is ALSO re-emitted as a span on
+    the unified timeline under ``component`` as its phase lane — so the
+    serving loop's host_plan / dispatch / fetch / host_parse / prefill
+    attribution lands on the same Chrome trace as train steps, gang
+    resizes, and checkpoint writes, with no serve.py changes and zero
+    cost while telemetry is off (one registry read per segment)."""
 
     enabled: bool = True
     window: int = 4096
+    component: str = "serve"
     _recent: dict = field(default_factory=dict)   # phase -> deque[float]
     _agg: dict = field(default_factory=dict)      # phase -> [n, total, max]
 
@@ -122,6 +133,12 @@ class PhaseTimer:
         agg[1] += seconds
         agg[2] = max(agg[2], seconds)
         self._recent[name].append(seconds)
+        tel = telemetry.active()
+        if tel is not None:
+            # the segment just ENDED: rebase its start so the span lands
+            # where the work actually ran on the shared timeline
+            tel.span_at(name, time.perf_counter() - seconds, seconds,
+                        phase=self.component)
 
     def reset(self) -> None:
         self._recent.clear()

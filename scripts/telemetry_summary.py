@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Inspect a telemetry run directory: per-phase/per-rank tables, step-time
+percentiles, the event log, and the merged Chrome-trace export.
+
+    python scripts/telemetry_summary.py RUN_DIR
+    python scripts/telemetry_summary.py RUN_DIR --chrome-trace trace.json
+    python scripts/telemetry_summary.py RUN_DIR --json
+
+The run directory is whatever ``--telemetry-dir`` (cli.py / lm_cli.py /
+launch.py) pointed at: one rank-tagged JSONL file per process
+(utils/telemetry.py).  ``--chrome-trace`` writes ONE merged
+Chrome-trace/Perfetto JSON spanning every rank and generation — open it
+at https://ui.perfetto.dev (or chrome://tracing): pid = rank, tid =
+phase, generation tagged on every event.  ``--json`` dumps the
+machine-readable ``run_summary`` instead of the tables.
+
+Deliberately jax-free and dependency-free: it must run on a laptop
+against a run directory rsync'd off a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_pytorch_tpu.utils import telemetry  # noqa: E402
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def print_tables(run_dir: str, summary: dict, *, max_events: int) -> None:
+    print(f"telemetry run: {os.path.abspath(run_dir)}")
+    print(f"ranks: {summary['ranks']}  "
+          f"generations: {summary['generations']}")
+
+    if summary["spans"]:
+        print("\nspans (per rank/phase/name):")
+        hdr = (f"  {'where':<40} {'count':>6} {'total':>10} {'p50':>10} "
+               f"{'p95':>10} {'max':>10}")
+        print(hdr)
+        for key, st in summary["spans"].items():
+            print(f"  {key:<40} {st['count']:>6} "
+                  f"{_fmt_s(st['total_s']):>10} {_fmt_s(st['p50_s']):>10} "
+                  f"{_fmt_s(st['p95_s']):>10} {_fmt_s(st['max_s']):>10}")
+
+    if summary["counters"]:
+        print("\ncounters (final totals):")
+        for key, v in summary["counters"].items():
+            print(f"  {key:<40} {v:>10g}")
+
+    if summary["gauges"]:
+        print("\ngauges (last value):")
+        for key, g in summary["gauges"].items():
+            last = g["last"]
+            shown = f"{last:.6g}" if isinstance(last, float) else str(last)
+            print(f"  {key:<40} {shown:>12}  (x{g['count']})")
+
+    if summary["events"]:
+        print("\nevents (count, by generation):")
+        for key, e in summary["events"].items():
+            by_gen = ", ".join(f"gen{g}: {n}"
+                               for g, n in sorted(e["by_gen"].items()))
+            print(f"  {key:<40} {e['count']:>6}  ({by_gen})")
+
+    # chronological event log (discrete events only; spans/gauges are
+    # summarized above) — the greppable story of the run
+    rows = []
+    for epoch, records in telemetry.read_run(run_dir):
+        for rec in records:
+            if rec.get("type") == "event":
+                rows.append((telemetry._align_us(epoch, rec["ts"]), rec))
+    rows.sort(key=lambda r: r[0])
+    if rows:
+        print(f"\nevent log ({min(len(rows), max_events)} of {len(rows)}):")
+        for ts_us, rec in rows[:max_events]:
+            args = dict(rec.get("args") or {})
+            # a caller-supplied generation wins over the registry's —
+            # the same precedence as the trace/by_gen tables (the agent
+            # is pinned gen 0 but its events span every generation)
+            gen = args.pop("gen", rec.get("gen"))
+            arg_s = " ".join(f"{k}={v}" for k, v in args.items())
+            print(f"  t+{(ts_us - rows[0][0]) / 1e6:9.3f}s "
+                  f"rank{rec.get('rank')} gen{gen} "
+                  f"[{rec.get('phase')}] {rec.get('name')} {arg_s}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge/inspect a unified-telemetry run directory")
+    p.add_argument("run_dir", help="directory of events_*.jsonl files "
+                                   "(a --telemetry-dir)")
+    p.add_argument("--chrome-trace", default=None, metavar="OUT_JSON",
+                   help="write the merged Chrome-trace/Perfetto JSON "
+                        "(pid=rank, tid=phase, generation-tagged)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the machine-readable run summary instead "
+                        "of tables")
+    p.add_argument("--max-events", type=int, default=40,
+                   help="event-log rows to print (tables mode)")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        p.error(f"{args.run_dir!r} is not a directory")
+    summary = telemetry.run_summary(args.run_dir)
+    if not summary["ranks"]:
+        p.error(f"no telemetry files ({telemetry.FILE_PREFIX}*.jsonl) "
+                f"under {args.run_dir!r}")
+
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        print_tables(args.run_dir, summary, max_events=args.max_events)
+
+    if args.chrome_trace:
+        trace = telemetry.merge_chrome_trace(args.run_dir)
+        tmp = args.chrome_trace + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, args.chrome_trace)
+        print(f"\nchrome trace: {args.chrome_trace} "
+              f"({len(trace['traceEvents'])} events) — open in "
+              f"https://ui.perfetto.dev", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

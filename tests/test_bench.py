@@ -293,6 +293,39 @@ def test_bench_json_keys_include_elastic_gate():
     assert "train_step" in esrc               # the proving step is timed
 
 
+def test_bench_telemetry_env_knob_fails_loudly():
+    """A typo'd BENCH_TELEMETRY must raise before any measurement (the
+    BENCH_KV_DTYPE contract, via the ONE shared _canon_bool_env);
+    unset/''/'0' skip cleanly, '1' runs."""
+    assert bench.canon_telemetry_env(None) is False
+    assert bench.canon_telemetry_env("") is False
+    assert bench.canon_telemetry_env("0") is False
+    assert bench.canon_telemetry_env("1") is True
+    for bad in ("yes", "true", "2", " 1", "on"):
+        with pytest.raises(ValueError, match="BENCH_TELEMETRY"):
+            bench.canon_telemetry_env(bad)
+
+
+def test_bench_json_keys_include_telemetry_gate():
+    """Round-13 schema: the telemetry-overhead keys ride the JSON, the
+    knob is canonicalized pre-bench, and the A/B follows the
+    hardened-window discipline (>= 5 alternating reps, median,
+    precompile outside the window) with the registry toggled in-session
+    around the SAME trainer (identical compiled programs)."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("telemetry_overhead_pct", "train_step_ms_telemetry_on",
+                "train_step_ms_telemetry_off"):
+        assert key in src, key
+    assert "canon_telemetry_env" in src and "BENCH_TELEMETRY" in src
+    sig = inspect.signature(bench.bench_train_telemetry)
+    assert sig.parameters["reps"].default >= 5
+    tsrc = inspect.getsource(bench.bench_train_telemetry)
+    assert "precompile_steps" in tsrc   # compile outside the window
+    assert "telemetry.enable" in tsrc and "telemetry.disable" in tsrc
+    assert "for on in (False, True)" in tsrc  # alternating A/B
+
+
 def test_bench_json_keys_include_pp_gate():
     """Round-10 schema: the interleaved-1F1B A/B keys ride the JSON, the
     knobs are canonicalized pre-bench, and the A/B reads its bubble from

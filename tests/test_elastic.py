@@ -753,14 +753,27 @@ def test_gang_kill_shrink_resume_rejoin_grow(tmp_path, monkeypatch):
     ra = run(2, tmp_path / "ck_a", tmp_path / "out_a")
     assert ra.returncode == 0, ra
 
-    # the elastic run: injected crash on gang rank 1, generation 0 only
+    # the elastic run: injected crash on gang rank 1, generation 0 only.
+    # Round 13: the gang ALSO streams unified telemetry — workers via
+    # the TELEMETRY_DIR env contract, the (in-process, threaded) agent
+    # via the test-process registry, exactly as launch.py main() wires
+    # it — and the bitwise pins below double as the proof that
+    # telemetry-on does not perturb the trajectory.
+    from distributed_pytorch_tpu.utils import telemetry
+    tel_dir = tmp_path / "telemetry"
+    telemetry.enable(str(tel_dir), rank=-1, gen=0, label="agent")
     plan = faults.FaultPlan(kind="crash", step=4, rank=1, gen=0)
-    re_ = run(2, tmp_path / "ck_e", tmp_path / "out_e",
-              extra={"FAULT_PLAN": plan.to_env()},
-              elastic=ElasticConfig(
-                  min_workers=1, max_workers=2, heartbeat_timeout_s=300,
-                  drain_grace_s=30, rejoin_delay_s=0.0,
-                  grow_after_steps=3))
+    try:
+        re_ = run(2, tmp_path / "ck_e", tmp_path / "out_e",
+                  extra={"FAULT_PLAN": plan.to_env(),
+                         "TELEMETRY_DIR": str(tel_dir)},
+                  elastic=ElasticConfig(
+                      min_workers=1, max_workers=2,
+                      heartbeat_timeout_s=300,
+                      drain_grace_s=30, rejoin_delay_s=0.0,
+                      grow_after_steps=3))
+    finally:
+        telemetry.disable()
     assert re_.returncode == 0, re_
     moves = [(e["kind"], e["from_size"], e["to_size"])
              for e in re_.resize_events]
@@ -800,3 +813,26 @@ def test_gang_kill_shrink_resume_rejoin_grow(tmp_path, monkeypatch):
     np.testing.assert_allclose(
         np.asarray([merged[s] for s in range(steps)]), a["losses"],
         rtol=1e-3, atol=1e-5)
+
+    # round 13 acceptance: ONE merged Chrome trace from the 2-worker
+    # elastic gang — valid trace JSON carrying spans/events from BOTH
+    # gang ranks across the shrink -> grow, generation-tagged.
+    trace = json.loads(json.dumps(telemetry.merge_chrome_trace(
+        str(tel_dir))))
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    data_pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+    assert {-1, 0, 1} <= data_pids, data_pids  # agent + both gang ranks
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} >= {0, 1}, "spans from both ranks"
+    for e in spans:
+        assert "gen" in e["args"] and "dur" in e and "ts" in e
+    gens = {e["args"]["gen"] for e in evs if "gen" in e.get("args", {})}
+    assert {0, 1, 2} <= gens, gens  # pre-shrink, shrunk, re-grown
+    resizes = [e for e in evs if e.get("name") == "gang_resize"]
+    assert [e["args"]["kind"] for e in resizes] == ["shrink", "grow"]
+    # the worker that honored the drain marked the boundary it left at
+    assert any(e.get("name") == "worker_drain" for e in evs)
+    # both ranks' train spans carry the per-step gauges next to them
+    gauge_names = {e["name"] for e in evs if e.get("ph") == "C"}
+    assert {"loss", "grad_norm", "param_norm"} <= gauge_names

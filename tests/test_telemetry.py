@@ -1,0 +1,570 @@
+"""Unified run telemetry (round 13, utils/telemetry.py).
+
+Pins:
+- the JSONL record schema (epoch first; every record rank/gen/phase/ts
+  tagged) and the per-record atomic-append sink;
+- Chrome-trace export validity: valid JSON, required keys, ONE pid per
+  rank, spans strictly nested per (pid, tid) lane;
+- multi-rank merge INCLUDING a simulated elastic resize (the same rank
+  re-registering at a later generation: both files merge, every event
+  generation-tagged);
+- the zero-overhead contract: telemetry OFF (the default) is bitwise
+  free — identical 3-step losses and identical compile counts whether
+  the registry was ever enabled or not (the per-step scalars ride the
+  in-scan health-flag output, so on/off is not a program property);
+- bounded memory: the in-process ring holds the most recent N records
+  while the exact aggregates keep counting;
+- the instrument fan-in: PhaseTimer segments, metric-window records,
+  sentry escalations, and checkpoint IO all land in the stream;
+- the --telemetry-dir surface on cli.py / lm_cli.py / launch.py, the
+  launcher agent staying jax-free with telemetry imported, and the
+  lazily-resolved log rank (the round-13 logging fix).
+"""
+
+import json
+import logging as pylogging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_pytorch_tpu.utils import telemetry  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+# -- record schema / sink ----------------------------------------------------
+
+def test_record_schema_and_epoch_first(tmp_path):
+    tel = telemetry.enable(str(tmp_path), rank=3, gen=2)
+    tel.counter("steps", 2, phase="train")
+    tel.gauge("loss", 1.5, phase="train", step=7)
+    tel.event("worker_start", phase="gang", rank=1)
+    with tel.span("dispatch", phase="serve"):
+        pass
+    tel.observe("latency", 0.25, phase="serve")
+    telemetry.disable()
+
+    files = [n for n in os.listdir(tmp_path)
+             if n.startswith(telemetry.FILE_PREFIX)]
+    assert files == [f"events_rank3_gen2_{os.getpid()}.jsonl"]
+    lines = [json.loads(ln) for ln in
+             (tmp_path / files[0]).read_text().splitlines()]
+    # epoch first: wall+mono pinned at one instant (the clock-alignment
+    # record the exporter needs), version + identity tagged
+    ep = lines[0]
+    assert ep["type"] == "epoch" and ep["version"] == 1
+    assert ep["rank"] == 3 and ep["gen"] == 2 and ep["pid"] == os.getpid()
+    assert "wall" in ep and "mono" in ep
+    kinds = [r["type"] for r in lines[1:]]
+    assert kinds == ["counter", "gauge", "event", "span", "hist"]
+    for rec in lines[1:]:
+        for key in ("name", "phase", "ts", "rank", "gen"):
+            assert key in rec, (key, rec)
+        assert rec["rank"] == 3 and rec["gen"] == 2
+    counter, gauge, event, span, hist = lines[1:]
+    assert counter["inc"] == 2 and counter["total"] == 2
+    assert gauge["value"] == 1.5 and gauge["args"] == {"step": 7}
+    assert event["args"] == {"rank": 1}
+    assert span["dur"] >= 0.0
+    assert hist["value"] == 0.25
+
+
+def test_counters_accumulate_and_summary(tmp_path):
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    tel.counter("steps", 2, phase="train")
+    tel.counter("steps", 3, phase="train")
+    tel.gauge("loss", 0.5, phase="train")
+    s = tel.summary()
+    assert s["counters"]["train/steps"] == 5
+    assert s["gauges"]["train/loss"] == 0.5
+
+
+def test_ring_buffer_bounded_memory(tmp_path):
+    """A month-long server must not grow: the recent ring caps at
+    ``ring`` records while the exact aggregates keep counting."""
+    tel = telemetry.Telemetry(str(tmp_path), rank=0, ring=16,
+                              flush_every=1000)
+    for i in range(100):
+        tel.gauge("g", float(i), phase="serve")
+    assert len(tel.recent) == 16
+    assert tel.recent[-1]["value"] == 99.0
+    assert len(tel._pending) <= 1000  # buffered, not unbounded
+    tel.close()
+    # everything still reached disk at close
+    _, recs = telemetry.read_run(str(tmp_path))[0]
+    assert len(recs) == 100
+
+
+# -- Chrome-trace export -----------------------------------------------------
+
+def _assert_strictly_nested(spans):
+    """Spans in one (pid, tid) lane must nest like a call stack: no
+    partial overlap (Perfetto renders partial overlaps as garbage)."""
+    stack = []
+    for s in sorted(spans, key=lambda e: (e["ts"], -e["dur"])):
+        while stack and s["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] - 1e-6:
+            stack.pop()
+        if stack:
+            outer = stack[-1]
+            assert s["ts"] + s["dur"] <= outer["ts"] + outer["dur"] + 1e-6, (
+                f"span {s} partially overlaps {outer}")
+        stack.append(s)
+
+
+def test_chrome_trace_export_is_valid(tmp_path):
+    t0 = telemetry.Telemetry(str(tmp_path), rank=0, gen=0)
+    with t0.span("train_steps", phase="train", k=2):
+        with t0.span("inner", phase="train"):
+            pass
+    t0.gauge("loss", 2.0, phase="train")
+    t0.event("snapshot", phase="sentry")
+    t0.close()
+    t1 = telemetry.Telemetry(str(tmp_path), rank=1, gen=0)
+    with t1.span("dispatch", phase="serve"):
+        pass
+    t1.close()
+
+    trace = telemetry.merge_chrome_trace(str(tmp_path))
+    trace = json.loads(json.dumps(trace))  # valid JSON round-trip
+    evs = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    # one pid per rank, process-named
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    data = [e for e in evs if e.get("ph") != "M"]
+    assert {e["pid"] for e in data} == {0, 1}
+    # spans: complete events with ts/dur, tid = phase, gen in args
+    spans = [e for e in data if e["ph"] == "X"]
+    assert {(e["pid"], e["tid"]) for e in spans} == {(0, "train"),
+                                                     (1, "serve")}
+    for e in spans:
+        assert e["dur"] >= 0 and e["args"]["gen"] == 0
+    by_lane = {}
+    for e in spans:
+        by_lane.setdefault((e["pid"], e["tid"]), []).append(e)
+    for lane in by_lane.values():
+        _assert_strictly_nested(lane)
+    # counters and instants survive with their lanes
+    assert any(e["ph"] == "C" and e["name"] == "loss" for e in data)
+    assert any(e["ph"] == "i" and e["name"] == "snapshot"
+               and e["tid"] == "sentry" for e in data)
+    # merged stream is time-ordered
+    ts = [e["ts"] for e in data]
+    assert ts == sorted(ts)
+
+
+def test_multi_rank_merge_across_simulated_resize(tmp_path):
+    """The elastic-resize shape without a gang: rank 1 dies after gen 0,
+    rank 0 re-registers at gen 1 (a respawned process gets a NEW file —
+    pid/gen-keyed), and the merge keeps every record generation-tagged
+    under ONE pid per rank."""
+    a0 = telemetry.Telemetry(str(tmp_path), rank=0, gen=0)
+    b0 = telemetry.Telemetry(str(tmp_path), rank=1, gen=0)
+    for t in (a0, b0):
+        with t.span("train_steps", phase="train"):
+            pass
+    agent = telemetry.Telemetry(str(tmp_path), rank=-1, gen=0,
+                                label="agent")
+    agent.event("gang_resize", phase="gang", kind="shrink", gen=0)
+    a0.close(), b0.close()
+    a1 = telemetry.Telemetry(str(tmp_path), rank=0, gen=1)
+    with a1.span("train_steps", phase="train"):
+        pass
+    a1.close()
+    agent.close()
+
+    assert len(telemetry.read_run(str(tmp_path))) == 4  # one per process
+    trace = json.loads(json.dumps(
+        telemetry.merge_chrome_trace(str(tmp_path))))
+    data = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert {e["pid"] for e in data} == {-1, 0, 1}
+    # rank 0 contributes spans from BOTH generations under one pid
+    rank0_gens = {e["args"]["gen"] for e in data
+                  if e["pid"] == 0 and e["ph"] == "X"}
+    assert rank0_gens == {0, 1}
+    # the agent lane carries the resize, and the summary sees both gens
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["ranks"] == [-1, 0, 1]
+    assert summary["generations"] == [0, 1]
+    assert summary["events"]["rank-1/gang/gang_resize"]["count"] == 1
+
+
+def test_run_summary_sums_counters_across_restarts_and_caller_gen_wins(
+        tmp_path):
+    """Two review-confirmed bugs pinned: (a) counter totals restart at
+    zero with every new registry (an elastic respawn, or a re-enable
+    appending to the same file), so the run total must SUM increments,
+    not max totals; (b) the agent's registry is pinned gen 0 but its
+    events carry their true generation in args — the caller's gen must
+    win in the trace and in by_gen."""
+    import time as _time
+
+    a = telemetry.Telemetry(str(tmp_path), rank=0, gen=0)
+    a.counter("steps", 4, phase="train")
+    a.gauge("loss", 5.0, phase="train")
+    a.close()
+    _time.sleep(0.01)
+    b = telemetry.Telemetry(str(tmp_path), rank=0, gen=1)
+    b.counter("steps", 3, phase="train")  # fresh registry: total restarts
+    b.gauge("loss", 3.0, phase="train")
+    b.close()
+    agent = telemetry.Telemetry(str(tmp_path), rank=-1, gen=0,
+                                label="agent")
+    agent.event("gang_resize", phase="gang", kind="shrink", gen=2)
+    agent.close()
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["counters"]["rank0/train/steps"] == 7  # 4 + 3
+    assert summary["gauges"]["rank0/train/loss"]["last"] == 3.0
+    assert summary["events"]["rank-1/gang/gang_resize"]["by_gen"] == \
+        {"2": 1}
+    assert 2 in summary["generations"]
+    trace = telemetry.merge_chrome_trace(str(tmp_path))
+    ev = [e for e in trace["traceEvents"]
+          if e.get("name") == "gang_resize"][0]
+    assert ev["args"]["gen"] == 2  # caller gen, not the registry's 0
+
+
+def test_read_run_orders_by_epoch_time_not_filename(tmp_path):
+    """Lexicographic file order puts gen10 before gen2; the merge must
+    order by each file's epoch wall clock so 'last value' summaries
+    stay fresh past 9 elastic restarts."""
+    import time as _time
+
+    for gen in (2, 10):
+        t = telemetry.Telemetry(str(tmp_path), rank=0, gen=gen)
+        t.gauge("loss", float(gen), phase="train")
+        t.close()
+        _time.sleep(0.01)
+    assert [e["gen"] for e, _ in telemetry.read_run(str(tmp_path))] == \
+        [2, 10]
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["gauges"]["rank0/train/loss"]["last"] == 10.0
+
+
+def test_nonfinite_gauges_stay_strict_json(tmp_path):
+    """A diverging run gauges loss=NaN exactly when the trace matters
+    most — Python's json module would write bare NaN (invalid strict
+    JSON, chrome://tracing rejects the whole file); the sink maps
+    non-finite floats to strings instead."""
+    tel = telemetry.Telemetry(str(tmp_path), rank=0)
+    tel.gauge("loss", float("nan"), phase="train", step=0)
+    tel.gauge("grad_norm", float("inf"), phase="train")
+    tel.close()
+    raw = [ln for n in os.listdir(tmp_path)
+           for ln in (tmp_path / n).read_text().splitlines()]
+    for ln in raw:
+        json.loads(ln, parse_constant=lambda c: pytest.fail(
+            f"bare {c} in JSONL line {ln!r}"))
+    trace = telemetry.merge_chrome_trace(str(tmp_path))
+    json.dumps(trace, allow_nan=False)  # strict-JSON exportable
+    vals = {e["name"]: e["args"][e["name"]]
+            for e in trace["traceEvents"] if e.get("ph") == "C"}
+    assert vals == {"loss": "NaN", "grad_norm": "Infinity"}
+
+
+def test_enable_disable_cycles_release_registries(tmp_path):
+    """close() unregisters its atexit hook, so cycling enable/disable
+    (the bench A/B, a server toggling telemetry) must not pin one dead
+    registry per cycle for process lifetime.  (atexit._ncallbacks does
+    not decrement on unregister in this CPython — slots are cleared,
+    not compacted — so pin the actual property: the objects die.)"""
+    import gc
+    import weakref
+
+    refs = []
+    for _ in range(5):
+        tel = telemetry.enable(str(tmp_path), rank=0)
+        tel.gauge("g", 1.0)
+        refs.append(weakref.ref(tel))
+        del tel
+        telemetry.disable()
+    gc.collect()
+    assert all(r() is None for r in refs), "closed registries still pinned"
+
+
+def test_torn_tail_is_skipped(tmp_path):
+    """A reader racing a live writer sees whole lines or nothing — and a
+    torn final line (simulated) must be skipped, not crash the merge."""
+    tel = telemetry.Telemetry(str(tmp_path), rank=0)
+    tel.gauge("g", 1.0, phase="train")
+    tel.close()
+    with open(tel.path, "a") as f:
+        f.write('{"type": "gauge", "name": "torn", "ph')  # no newline/end
+    (epoch, recs), = telemetry.read_run(str(tmp_path))
+    assert [r["name"] for r in recs] == ["g"]
+
+
+# -- the zero-overhead contract ---------------------------------------------
+
+def test_telemetry_off_is_bitwise_free_and_compile_parity(tmp_path):
+    """THE acceptance pin: telemetry disabled (the default) is free —
+    the 3-step loss trajectory is bitwise-identical to a run with the
+    registry enabled and streaming, and the compile count is identical
+    (the per-step scalars ride the in-scan health-flag output, so
+    toggling telemetry changes NO compiled program)."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, 1).astype(np.int32)
+
+    def run():
+        tr = LMTrainer(LMTrainConfig(model=model, dp=2, fsdp=True,
+                                     compute_dtype=None))
+        losses = [float(tr.train_step(toks, tgts)) for _ in range(3)]
+        compiles = (tr.step_fn._cache_size()
+                    if hasattr(tr.step_fn, "_cache_size") else None)
+        return losses, compiles, np.asarray(tr.last_metrics)
+
+    off_losses, off_compiles, off_mets = run()
+    telemetry.enable(str(tmp_path), rank=0)
+    on_losses, on_compiles, on_mets = run()
+    telemetry.disable()
+
+    assert off_losses == on_losses  # bitwise
+    assert off_compiles == on_compiles
+    np.testing.assert_array_equal(off_mets, on_mets)
+    # the metrics are real: finite positive norms, shape (2,)
+    assert off_mets.shape == (2,) and np.all(off_mets > 0)
+    assert np.all(np.isfinite(off_mets))
+    # the enabled run streamed the step instrumentation
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["counters"]["rank0/train/steps"] == 3
+    for g in ("loss", "grad_norm", "param_norm"):
+        assert summary["gauges"][f"rank0/train/{g}"]["count"] == 3
+    assert summary["spans"]["rank0/train/lm_train_step"]["count"] == 3
+    # the streamed loss gauges ARE the returned losses
+    last = summary["gauges"]["rank0/train/loss"]["last"]
+    assert last == on_losses[-1]
+
+
+# -- instrument fan-in -------------------------------------------------------
+
+def test_phase_timer_reemits_spans(tmp_path):
+    from distributed_pytorch_tpu.utils.tracing import PhaseTimer
+
+    timer = PhaseTimer()
+    timer.add("host_plan", 0.002)  # off: no registry, no records
+    telemetry.enable(str(tmp_path), rank=0)
+    with timer.phase("dispatch"):
+        pass
+    timer.add("fetch", 0.004)
+    telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    assert "rank0/serve/dispatch" in summary["spans"]
+    assert summary["spans"]["rank0/serve/fetch"]["count"] == 1
+    assert "rank0/serve/host_plan" not in summary["spans"]
+
+
+def test_metric_windows_feed_gauges(tmp_path):
+    from distributed_pytorch_tpu.utils.metrics import (IterTimeMeter,
+                                                       LossMeter)
+
+    telemetry.enable(str(tmp_path), rank=0)
+    lm, tm = LossMeter(), IterTimeMeter()
+    for i in range(40):
+        lm.update(i, 2.0)
+        tm.update(i, 0.5)
+    telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    # 40 iters = two loss windows (20) + one time window (40, iter-0
+    # excluded -> first divisor 39), same values the meters print
+    assert summary["gauges"]["rank0/train/window_loss"]["count"] == 2
+    assert summary["gauges"]["rank0/train/window_loss"]["last"] == 2.0
+    assert summary["gauges"][
+        "rank0/train/window_iter_seconds"]["count"] == 1
+    assert summary["gauges"][
+        "rank0/train/window_iter_seconds"]["last"] == 0.5
+
+
+def test_sentry_escalations_land_as_events(tmp_path):
+    from distributed_pytorch_tpu.utils.sentry import (SentryConfig,
+                                                      TrainingSentry)
+
+    class _FakeTrainer:
+        _step = 0
+        params = {"w": jnp.zeros((2,))}
+
+        def train_step(self, loss):
+            self._step += 1
+            self.last_ok = np.float32(1.0)
+            return jnp.float32(loss)
+
+    telemetry.enable(str(tmp_path), rank=0)
+    tr = _FakeTrainer()
+    sentry = TrainingSentry(tr, SentryConfig(max_rollbacks=5),
+                            log=lambda *a: None)
+    assert sentry.step(1.0) == 1.0
+    assert sentry.step(float("nan")) is None  # nonfinite -> rollback
+    telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["events"]["rank0/sentry/sentry_trigger"]["count"] == 1
+    assert summary["events"]["rank0/sentry/sentry_rollback"]["count"] == 1
+
+
+def test_checkpoint_io_lands_as_spans(tmp_path):
+    from distributed_pytorch_tpu.utils.checkpoint import (
+        PyTreeCheckpointer, ShardedCheckpointer)
+
+    telemetry.enable(str(tmp_path / "tel"), rank=0)
+    trees = {"p": {"w": jnp.arange(64, dtype=jnp.float32)}}
+    ck = PyTreeCheckpointer(str(tmp_path / "npz"))
+    ck.save(trees, 1)
+    ck.wait()
+    ck.restore(trees)
+    sck = ShardedCheckpointer(str(tmp_path / "sh"))
+    sck.save(trees, 1)
+    sck.load_resharded(trees)
+    telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path / "tel"))
+    saves = summary["spans"]["rank0/ckpt/ckpt_save"]
+    assert saves["count"] == 2  # npz + sharded
+    assert summary["spans"]["rank0/ckpt/ckpt_restore"]["count"] == 1
+    assert summary["spans"]["rank0/ckpt/ckpt_reshard"]["count"] == 1
+    # bytes ride the span args (check the raw records)
+    recs = [r for _, rs in telemetry.read_run(str(tmp_path / "tel"))
+            for r in rs if r["name"] == "ckpt_save"]
+    assert all(r["args"]["bytes"] > 0 for r in recs)
+
+
+# -- CLI surface / summary script -------------------------------------------
+
+def test_telemetry_dir_flags_on_all_entry_points():
+    from distributed_pytorch_tpu import cli, lm_cli
+    from distributed_pytorch_tpu import launch
+
+    for mod in (cli, lm_cli, launch):
+        args = mod.build_parser().parse_args(
+            ["--telemetry-dir", "/tmp/t"]
+            + (["--", "-c", "pass"] if mod is launch else []))
+        assert args.telemetry_dir == "/tmp/t"
+        assert mod.build_parser().parse_args(
+            [] if mod is not launch
+            else ["--", "-c", "pass"]).telemetry_dir is None
+
+
+def test_maybe_enable_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv(telemetry.TELEMETRY_DIR_ENV, raising=False)
+    assert telemetry.maybe_enable() is None
+    assert telemetry.active() is None
+    monkeypatch.setenv(telemetry.TELEMETRY_DIR_ENV, str(tmp_path))
+    assert telemetry.maybe_enable() is not None  # the launcher contract
+    telemetry.disable()
+
+
+def test_enable_from_cli_rank_precedence(tmp_path, monkeypatch):
+    """The ONE CLI bootstrap: env RANK (the launcher contract — right
+    even for CPU-simulation gang members whose process_index is always
+    0) beats jax.process_index(), which is the launcher-less fallback."""
+    monkeypatch.setenv("RANK", "7")
+    tel = telemetry.enable_from_cli(str(tmp_path))
+    assert tel is not None and tel.rank == 7
+    telemetry.disable()
+    monkeypatch.delenv("RANK", raising=False)
+    tel = telemetry.enable_from_cli(str(tmp_path))
+    # jax is imported in this process: falls back to process_index (0)
+    assert tel is not None and tel.rank == 0
+    telemetry.disable()
+    monkeypatch.delenv(telemetry.TELEMETRY_DIR_ENV, raising=False)
+    assert telemetry.enable_from_cli(None) is None  # off by default
+
+
+def test_summary_script_tables_and_chrome_trace(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import telemetry_summary
+    finally:
+        sys.path.pop(0)
+    tel = telemetry.Telemetry(str(tmp_path), rank=0)
+    with tel.span("train_steps", phase="train"):
+        pass
+    tel.counter("steps", 1, phase="train")
+    tel.event("gang_resize", phase="gang", kind="shrink")
+    tel.close()
+    out_json = str(tmp_path / "trace.json")
+    rc = telemetry_summary.main([str(tmp_path), "--chrome-trace",
+                                 out_json])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "train/train_steps" in out and "gang_resize" in out
+    with open(out_json) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"]
+    # --json mode emits the machine-readable summary
+    rc = telemetry_summary.main([str(tmp_path), "--json"])
+    assert rc == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["counters"]["rank0/train/steps"] == 1
+
+
+def test_launch_agent_stays_jax_free_with_telemetry():
+    """The agent imports telemetry + structured logging now — and must
+    STILL never import jax (it supervises workers; it must not compete
+    for chips).  utils/__init__ resolves submodules lazily for exactly
+    this."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import distributed_pytorch_tpu.launch, sys; "
+         "from distributed_pytorch_tpu.utils import telemetry, logging; "
+         "assert 'jax' not in sys.modules, 'jax leaked into the agent'"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert proc.returncode == 0, proc.stderr
+
+
+# -- the round-13 logging fix ------------------------------------------------
+
+def test_log_rank_resolves_lazily_per_record(monkeypatch):
+    """The rank used to be baked into the format string at the first
+    setup_logging call and kept stale by the idempotent early-return;
+    now a logging.Filter resolves it PER RECORD, so a process
+    configured before jax.distributed init (or respawned into a new
+    generation) logs its current rank."""
+    from distributed_pytorch_tpu.utils import logging as ulog
+
+    ulog.setup_logging()
+    ulog.setup_logging()  # idempotent: still one stdout + one stderr pair
+    root = pylogging.getLogger("distributed_pytorch_tpu")
+    assert len(root.handlers) == 2
+    handler = root.handlers[0]          # stdout (INFO/WARNING)
+    err_handler = root.handlers[1]      # stderr (ERROR+): a supervisor
+    assert err_handler.level == pylogging.ERROR  # capturing stderr still
+    assert err_handler.stream is sys.stderr      # sees gang failures
+
+    def fmt() -> str:
+        rec = pylogging.LogRecord("distributed_pytorch_tpu.t", 20,
+                                  __file__, 1, "hello", (), None)
+        assert handler.filter(rec)  # runs RankFilter + the level gate
+        return handler.formatter.format(rec)
+
+    # the stdout handler refuses ERROR records (they belong to stderr)
+    err_rec = pylogging.LogRecord("distributed_pytorch_tpu.t", 40,
+                                  __file__, 1, "boom", (), None)
+    assert not handler.filter(err_rec)
+    assert err_handler.filter(err_rec)
+
+    monkeypatch.delenv("RANK", raising=False)
+    assert "rank0 " in fmt()
+    monkeypatch.setenv("RANK", "3")
+    assert "rank3 " in fmt()  # same handler, NEW rank — lazily resolved
+    monkeypatch.setenv("RANK", "5")
+    assert "rank5 " in fmt()
+    monkeypatch.setenv("RANK", "bogus")
+    assert "rank0 " in fmt()  # unparsable env falls back, never raises

@@ -60,6 +60,7 @@ from distributed_pytorch_tpu.lm import (  # noqa: E402
     IGNORE, LMTrainConfig, LMTrainer)
 from distributed_pytorch_tpu.models import transformer as tfm  # noqa: E402
 from distributed_pytorch_tpu.parallel import elastic as el  # noqa: E402
+from distributed_pytorch_tpu.utils import telemetry  # noqa: E402
 from distributed_pytorch_tpu.utils.checkpoint import (  # noqa: E402
     ShardedCheckpointer)
 
@@ -100,6 +101,12 @@ def main() -> int:
     ectx = el.ElasticContext.from_env()
     hb = (el.Heartbeat(ectx.run_dir, rank, gen)
           if ectx is not None else None)
+    # unified telemetry (round 13): on when the agent/test exported
+    # TELEMETRY_DIR — train-step spans/gauges and checkpoint IO then
+    # land on the same generation-tagged timeline as the agent's gang
+    # events (every record is written through per-record atomic appends,
+    # so the drain path's os._exit loses nothing)
+    telemetry.maybe_enable()
 
     model = tfm.TransformerConfig(vocab_size=VOCAB, d_model=32, n_layers=1,
                                   n_heads=2, head_dim=16, d_ff=64)
@@ -144,6 +151,9 @@ def main() -> int:
         if guard.sync():
             print(f"worker rank={rank} gen={gen} DRAIN at step {step}",
                   flush=True)
+            tel = telemetry.active()
+            if tel is not None:
+                tel.event("worker_drain", phase="gang", step=step)
             el.drain_exit(lambda: save(step))
         loss = float(tr.train_step(*_batch(sampler, step)))
         assert np.isfinite(loss), (step, loss)
