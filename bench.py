@@ -936,6 +936,102 @@ def bench_serving(reps: int = 5, kv_dtype: str | None = None) -> dict:
             "utilization": util, "emitted_per_slot_step": eps}
 
 
+def canon_fleet_env(value: str | None) -> bool:
+    """Validate the BENCH_FLEET knob: '1' runs the round-14 serving-
+    fleet gate (prefix-aware router over 2 replicas + a disaggregated
+    prefill->decode handoff pass), unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_FLEET", value, default=False,
+        guess="whether to run the serving-fleet gate")
+
+
+def bench_serve_fleet(reps: int = 3, kv_dtype: str | None = None) -> dict:
+    """Serving-fleet gate (round 14, BENCH_FLEET=1), two passes over the
+    same compiled model (fns shared via ``warm_clone`` per replica):
+
+    1. **routed throughput** — a 2-replica unified fleet serves a mixed
+       workload (6 prompts sharing one full 512-token page + distinct
+       tails, 6 short prompts) after a seed request registers the shared
+       page on one replica, so the shared-prefix requests route
+       prefix-aware while the short ones fall back to LPT.  Median
+       tok/s over ``reps`` fresh fleets (hardened-window discipline) ->
+       ``fleet_tokens_per_sec``; the measuring run's placement split ->
+       ``fleet_prefix_hit_rate`` (routed_prefix / routed, seed
+       included).
+    2. **handoff cost** — a disaggregated fleet (replica 0 prefill,
+       replica 1 decode) serves short requests, so EVERY request crosses
+       pools as a paged-KV handoff; mean wall ms per handoff (export
+       gather + admit) -> ``fleet_handoff_ms``."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+    import bench_serving as bs
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.fleet import make_fleet
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
+                                n_heads=8, head_dim=64, d_ff=2048)
+    params = tfm.init(jax.random.key(0), cfg)
+    on_tpu = jax.default_backend() != "cpu"
+
+    def make():
+        # no prefill_chunk: prefix_cache refuses to compose with chunked
+        # admission (serve.py) — shared-prefix admits are already one
+        # suffix-sized dispatch
+        return ContinuousBatcher(
+            params, cfg, slots=4, max_len=1024, temperature=0.0,
+            dtype=jnp.bfloat16 if on_tpu else None,
+            prompt_buckets=(32, 544), steps_per_sync=8,
+            schedule="longest_first", paged=True, prefix_cache=True,
+            kv_dtype=kv_dtype)
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, 4096, 512).astype(np.int32)  # one full page
+
+    def tail(n):
+        return np.concatenate(
+            [shared, rng.integers(0, 4096, n).astype(np.int32)])
+
+    prompts = ([tail(16 + 2 * i) for i in range(6)]
+               + [rng.integers(0, 4096, 16 + 2 * i).astype(np.int32)
+                  for i in range(6)])
+    budgets = [24] * len(prompts)
+
+    cold = make()
+    bs.run(cold, [tail(16), prompts[6]], [8, 8])  # compile both buckets
+    factory = lambda: bs.warm_clone(cold, make)  # noqa: E731
+
+    runs = []
+    for _ in range(reps):
+        fleet = make_fleet(factory, 2)
+        try:
+            fleet.run([tail(8)], 8)  # seed: register the shared page
+            runs.append(bs.run_fleet(fleet, prompts, budgets))
+        finally:
+            fleet.close()
+    ts = sorted(r["tok_per_s"] for r in runs)
+    p50 = ts[len(ts) // 2]
+    hit_rate = runs[0]["prefix_hit_rate"]  # deterministic placement
+
+    fleet = make_fleet(factory, 2, disaggregate=True)
+    try:
+        hand = bs.run_fleet(fleet, prompts[6:], budgets[6:])
+    finally:
+        fleet.close()
+    _log(f"[bench] serving fleet: {p50:.1f} tok/s p50 routed over 2 "
+         f"replicas ({reps} reps, range {ts[0]:.1f}-{ts[-1]:.1f}), "
+         f"prefix hit rate {hit_rate:.1%}, disaggregated handoff "
+         f"{hand['handoff_ms']:.1f} ms mean over {hand['handoffs']} "
+         f"handoffs (kv={kv_dtype or 'default'})")
+    return {"tok_per_s": p50, "prefix_hit_rate": hit_rate,
+            "handoff_ms": hand["handoff_ms"],
+            "handoffs": hand["handoffs"]}
+
+
 # Reference-semantics torch-CPU throughput: fallback constant for when torch
 # is unavailable, measured with the windowed metric below (BASELINE.md
 # records the methodology and the live-host measurement).
@@ -1034,6 +1130,10 @@ def main() -> None:
     # Telemetry-overhead knob (round 13), validated loudly pre-bench:
     # BENCH_TELEMETRY=1 A/Bs the unified event stream on vs off.
     run_telemetry = canon_telemetry_env(os.environ.get("BENCH_TELEMETRY"))
+    # Serving-fleet knob (round 14), validated loudly pre-bench:
+    # BENCH_FLEET=1 runs the routed-throughput + disaggregated-handoff
+    # passes over a 2-replica fleet.
+    run_fleet = canon_fleet_env(os.environ.get("BENCH_FLEET"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -1104,6 +1204,15 @@ def main() -> None:
             telemetry_ab = bench_train_telemetry()
         except Exception as e:
             _log(f"[bench] telemetry A/B failed ({e}); omitting")
+
+    # Serving-fleet gate (round 14): routed throughput + prefix hit
+    # rate + disaggregated handoff cost; optional like the other gates.
+    fleet_ab = None
+    if run_fleet:
+        try:
+            fleet_ab = bench_serve_fleet(kv_dtype=kv_dtype)
+        except Exception as e:
+            _log(f"[bench] serving-fleet gate failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -1262,6 +1371,17 @@ def main() -> None:
         "serving_emitted_per_slot_step": (
             round(serve["emitted_per_slot_step"], 4)
             if serve is not None else None),
+        # serving-fleet gate (round 14, BENCH_FLEET=1): median routed
+        # tok/s over a 2-replica fleet, the measuring run's
+        # prefix-aware placement rate (routed_prefix / routed), and the
+        # mean wall ms one paged-KV handoff costs on the disaggregated
+        # prefill->decode pass.  All null when the gate is skipped.
+        "fleet_tokens_per_sec": (round(fleet_ab["tok_per_s"], 1)
+                                 if fleet_ab is not None else None),
+        "fleet_prefix_hit_rate": (round(fleet_ab["prefix_hit_rate"], 4)
+                                  if fleet_ab is not None else None),
+        "fleet_handoff_ms": (round(fleet_ab["handoff_ms"], 3)
+                             if fleet_ab is not None else None),
     }), flush=True)
 
 

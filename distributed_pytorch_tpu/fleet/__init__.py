@@ -1,0 +1,24 @@
+"""Disaggregated serving fleet: router -> replicas -> paged-KV handoff.
+
+The single-batcher serving stack (serve.py) scaled one pool; this
+package scales POOLS.  ``FleetRouter`` places requests over N
+``BatcherReplica`` members — prefix-aware (the replica already holding
+the prompt's pages), session-sticky, LPT otherwise — and ``KVHandoff``
+moves a live request's paged KV between pools (prefill->decode
+disaggregation, graceful drain, loss rescue) without recompute.
+
+    from distributed_pytorch_tpu.fleet import make_fleet
+    fleet = make_fleet(make_batcher, n=2)
+    gid = fleet.submit(prompt, max_new=128)
+    while fleet.pending():
+        for gid, tok in fleet.step():
+            ...
+    out = fleet.result(gid)
+"""
+
+from .handoff import KVHandoff
+from .replica import ROLES, BatcherReplica
+from .router import FleetRouter, make_fleet
+
+__all__ = ["KVHandoff", "BatcherReplica", "FleetRouter", "make_fleet",
+           "ROLES"]

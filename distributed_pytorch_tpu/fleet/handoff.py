@@ -1,0 +1,117 @@
+"""KVHandoff: one in-flight request as a portable, serializable unit.
+
+The paged pool (serve.py) makes a request's cache a compact object:
+``n_pages`` fixed-size pages per leaf — K/V slabs plus, under
+``kv_dtype="int8"``, the per-row f32 scale leaves — a block-table
+position, and a few scalars of generation state.  ``export_request``
+fetches exactly that through the host-swap gather path (one awaited
+dispatch), and ``import_request`` re-enters it into another batcher
+through the host-swap scatter/refill path (``_resume_swapped``), so a
+prefill->decode or drain->re-admit handoff is a page transfer, not a
+recompute, and the continued stream is token-exact.
+
+Requests that never produced portable KV (still queued / mid-chunked-
+prefill, or on a dense cache) hand off with ``kv=None``: the prompt +
+sampling state + emitted prefix still travel, and the receiving side
+re-prefills (the router's fallback for hard replica loss, where the
+pages died with the replica).
+
+``to_bytes``/``from_bytes`` give a wire format (one ``np.savez``
+archive, no pickle) for when replicas stop sharing a process.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class KVHandoff:
+    """The payload of ``ContinuousBatcher.export_request`` (field-for-
+    field), plus ``export_s`` — the wall seconds the export's gather
+    took, so the router can report end-to-end handoff latency."""
+
+    prompt: np.ndarray            # (L,) int32
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    eos_id: int | None
+    emitted: list = field(default_factory=list)
+    # per cache leaf: (n_pages, hkv, page, *) host arrays — K/V slabs
+    # and (int8 pools) their f32 scale leaves; None = re-prefill
+    kv: list | None = None
+    n_pages: int = 0
+    pos: int = 0                  # last written cache position
+    poff: int = 0                 # prompt progress (mid-prefill exports)
+    last_tok: int = 0
+    export_s: float = 0.0
+
+    # -- batcher round-trip ------------------------------------------------
+    @classmethod
+    def extract(cls, cb, rid: int) -> "KVHandoff | None":
+        """Export ``rid`` from ``cb`` (``ContinuousBatcher``).  None when
+        the request completed inside the in-flight block the export had
+        to flush — its result is final on ``cb``."""
+        t0 = time.perf_counter()
+        state = cb.export_request(rid)
+        if state is None:
+            return None
+        return cls(export_s=time.perf_counter() - t0, **state)
+
+    def admit(self, cb) -> int:
+        """Admit into ``cb``; returns the LOCAL rid there."""
+        return cb.import_request(self.to_state())
+
+    def to_state(self) -> dict:
+        return {"prompt": self.prompt, "max_new": self.max_new,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "eos_id": self.eos_id,
+                "emitted": list(self.emitted), "kv": self.kv,
+                "n_pages": self.n_pages, "pos": self.pos,
+                "poff": self.poff, "last_tok": self.last_tok}
+
+    @property
+    def nbytes(self) -> int:
+        """Payload size (prompt + KV pages), for transfer accounting."""
+        n = int(np.asarray(self.prompt).nbytes)
+        if self.kv is not None:
+            n += sum(int(np.asarray(x).nbytes) for x in self.kv)
+        return n
+
+    # -- wire format -------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """One ``np.savez`` archive: arrays stay arrays (dtypes exact —
+        the int8 pages must not round-trip through JSON), scalars ride a
+        JSON metadata record.  No pickle anywhere."""
+        meta = {"max_new": int(self.max_new),
+                "temperature": float(self.temperature),
+                "top_k": int(self.top_k), "top_p": float(self.top_p),
+                "eos_id": self.eos_id,
+                "emitted": [int(t) for t in self.emitted],
+                "n_pages": int(self.n_pages), "pos": int(self.pos),
+                "poff": int(self.poff), "last_tok": int(self.last_tok),
+                "n_kv": -1 if self.kv is None else len(self.kv)}
+        arrays = {"meta": np.frombuffer(
+            json.dumps(meta).encode(), np.uint8),
+            "prompt": np.asarray(self.prompt, np.int32)}
+        if self.kv is not None:
+            for i, x in enumerate(self.kv):
+                arrays[f"kv_{i}"] = np.asarray(x)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KVHandoff":
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            n_kv = meta.pop("n_kv")
+            kv = (None if n_kv < 0
+                  else [z[f"kv_{i}"] for i in range(n_kv)])
+            return cls(prompt=z["prompt"], kv=kv, **meta)

@@ -1,0 +1,229 @@
+"""BatcherReplica: one ContinuousBatcher behind the fleet's queue face.
+
+A replica wraps one batcher in a role — ``"unified"`` (prefill and
+decode), ``"prefill"`` (admits fresh prompts, exports each request as a
+``KVHandoff`` as soon as its first tokens exist), or ``"decode"``
+(accepts only handoffs, never fresh prompts) — behind a
+submit / poll / drain interface keyed by GLOBAL request ids (gids):
+local rids stay private to the batcher, so a request keeps its identity
+as it moves between replicas.
+
+Liveness is published the way elastic workers publish it
+(parallel/elastic.Heartbeat): one atomic ``hb_rank<replica>.json`` per
+poll tick.  An injected ``replica_loss`` fault
+(utils/faults.maybe_kill_replica) flips the replica dead mid-poll — its
+pool is treated as lost, exactly like a process death — and the router
+rescues its requests.
+
+When the process telemetry registry is active (utils/telemetry.py),
+each replica keeps its OWN registry in the same run_dir with
+rank = replica id — so every replica is its own pid lane in the merged
+Chrome trace, alongside the ranks of a training run.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..parallel.elastic import Heartbeat
+from ..utils import faults, telemetry
+from .handoff import KVHandoff
+
+ROLES = ("unified", "prefill", "decode")
+
+
+class BatcherReplica:
+    """One fleet member.  ``make_batcher`` is either a ready
+    ``ContinuousBatcher`` or a zero-arg factory (the factory form lets
+    the router build replicas lazily and bench share compiled fns via
+    ``warm_clone``)."""
+
+    def __init__(self, replica_id: int, make_batcher, *,
+                 role: str = "unified", hb_dir: str | None = None,
+                 hb_min_interval_s: float = 0.0):
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; one of {ROLES}")
+        self.replica_id = replica_id
+        self.role = role
+        self.cb = make_batcher() if callable(make_batcher) else make_batcher
+        self.alive = True
+        self.accepting = True       # False once drained/retired
+        self._tick = 0
+        self._gid_rid: dict[int, int] = {}
+        self._rid_gid: dict[int, int] = {}
+        # tokens already DELIVERED upstream per gid (a handoff arrives
+        # with its emitted prefix; poll must not re-report it)
+        self._delivered: dict[int, int] = {}
+        self._done: set[int] = set()
+        self.heartbeat = (Heartbeat(hb_dir, replica_id, 0,
+                                    min_interval_s=hb_min_interval_s)
+                          if hb_dir else None)
+        self.tel = None
+        host = telemetry.active()
+        if host is not None:
+            # own registry, own rank -> own pid lane in the merged trace
+            self.tel = telemetry.Telemetry(
+                host.run_dir, rank=replica_id, gen=host.gen,
+                label=f"replica {replica_id}",
+                tag=f"_replica{replica_id}")
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, gid: int, prompt, max_new: int, **kw) -> None:
+        """Admit a fresh prompt under global id ``gid``."""
+        if self.role == "decode":
+            raise RuntimeError(
+                f"replica {self.replica_id} is decode-only: it accepts "
+                f"KV handoffs, not fresh prompts")
+        rid = self.cb.submit(prompt, max_new, **kw)
+        self._bind(gid, rid, delivered=0)
+
+    def admit(self, handoff: KVHandoff, gid: int) -> None:
+        """Admit a handed-off request under its global id."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                f"replica {self.replica_id} is prefill-only: handoffs "
+                f"flow OUT of it")
+        rid = handoff.admit(self.cb)
+        self._bind(gid, rid, delivered=len(handoff.emitted))
+        if self.tel is not None:
+            self.tel.event("handoff_in", phase="fleet", gid=gid,
+                           pages=handoff.n_pages,
+                           bytes=handoff.nbytes)
+
+    def _bind(self, gid: int, rid: int, *, delivered: int) -> None:
+        self._gid_rid[gid] = rid
+        self._rid_gid[rid] = gid
+        self._delivered[gid] = delivered
+
+    # -- scheduling signals ------------------------------------------------
+    def load(self) -> int:
+        """Outstanding emission budget (LPT's processing-time proxy):
+        remaining tokens over every live request this replica holds."""
+        total = 0
+        for rid in self._gid_rid.values():
+            req = self.cb.requests.get(rid)
+            if req is not None and not req.done:
+                total += req.max_new - len(req.emitted)
+        return total
+
+    def page_hashes(self):
+        """The replica's page-hash index: prefix-chain keys its prefix
+        registry currently holds (empty when prefix caching is off) —
+        what the router scores prefix-aware placement against."""
+        if not getattr(self.cb, "prefix_cache", False):
+            return frozenset()
+        return frozenset(self.cb.registry)
+
+    def pending(self) -> bool:
+        return self.alive and self.cb.pending()
+
+    def result(self, gid: int):
+        return self.cb.result(self._gid_rid[gid])
+
+    # -- the poll loop -----------------------------------------------------
+    def poll(self):
+        """One scheduling turn: heartbeat, consult the chaos plan, run
+        one batcher step if work is pending, and report
+        ``(emissions, done, handoffs)`` — new ``(gid, token)`` pairs
+        beyond what was already delivered, gids that completed, and (for
+        prefill replicas) requests exported for the decode tier."""
+        if not self.alive:
+            return [], set(), []
+        self._tick += 1
+        if faults.maybe_kill_replica(self.replica_id, self._tick):
+            self.kill()
+            return [], set(), []
+        if self.heartbeat is not None:
+            self.heartbeat.beat(self._tick)
+        if self.cb.pending():
+            t0 = time.perf_counter()
+            self.cb.step()
+            if self.tel is not None:
+                self.tel.span_at("poll_step", t0,
+                                 time.perf_counter() - t0, phase="fleet")
+        emissions, done = self._scan()
+        handoffs = []
+        if self.role == "prefill":
+            # first token(s) exist -> the decode tier takes over
+            for gid in [g for g, rid in self._gid_rid.items()
+                        if g not in self._done
+                        and (req := self.cb.requests.get(rid)) is not None
+                        and not req.done and req.emitted]:
+                h = self.export(gid)
+                if h is not None:
+                    handoffs.append((gid, h))
+        return emissions, done, handoffs
+
+    def _scan(self):
+        """Diff every bound request's emitted list against what was
+        already delivered upstream — robust to tokens that land outside
+        ``step()``'s return (in-flight flushes during an export)."""
+        emissions: list[tuple[int, int]] = []
+        done: set[int] = set()
+        for gid, rid in list(self._gid_rid.items()):
+            if gid in self._done:
+                continue
+            req = self.cb.requests.get(rid)
+            if req is None:
+                continue  # exported between polls
+            seen = self._delivered[gid]
+            for tok in req.emitted[seen:]:
+                emissions.append((gid, int(tok)))
+            self._delivered[gid] = len(req.emitted)
+            if req.done:
+                done.add(gid)
+                self._done.add(gid)
+        return emissions, done
+
+    # -- handoff / drain / loss --------------------------------------------
+    def export(self, gid: int) -> KVHandoff | None:
+        """Extract ``gid`` as a handoff (the request leaves this
+        replica).  None when it completed during the export's in-flight
+        flush — the completion surfaces through the next ``poll``."""
+        rid = self._gid_rid[gid]
+        h = KVHandoff.extract(self.cb, rid)
+        if h is None:
+            return None
+        del self._gid_rid[gid]
+        del self._rid_gid[rid]
+        del self._delivered[gid]
+        if self.tel is not None:
+            self.tel.event("handoff_out", phase="fleet", gid=gid,
+                           pages=h.n_pages, bytes=h.nbytes)
+        return h
+
+    def drain(self) -> list[tuple[int, KVHandoff]]:
+        """Graceful retirement: stop accepting work and export every
+        live request as a handoff (in-flight blocks are flushed first,
+        so nothing is mid-air).  Completions the flush itself produced
+        stay here and surface through the next ``poll``."""
+        self.accepting = False
+        out = []
+        for gid in [g for g in list(self._gid_rid)
+                    if g not in self._done]:
+            rid = self._gid_rid[gid]
+            req = self.cb.requests.get(rid)
+            if req is None or req.done:
+                continue
+            h = self.export(gid)
+            if h is not None:
+                out.append((gid, h))
+        return out
+
+    def kill(self) -> None:
+        """Simulated hard loss: the pool (and every un-exported page in
+        it) is gone.  State is NOT drained — the router re-prefills."""
+        self.alive = False
+        self.accepting = False
+        if self.tel is not None:
+            self.tel.event("replica_killed", phase="fleet",
+                           tick=self._tick)
+
+    def orphans(self) -> list[int]:
+        """Gids lost with the pool (bound, not completed) — what the
+        router must rescue after ``kill``."""
+        return [g for g in self._gid_rid if g not in self._done]
+
+    def close(self) -> None:
+        if self.tel is not None:
+            self.tel.close()

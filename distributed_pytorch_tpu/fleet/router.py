@@ -1,0 +1,300 @@
+"""FleetRouter: prefix-aware request placement over N batcher replicas.
+
+Placement precedence per fresh request:
+
+1. **session affinity** — a session that already landed on a live,
+   accepting replica stays there (its earlier turns' pages live in that
+   replica's pool even when the registry has since evicted the chain);
+2. **prefix-aware** — the replica whose page-hash index holds the
+   LONGEST chain prefix of the prompt's page hashes (serve.py's
+   ``prefix_page_hashes`` — the same chains the batcher's prefix cache
+   registers, so a hit here IS a shared-page admission there);
+3. **LPT fallback** — least outstanding emission budget, the same
+   longest-processing-time discipline the batcher's ``longest_first``
+   schedule applies within one pool.
+
+Handoffs (disaggregated prefill->decode, graceful ``drain``) move a
+request's KV pages between pools as a ``KVHandoff``; hard replica loss
+(``utils/faults.py`` ``replica_loss``, or a stale heartbeat) loses the
+pool, so the router rescues orphans by re-prefilling prompt+emitted
+with the remaining budget on a surviving replica — either way the
+reassembled stream is token-exact, with zero lost or duplicated tokens.
+
+The router is single-threaded by design: ``step()`` polls every live
+replica once.  It is a scheduling layer, not a transport — replicas
+share the process here; ``KVHandoff.to_bytes`` is the wire format for
+when they stop doing so.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..serve import prefix_page_hashes
+from ..utils import telemetry
+from .handoff import KVHandoff
+from .replica import BatcherReplica
+
+
+class FleetRouter:
+    def __init__(self, replicas: list[BatcherReplica], *,
+                 hb_stale_s: float | None = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = {r.replica_id: r for r in replicas}
+        if len(self.replicas) != len(replicas):
+            raise ValueError("duplicate replica ids")
+        self.hb_stale_s = hb_stale_s
+        self._next_gid = 0
+        # gid -> the router's own view of the stream: everything needed
+        # to reassemble the result and to re-prefill after a hard loss
+        self._streams: dict[int, dict] = {}
+        self._sessions: dict[object, int] = {}
+        self._rescued_replicas: set[int] = set()
+        self.stats = {"routed_affinity": 0, "routed_prefix": 0,
+                      "routed_lpt": 0, "handoffs": 0, "handoff_ms": 0.0,
+                      "rescued": 0, "replicas_lost": 0}
+        self.tel = None
+        host = telemetry.active()
+        if host is not None:
+            self.tel = telemetry.Telemetry(
+                host.run_dir, rank=-2, gen=host.gen, label="router",
+                tag="_router")
+
+    # -- placement ---------------------------------------------------------
+    def _intake(self, exclude: int | None = None
+                ) -> list[BatcherReplica]:
+        return [r for r in self.replicas.values()
+                if r.alive and r.accepting and r.role != "decode"
+                and r.replica_id != exclude]
+
+    def _route(self, prompt: np.ndarray, session=None,
+               exclude: int | None = None):
+        """(replica, how) for a fresh prompt — affinity, then longest
+        shared prefix chain, then least loaded."""
+        cands = self._intake(exclude)
+        if not cands:
+            raise RuntimeError("no replica is accepting fresh prompts")
+        if session is not None:
+            rid = self._sessions.get(session)
+            home = self.replicas.get(rid)
+            if home is not None and home in cands:
+                return home, "affinity"
+        best, best_score = None, 0
+        hashes: dict[int, list[bytes]] = {}  # per page size
+        for r in cands:
+            keys = r.page_hashes()
+            if not keys:
+                continue
+            page = r.cb.page
+            hs = hashes.get(page)
+            if hs is None:
+                hs = prefix_page_hashes(prompt, page)
+                if hs and len(prompt) % page == 0:
+                    # the batcher always leaves >= 1 suffix token to
+                    # prefill (_prefix_lookup) — score what it can use
+                    hs = hs[:-1]
+                hashes[page] = hs
+            score = 0
+            for h in hs:
+                if h not in keys:
+                    break
+                score += 1
+            if score > best_score or (
+                    score == best_score and score
+                    and r.load() < best.load()):
+                best, best_score = r, score
+        if best_score > 0:
+            return best, "prefix"
+        return (min(cands, key=lambda r: (r.load(), r.replica_id)),
+                "lpt")
+
+    def submit(self, prompt, max_new: int = 128, *, session=None,
+               **sampling) -> int:
+        """Route one request; returns its GLOBAL id."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rep, how = self._route(prompt, session)
+        gid = self._next_gid
+        self._next_gid += 1
+        rep.submit(gid, prompt, max_new, **sampling)
+        self.stats[f"routed_{how}"] += 1
+        self._streams[gid] = {"prompt": prompt, "max_new": max_new,
+                              "sampling": dict(sampling), "tokens": [],
+                              "done": False, "replica": rep.replica_id,
+                              "session": session}
+        if session is not None:
+            self._sessions[session] = rep.replica_id
+        if self.tel is not None:
+            self.tel.event("route", phase="fleet", gid=gid, how=how,
+                           replica=rep.replica_id)
+        return gid
+
+    # -- the serving loop --------------------------------------------------
+    def step(self) -> list[tuple[int, int]]:
+        """Poll every replica once; detect losses and rescue their
+        orphans; place prefill-tier handoffs.  Returns (gid, token)
+        pairs delivered this call."""
+        out: list[tuple[int, int]] = []
+        for rep in list(self.replicas.values()):
+            if rep.alive and self._hb_stale(rep):
+                rep.kill()  # a silent replica is a lost replica
+            if not rep.alive:
+                self._rescue(rep)
+                continue
+            emissions, done, handoffs = rep.poll()
+            if not rep.alive:  # the chaos plan fired inside this poll
+                self._rescue(rep)
+                continue
+            for gid, tok in emissions:
+                self._streams[gid]["tokens"].append(tok)
+                out.append((gid, tok))
+            for gid in done:
+                self._streams[gid]["done"] = True
+            for gid, h in handoffs:
+                out.extend(self._place_handoff(gid, h,
+                                               exclude=rep.replica_id))
+        return out
+
+    def pending(self) -> bool:
+        return any(not s["done"] for s in self._streams.values())
+
+    def result(self, gid: int) -> np.ndarray:
+        s = self._streams[gid]
+        return np.concatenate([s["prompt"],
+                               np.asarray(s["tokens"], np.int32)])
+
+    def run(self, prompts, max_new: int = 128) -> dict[int, np.ndarray]:
+        """Submit every prompt, drive to completion, gid -> tokens."""
+        gids = [self.submit(p, max_new) for p in prompts]
+        while self.pending():
+            self.step()
+        return {gid: self.result(gid) for gid in gids}
+
+    # -- handoff / loss ----------------------------------------------------
+    def _decode_targets(self, exclude: int | None = None
+                        ) -> list[BatcherReplica]:
+        return [r for r in self.replicas.values()
+                if r.alive and r.accepting and r.role != "prefill"
+                and r.replica_id != exclude]
+
+    def _place_handoff(self, gid: int, h: KVHandoff,
+                       exclude: int | None = None
+                       ) -> list[tuple[int, int]]:
+        targets = self._decode_targets(exclude)
+        if not targets:
+            raise RuntimeError("no replica can take the handoff")
+        rep = min(targets, key=lambda r: (r.load(), r.replica_id))
+        s = self._streams[gid]
+        # the handoff's emitted prefix is authoritative: the export's
+        # in-flight flush can emit tokens the source replica never got
+        # to report — deliver them here, BEFORE the target replica's
+        # delivered-offset (len(h.emitted)) makes them invisible
+        late = [(gid, int(t)) for t in h.emitted[len(s["tokens"]):]]
+        s["tokens"].extend(t for _, t in late)
+        t0 = time.perf_counter()
+        rep.admit(h, gid)
+        dur = time.perf_counter() - t0
+        s["replica"] = rep.replica_id
+        self.stats["handoffs"] += 1
+        self.stats["handoff_ms"] += (h.export_s + dur) * 1e3
+        if self.tel is not None:
+            self.tel.span_at("handoff", t0 - h.export_s,
+                             h.export_s + dur, phase="fleet", gid=gid,
+                             dst=rep.replica_id, pages=h.n_pages,
+                             bytes=h.nbytes)
+        return late
+
+    def drain(self, replica_id: int) -> int:
+        """Gracefully retire a replica: flush it, move every live
+        request to a surviving replica as a KV handoff (pages travel —
+        no recompute), stop routing to it.  Returns requests moved."""
+        rep = self.replicas[replica_id]
+        moved = rep.drain()
+        for gid, h in moved:
+            self._place_handoff(gid, h, exclude=replica_id)
+        if self.tel is not None:
+            self.tel.event("replica_drained", phase="fleet",
+                           replica=replica_id, moved=len(moved))
+        return len(moved)
+
+    def readmit(self, replica_id: int) -> None:
+        """Bring a drained (still-alive) replica back into rotation."""
+        rep = self.replicas[replica_id]
+        if not rep.alive:
+            raise RuntimeError(
+                f"replica {replica_id} is dead, not drained — a lost "
+                f"pool cannot be re-admitted")
+        rep.accepting = True
+
+    def _hb_stale(self, rep: BatcherReplica) -> bool:
+        if (self.hb_stale_s is None or rep.heartbeat is None
+                or rep._tick == 0):
+            return False  # silence before the first beat = still warming
+        try:
+            with open(rep.heartbeat.path) as f:
+                beat = json.load(f)
+            return time.time() - beat["time"] > self.hb_stale_s
+        except (OSError, ValueError, KeyError):
+            return False  # a missed beat is late detection, not a death
+
+    def _rescue(self, rep: BatcherReplica) -> None:
+        """A replica died with its pool: re-prefill every orphaned
+        stream — prompt + tokens already delivered becomes the new
+        prompt, the remaining budget the new max_new — on a surviving
+        replica.  Delivered tokens were never retracted and the
+        continuation starts exactly past them: zero lost, zero
+        duplicated."""
+        if rep.replica_id in self._rescued_replicas:
+            return
+        self._rescued_replicas.add(rep.replica_id)
+        self.stats["replicas_lost"] += 1
+        if self.tel is not None:
+            self.tel.event("replica_lost", phase="fleet",
+                           replica=rep.replica_id,
+                           orphans=len(rep.orphans()))
+        for gid in rep.orphans():
+            s = self._streams[gid]
+            if s["done"]:
+                continue
+            prompt = (np.concatenate(
+                [s["prompt"], np.asarray(s["tokens"], np.int32)])
+                if s["tokens"] else s["prompt"])
+            remaining = s["max_new"] - len(s["tokens"])
+            target, how = self._route(prompt, s["session"],
+                                      exclude=rep.replica_id)
+            target.submit(gid, prompt, remaining, **s["sampling"])
+            s["replica"] = target.replica_id
+            if s["session"] is not None:
+                self._sessions[s["session"]] = target.replica_id
+            self.stats["rescued"] += 1
+            self.stats[f"routed_{how}"] += 1
+            if self.tel is not None:
+                self.tel.event("rescue", phase="fleet", gid=gid,
+                               to=target.replica_id, how=how,
+                               replayed=len(s["tokens"]))
+
+    def close(self) -> None:
+        for rep in self.replicas.values():
+            rep.close()
+        if self.tel is not None:
+            self.tel.close()
+
+
+def make_fleet(make_batcher, n: int, *, disaggregate: bool = False,
+               hb_dir: str | None = None,
+               hb_stale_s: float | None = None) -> FleetRouter:
+    """Build an N-replica fleet from a batcher factory.  Disaggregated:
+    replica 0 prefills (and exports every request as a KV handoff once
+    its first tokens exist), replicas 1..N-1 decode; otherwise every
+    replica is unified."""
+    if n < 1 or (disaggregate and n < 2):
+        raise ValueError(f"need >= {2 if disaggregate else 1} replicas")
+    roles = (["prefill"] + ["decode"] * (n - 1) if disaggregate
+             else ["unified"] * n)
+    return FleetRouter(
+        [BatcherReplica(i, make_batcher, role=roles[i], hb_dir=hb_dir)
+         for i in range(n)],
+        hb_stale_s=hb_stale_s)

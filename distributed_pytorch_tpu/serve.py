@@ -140,6 +140,26 @@ from .utils.tracing import PhaseTimer
 _INHERIT = object()
 
 
+def prefix_page_hashes(prompt, page: int) -> list[bytes]:
+    """Chain hash per FULL ``page``-token prompt page: page i's key
+    commits to tokens [0, (i+1)*page), so equal keys imply the cached
+    page's K/V was computed under the identical token context.
+    Module-level because the fleet router (fleet/router.py) scores
+    replicas by walking these same chains against each replica's page
+    registry — the router and the batcher must hash identically or
+    prefix-aware routing silently degrades to load balancing."""
+    import hashlib
+    prompt = np.asarray(prompt)
+    out: list[bytes] = []
+    h = b""
+    for i in range(len(prompt) // page):
+        h = hashlib.sha1(
+            h + prompt[i * page:(i + 1) * page]
+            .astype(np.int32).tobytes()).digest()
+        out.append(h)
+    return out
+
+
 @dataclass
 class _Request:
     rid: int
@@ -529,7 +549,11 @@ class ContinuousBatcher:
                       # prompt pages, pages reused, and registry pages
                       # reclaimed under pool pressure
                       "prefix_hits": 0, "prefix_pages_shared": 0,
-                      "prefix_reclaimed": 0}
+                      "prefix_reclaimed": 0,
+                      # fleet handoffs (export_request / import_request):
+                      # requests that left this batcher mid-flight as a
+                      # portable KV unit, and ones admitted from one
+                      "handoff_exports": 0, "handoff_imports": 0}
 
     # -- submission / results --------------------------------------------
     def submit(self, prompt, max_new: int = 128, *,
@@ -637,11 +661,16 @@ class ContinuousBatcher:
         dispatched VERIFY POSITIONS, so rejected proposals count as
         dispatched work and this reads low BY DESIGN (0.18-0.28 on the
         round-5 workloads) — use ``emitted_per_slot_step`` for the
-        acceptance-adjusted number (VERDICT r5 weak #4)."""
+        acceptance-adjusted number (VERDICT r5 weak #4).
+
+        A batcher that never dispatched a decode block (fresh, or a
+        fleet replica drained/exported before its first block) reports
+        0.0 — never a ZeroDivisionError."""
         s = self.stats
+        if s["slot_steps"] == 0:
+            return 0.0
         return ((s["emitted_tokens"] - s["batch_admissions"]
-                 + s["inblock_prefill_steps"])
-                / max(s["slot_steps"], 1))
+                 + s["inblock_prefill_steps"]) / s["slot_steps"])
 
     def emitted_per_slot_step(self) -> float:
         """ACCEPTANCE-ADJUSTED utilization: sampled emissions actually
@@ -651,10 +680,172 @@ class ContinuousBatcher:
         emissions per verify position — the number that stays meaningful
         when rejected proposals inflate ``slot_steps`` — and without
         speculation it differs from ``utilization`` only by the teacher-
-        forced in-block prefill steps."""
+        forced in-block prefill steps.  Zero dispatched blocks (a
+        drained replica) reads 0.0, as in ``utilization``."""
         s = self.stats
+        if s["slot_steps"] == 0:
+            return 0.0
         return ((s["emitted_tokens"] - s["batch_admissions"])
-                / max(s["slot_steps"], 1))
+                / s["slot_steps"])
+
+    # -- fleet handoff: export / import a request mid-flight ---------------
+    def _flush_inflight(self) -> list[tuple[int, int]]:
+        """Collect the overlapped in-flight block (if any) serially, so
+        the host bookkeeping is caught up with the device before a
+        request's state is exported.  Emissions land in each request's
+        ``emitted`` list (and are returned) — nothing is lost."""
+        out: list[tuple[int, int]] = []
+        fl, self._inflight = self._inflight, None
+        if fl is not None:
+            out += self._collect(fl)
+        return out
+
+    def export_request(self, rid: int) -> dict | None:
+        """Extract a not-yet-completed request as a portable state dict
+        (the payload of ``fleet.handoff.KVHandoff``): prompt + resolved
+        sampling parameters + tokens emitted so far, and — when the
+        request holds pool pages — its KV pages as host arrays fetched
+        through the host-swap gather path (one awaited dispatch; int8
+        scale leaves ride along as extra leaves).  The request leaves
+        this batcher entirely: its slot/pages/queue entry are released
+        and its rid forgotten.
+
+        ``kv`` is None for requests that never produced KV worth moving
+        (still queued, staged, or mid-chunked-prefill — cheaper to
+        re-prefill than to ship a partial scratch cache) and for dense
+        (non-paged) occupants, whose cache is not a portable page unit.
+        A ``kv=None`` export with emitted tokens can only continue by
+        re-prefilling prompt+emitted — ``import_request`` rejects it and
+        the fleet router owns that fallback.
+
+        Returns None when the request completed inside the in-flight
+        block this call had to flush first (its result is final — read
+        it with ``result`` before the rid is reused)."""
+        req = self.requests.get(rid)
+        if req is None:
+            raise KeyError(f"unknown request {rid}")
+        if req.done:
+            raise ValueError(f"request {rid} already completed")
+        # a dispatched-but-unfetched block may still emit for this
+        # request: collect it so the exported stream is complete
+        self._flush_inflight()
+        if req.done:
+            return None
+        state = {"prompt": np.asarray(req.prompt, np.int32),
+                 "max_new": req.max_new, "temperature": req.temperature,
+                 "top_k": req.top_k, "top_p": req.top_p,
+                 "eos_id": req.eos_id, "emitted": list(req.emitted),
+                 "kv": None, "n_pages": 0, "pos": 0, "poff": 0,
+                 "last_tok": 0}
+        if req in self.queue:
+            self.queue.remove(req)
+        elif any(req is r for r in self.staged_refill):
+            slot = next(s for s, r in enumerate(self.staged_refill)
+                        if r is req)
+            self.staged_refill[slot] = None
+            self._staged_order.remove(slot)
+            if self.paged:
+                self._release_refill_pages(slot)
+        elif any(adm.req is req for adm in self.admitting.values()):
+            # chunked prefill in progress: drop the scratch progress,
+            # the importer re-prefills from the prompt
+            slot = next(s for s, adm in self.admitting.items()
+                        if adm.req is req)
+            del self.admitting[slot]
+        elif self.paged and any(sw.req is req for sw in self.swapped):
+            # already host-swapped: the pages ARE the handoff payload
+            sw = next(sw for sw in self.swapped if sw.req is req)
+            self.swapped.remove(sw)
+            state.update(kv=[np.asarray(x) for x in sw.kv],
+                         n_pages=sw.n_pages, pos=sw.pos, poff=sw.poff,
+                         last_tok=sw.last_tok)
+        elif any(o is req for o in self.occupant):
+            slot = next(s for s, o in enumerate(self.occupant)
+                        if o is req)
+            if self.paged and self.slot_pages[slot]:
+                # the _evict gather, aimed at the handoff instead of the
+                # local resume queue.  np.array(copy=True): the payload
+                # outlives this batcher's donated cache chain, so it
+                # must own its buffers (utils/compat.py zero-copy
+                # hazard).
+                pids = np.zeros(self.pages_per_slot, np.int32)
+                n = len(self.slot_pages[slot])
+                pids[:n] = self.slot_pages[slot]
+                gather, _ = self._page_io_fns()
+                n2 = min(self._pow2(n), self.pages_per_slot)
+                kv = [np.array(x[:n], copy=True) for x in jax.device_get(
+                    gather(self.cache, jnp.asarray(pids), n2))]
+                state.update(kv=kv, n_pages=n, pos=int(self.pos[slot]),
+                             poff=int(self.slot_poff[slot]),
+                             last_tok=int(self.last_tok[slot]))
+            self.occupant[slot] = None
+            if self.paged:
+                self._release_pages(slot)
+        del self.requests[rid]
+        self.stats["handoff_exports"] += 1
+        return state
+
+    def import_request(self, state: dict) -> int:
+        """Admit a request exported by another batcher's
+        ``export_request``.  Without KV it is a plain submission (fresh
+        prefill); with KV pages it joins the host-swap resume queue and
+        re-enters the pool through the scatter/refill path
+        (``_resume_swapped``) — continuing mid-generation, token-exact,
+        with the inherited ``emitted`` prefix intact.  Returns the LOCAL
+        rid (rids are per-batcher; the fleet router maps global ids)."""
+        prompt = np.asarray(state["prompt"], np.int32).reshape(-1)
+        emitted = list(state.get("emitted") or [])
+        kv = state.get("kv")
+        if kv is None:
+            if emitted:
+                raise ValueError(
+                    "cannot import a mid-stream request without KV: "
+                    "re-prefilling prompt+emitted is the router's "
+                    "fallback (fleet/router.py), not the batcher's")
+            return self.submit(prompt, state["max_new"],
+                               temperature=state["temperature"],
+                               top_k=state["top_k"],
+                               top_p=state["top_p"],
+                               eos_id=state["eos_id"])
+        if not self.paged:
+            raise ValueError("KV handoff requires a paged batcher")
+        n_pages = int(state["n_pages"])
+        if n_pages > self.pages_per_slot:
+            raise ValueError(
+                f"handoff carries {n_pages} pages but this pool holds "
+                f"{self.pages_per_slot} per slot")
+        if len(prompt) + state["max_new"] > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {state['max_new']} "
+                f"exceeds max_len {self.max_len}")
+        leaves = jax.tree.leaves(self.cache)
+        if len(kv) != len(leaves) or any(
+                tuple(x.shape[1:]) != tuple(leaf.shape[1:])
+                or np.dtype(x.dtype) != np.dtype(leaf.dtype)
+                for x, leaf in zip(kv, leaves)):
+            raise ValueError(
+                "handoff KV layout does not match this pool (leaf "
+                "count / page shape / dtype) — replicas must share "
+                "model config, page size, and kv_dtype")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, prompt, int(state["max_new"]),
+                       temperature=float(state["temperature"]),
+                       top_k=int(state["top_k"]),
+                       top_p=float(state["top_p"]),
+                       eos_id=state["eos_id"])
+        req.t_submit = time.perf_counter()
+        req.emitted = emitted
+        if self.prefix_cache:
+            req.prefix_hashes = self._prefix_hashes(prompt)
+            req.pages_published = True  # imported pages stay private
+        self.requests[rid] = req
+        self.swapped.append(_Swapped(
+            req=req, kv=[np.asarray(x) for x in kv], n_pages=n_pages,
+            pos=int(state["pos"]), poff=int(state["poff"]),
+            last_tok=int(state["last_tok"])))
+        self.stats["handoff_imports"] += 1
+        return rid
 
     # -- compiled pieces --------------------------------------------------
     def _prefill(self, bucket: int):
@@ -1224,18 +1415,9 @@ class ContinuousBatcher:
 
     # -- prefix cache (self.prefix_cache) ---------------------------------
     def _prefix_hashes(self, prompt: np.ndarray) -> list[bytes]:
-        """Chain hash per FULL prompt page: page i's key commits to
-        tokens [0, (i+1)*page), so equal keys imply the cached page's
-        K/V was computed under the identical token context."""
-        import hashlib
-        out: list[bytes] = []
-        h = b""
-        for i in range(len(prompt) // self.page):
-            h = hashlib.sha1(
-                h + prompt[i * self.page:(i + 1) * self.page]
-                .astype(np.int32).tobytes()).digest()
-            out.append(h)
-        return out
+        """Chain hash per FULL prompt page (module-level
+        ``prefix_page_hashes`` — shared with the fleet router)."""
+        return prefix_page_hashes(prompt, self.page)
 
     def _prefix_lookup(self, req: _Request) -> list[int]:
         """Longest cached chain of the request's full prompt pages
@@ -1245,6 +1427,10 @@ class ContinuousBatcher:
         hashes = req.prefix_hashes
         if len(req.prompt) % self.page == 0:
             hashes = hashes[:-1]
+        return self._registry_chain(hashes)
+
+    def _registry_chain(self, hashes: list[bytes]) -> list[int]:
+        """Pages of the longest chain prefix present in the registry."""
         shared: list[int] = []
         for h in hashes:
             pid = self.registry.get(h)

@@ -24,7 +24,12 @@ Fault classes (``FaultPlan.kind``):
 - ``rendezvous``: refuse the first ``count`` rendezvous connection
   attempts (parallel/init.py retries with backoff + jitter);
 - ``straggler``: sleep ``delay_s`` before each step in
-  [``step``, ``step + count``) — a slow rank, not a dead one.
+  [``step``, ``step + count``) — a slow rank, not a dead one;
+- ``replica_loss``: kill one serving-fleet replica (``rank`` is the
+  REPLICA id here — the fleet is in-process, so there is no process
+  rank to scope by) once its poll tick reaches ``step``; the router
+  must detect the loss and rescue the replica's in-flight requests
+  (fleet/router.py, ``maybe_kill_replica``).
 
 Plans deliver either programmatically (``install``) or through the
 ``FAULT_PLAN`` env var as JSON — the env path crosses the launcher's
@@ -57,7 +62,7 @@ FAULT_EXIT_CODE = 77
 ENV_VAR = "FAULT_PLAN"
 
 KINDS = ("nan_grad", "inf_grad", "loss_spike", "crash", "ckpt_corrupt",
-         "rendezvous", "straggler")
+         "rendezvous", "straggler", "replica_loss")
 
 
 @dataclass
@@ -231,6 +236,27 @@ def maybe_delay(step: int, window: int = 1) -> None:
     if plan is not None and (plan.step < step + window
                              and step < plan.step + plan.count):
         time.sleep(plan.delay_s)
+
+
+def maybe_kill_replica(replica: int, tick: int) -> bool:
+    """``replica_loss``: True exactly ``count`` times once the fleet's
+    poll tick reaches the plan's ``step``, for the planned replica.
+    ``rank`` is interpreted as the REPLICA id (-1 = any replica) — the
+    serving fleet runs in ONE process, so ``_rank_live``'s process-rank
+    gate does not apply; generation gating works as for every other
+    kind.  The replica marks itself dead (its KV pool is lost, as a real
+    process death would lose it) and the router rescues its in-flight
+    requests (fleet/replica.py / fleet/router.py)."""
+    plan = get_plan()
+    if (plan is None or plan.kind != "replica_loss"
+            or not _gen_live(plan)):
+        return False
+    if 0 <= plan.rank != replica:
+        return False
+    if tick < plan.step or plan.count <= 0:
+        return False
+    plan.count -= 1
+    return True
 
 
 _RDZV_FAILED = 0

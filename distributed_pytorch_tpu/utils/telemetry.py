@@ -101,7 +101,8 @@ class Telemetry:
 
     def __init__(self, run_dir: str, *, rank: int | None = None,
                  gen: int | None = None, ring: int = 4096,
-                 flush_every: int = 1, label: str | None = None):
+                 flush_every: int = 1, label: str | None = None,
+                 tag: str = ""):
         self.run_dir = run_dir
         self.rank = rank if rank is not None else _env_int("RANK", 0)
         self.gen = (gen if gen is not None
@@ -109,10 +110,15 @@ class Telemetry:
         self.label = label
         self.flush_every = max(1, flush_every)
         os.makedirs(run_dir, exist_ok=True)
+        # ``tag`` disambiguates SEVERAL registries in one process writing
+        # the same run_dir (the serving fleet: each replica + the router
+        # keep their own registry so spans land under their own pid/rank
+        # in the merged trace) — without it two same-rank registries
+        # would interleave epochs in one O_APPEND file
         self.path = os.path.join(
             run_dir,
             f"{FILE_PREFIX}rank{self.rank}_gen{self.gen}_"
-            f"{os.getpid()}.jsonl")
+            f"{os.getpid()}{tag}.jsonl")
         self._lock = threading.Lock()
         self._fd: int | None = None
         self._pending: list[str] = []

@@ -158,8 +158,11 @@ class PhaseTimer:
             out[name] = {
                 "segments": n,
                 "total_s": tot,
-                "p50_s": s[m // 2],
-                "p95_s": s[min(m - 1, int(m * 0.95))],
+                # m == 0 only when window=0 (percentiles disabled) — a
+                # phase with aggregates but an empty recent window must
+                # not IndexError a drained-replica stats read
+                "p50_s": s[m // 2] if m else 0.0,
+                "p95_s": s[min(m - 1, int(m * 0.95))] if m else 0.0,
                 "max_s": mx,
             }
         out["_total_s"] = total

@@ -9,7 +9,16 @@ WHEN it occurred: ``while_queued`` (work was available — a scheduling
 loss) vs ``queue_drained`` (tail imbalance after the last admission —
 only batch compaction could reclaim these).
 
+Round 14 adds the fleet leg: ``--fleet N`` drives the SAME workload
+through a ``FleetRouter`` over N replicas (each a ``warm_clone`` of the
+compiled batcher) and reports router-level accounting — placement
+split (affinity / prefix / LPT), prefix hit rate, and per-handoff wall
+ms.  ``--disaggregate`` (requires ``--paged``) makes replica 0
+prefill-only and the rest decode-only, so every request crosses pools
+as a paged-KV handoff.
+
 Run:  PYTHONPATH=. python scripts/bench_serving.py [--slots 4 --requests 16]
+      PYTHONPATH=. python scripts/bench_serving.py --fleet 2 --paged --disaggregate
 """
 import argparse
 import json
@@ -96,6 +105,33 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
                        if isinstance(v, dict)}}
 
 
+def run_fleet(fleet, prompts, budgets):
+    """Drive a ``FleetRouter`` over the workload; router accounting."""
+    gids = [fleet.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
+    t0 = time.perf_counter()
+    while fleet.pending():
+        fleet.step()
+    wall = time.perf_counter() - t0
+    total = sum(len(fleet.result(g)) - len(p)
+                for g, p in zip(gids, prompts))
+    st = fleet.stats
+    routed = (st["routed_affinity"] + st["routed_prefix"]
+              + st["routed_lpt"])
+    return {"requests": len(prompts), "replicas": len(fleet.replicas),
+            "tokens": total, "wall_s": round(wall, 2),
+            "tok_per_s": round(total / wall, 1),
+            "routed": {k: st[k] for k in ("routed_affinity",
+                                          "routed_prefix",
+                                          "routed_lpt")},
+            "prefix_hit_rate": round(
+                st["routed_prefix"] / max(routed, 1), 4),
+            "handoffs": st["handoffs"],
+            "handoff_ms": (round(st["handoff_ms"] / st["handoffs"], 3)
+                           if st["handoffs"] else None),
+            "rescued": st["rescued"],
+            "replicas_lost": st["replicas_lost"]}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=4)
@@ -127,7 +163,17 @@ def main():
                     "cache with per-row scales (halves the HBM cache "
                     "read per decode step; ~2x pages per byte budget)")
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="route the workload through a FleetRouter "
+                    "over N replicas (0 = single-batcher, the default)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="with --fleet N>=2: replica 0 prefills, the "
+                    "rest decode — every request moves pools as a "
+                    "paged-KV handoff (requires --paged)")
     args = ap.parse_args()
+    if args.disaggregate and not args.paged:
+        ap.error("--disaggregate moves paged KV between pools: "
+                 "add --paged")
 
     cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
                                 n_heads=8, head_dim=64, d_ff=2048)
@@ -154,6 +200,16 @@ def main():
     # fns through a fresh batcher, so tok/s is warm and stats are clean
     cold = make()
     run(cold, prompts, budgets)
+    if args.fleet:
+        from distributed_pytorch_tpu.fleet import make_fleet
+
+        fleet = make_fleet(lambda: warm_clone(cold, make), args.fleet,
+                           disaggregate=args.disaggregate)
+        try:
+            print(json.dumps(run_fleet(fleet, prompts, budgets)))
+        finally:
+            fleet.close()
+        return
     print(json.dumps(run(warm_clone(cold, make), prompts, budgets)))
 
 

@@ -34,6 +34,24 @@ def _fmt_s(v: float) -> str:
     return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
 
 
+def _fleet_rows(summary: dict) -> dict[str, dict[str, str]]:
+    """Group fleet-phase activity per replica lane.  In a fleet run
+    every replica keeps its own registry with rank = replica id and the
+    router rides rank -2 (fleet/replica.py, fleet/router.py), so the
+    ``rank{R}/fleet/{name}`` keys ARE the per-replica grouping."""
+    rows: dict[str, dict[str, str]] = {}
+    for section, fmt in (
+            ("spans",
+             lambda st: f"{st['count']}x {_fmt_s(st['total_s'])}"),
+            ("events", lambda e: f"{e['count']}x"),
+            ("counters", lambda v: f"{v:g}")):
+        for key, st in summary[section].items():
+            rank, phase, name = key.split("/", 2)
+            if phase == "fleet":
+                rows.setdefault(rank, {})[name] = fmt(st)
+    return rows
+
+
 def print_tables(run_dir: str, summary: dict, *, max_events: int) -> None:
     print(f"telemetry run: {os.path.abspath(run_dir)}")
     print(f"ranks: {summary['ranks']}  "
@@ -48,6 +66,14 @@ def print_tables(run_dir: str, summary: dict, *, max_events: int) -> None:
             print(f"  {key:<40} {st['count']:>6} "
                   f"{_fmt_s(st['total_s']):>10} {_fmt_s(st['p50_s']):>10} "
                   f"{_fmt_s(st['p95_s']):>10} {_fmt_s(st['max_s']):>10}")
+
+    fleet = _fleet_rows(summary)
+    if fleet:
+        print("\nserving fleet (per replica lane; rank -2 = router):")
+        for rank in sorted(fleet, key=lambda r: int(r[4:])):
+            parts = "  ".join(f"{n}={v}"
+                              for n, v in sorted(fleet[rank].items()))
+            print(f"  {rank:<8} {parts}")
 
     if summary["counters"]:
         print("\ncounters (final totals):")

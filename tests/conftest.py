@@ -131,3 +131,11 @@ def pytest_configure(config):
         "tier-1 via `-m 'not slow'`; the gang-level "
         "kill->shrink->resume->rejoin->grow test carries `slow` too "
         "and runs with the full suite (wired like the `faults` lane).")
+    config.addinivalue_line(
+        "markers",
+        "fleet: serving-fleet lane (round 14) — `pytest -m fleet` runs "
+        "the disaggregated prefill/decode fleet (tests/test_fleet.py: "
+        "KV handoff round-trips, prefix-aware routing, LPT fallback, "
+        "session affinity, replica-loss rescue).  All fleet tests are "
+        "fast and ride tier-1 via `-m 'not slow'` (wired like the "
+        "`faults`/`elastic` lanes).")

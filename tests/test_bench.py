@@ -326,6 +326,38 @@ def test_bench_json_keys_include_telemetry_gate():
     assert "for on in (False, True)" in tsrc  # alternating A/B
 
 
+def test_bench_fleet_env_knob_fails_loudly():
+    """A typo'd BENCH_FLEET must raise before any measurement (the
+    BENCH_KV_DTYPE contract, via the ONE shared _canon_bool_env);
+    unset/''/'0' skip cleanly, '1' runs."""
+    assert bench.canon_fleet_env(None) is False
+    assert bench.canon_fleet_env("") is False
+    assert bench.canon_fleet_env("0") is False
+    assert bench.canon_fleet_env("1") is True
+    for bad in ("yes", "true", "2", " 1", "on"):
+        with pytest.raises(ValueError, match="BENCH_FLEET"):
+            bench.canon_fleet_env(bad)
+
+
+def test_bench_json_keys_include_fleet_gate():
+    """Round-14 schema: the serving-fleet keys ride the JSON, the knob
+    is canonicalized pre-bench, and the gate measures a warm fleet
+    (compiled fns shared per replica via warm_clone) with a
+    disaggregated pass for the handoff cost."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("fleet_tokens_per_sec", "fleet_prefix_hit_rate",
+                "fleet_handoff_ms"):
+        assert key in src, key
+    assert "canon_fleet_env" in src and "BENCH_FLEET" in src
+    fsrc = inspect.getsource(bench.bench_serve_fleet)
+    assert "warm_clone" in fsrc           # timed fleets run warm
+    assert "make_fleet" in fsrc
+    assert "disaggregate=True" in fsrc    # the handoff pass is real
+    sig = inspect.signature(bench.bench_serve_fleet)
+    assert sig.parameters["reps"].default >= 3  # hardened window
+
+
 def test_bench_json_keys_include_pp_gate():
     """Round-10 schema: the interleaved-1F1B A/B keys ride the JSON, the
     knobs are canonicalized pre-bench, and the A/B reads its bubble from

@@ -1,0 +1,298 @@
+"""Serving-fleet tests (fleet/): handoff, routing, loss rescue.
+
+Oracle: static greedy generation (as tests/test_serve.py) — every
+stream the fleet delivers, however it was routed, handed off between
+pools, or rescued after a replica loss, must match the single-batcher
+greedy oracle token for token (f32 greedy is dispatch-shape exact).
+
+The fast lane (`fleet` marker, no `slow`) rides tier-1 and pins the
+ISSUE's acceptance proof: token-exact handoff round-trips incl. the
+int8 pool's scale leaves, prefix-aware placement onto the page-holding
+replica, LPT fallback, session affinity, and injected replica loss
+(utils/faults.py `replica_loss`) drained and re-admitted with zero lost
+or duplicated tokens — replica-tagged on the merged Chrome trace.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_pytorch_tpu import generate as gen
+from distributed_pytorch_tpu.fleet import (BatcherReplica, FleetRouter,
+                                           KVHandoff, make_fleet)
+from distributed_pytorch_tpu.models import transformer as tfm
+from distributed_pytorch_tpu.serve import ContinuousBatcher
+from distributed_pytorch_tpu.utils import faults, telemetry
+
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.fleet
+
+CFG = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=2,
+                            n_heads=4, head_dim=32, n_kv_heads=2, d_ff=256)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init(jax.random.key(0), CFG)
+
+
+def _greedy_oracle(params, prompt, max_new):
+    return np.asarray(gen.generate(
+        params, jnp.asarray(prompt)[None], jax.random.key(1), cfg=CFG,
+        max_new=max_new, temperature=0.0))[0]
+
+
+def _make(params, **kw):
+    base = dict(slots=2, max_len=512, temperature=0.0,
+                prompt_buckets=(32,), steps_per_sync=4, paged=True)
+    base.update(kw)
+    return ContinuousBatcher(params, CFG, **base)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_handoff_roundtrip_token_exact(params, kv_dtype):
+    """A request exported mid-stream from one paged pool and admitted
+    into another (through the serialized wire format) finishes exactly
+    as one batcher running it start to finish — incl. the int8 pool,
+    whose per-row scale leaves must ride the handoff."""
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, 256, (9,)).astype(np.int32)
+    # oracle: ONE batcher of the same config runs the whole stream
+    # (for int8 the quantized cache is the ground truth, not f32)
+    single = _make(params, kv_dtype=kv_dtype)
+    want = single.run([prompt], max_new=16)[0]
+    if kv_dtype is None:
+        np.testing.assert_array_equal(
+            want, _greedy_oracle(params, prompt, 16))
+
+    a = _make(params, kv_dtype=kv_dtype)
+    b = _make(params, kv_dtype=kv_dtype)
+    rid = a.submit(prompt, max_new=16)
+    for _ in range(3):  # partial: a few tokens emitted, far from done
+        a.step()
+    h = KVHandoff.extract(a, rid)
+    assert h is not None and h.kv is not None and h.n_pages >= 1
+    assert 0 < len(h.emitted) < 16
+    assert rid not in a.requests and not a.pending()
+    assert a.stats["handoff_exports"] == 1
+    if kv_dtype == "int8":
+        dtypes = {np.dtype(x.dtype) for x in h.kv}
+        assert np.dtype(np.int8) in dtypes      # quantized K/V pages
+        assert np.dtype(np.float32) in dtypes   # per-row scale leaves
+    h2 = KVHandoff.from_bytes(h.to_bytes())     # wire round-trip
+    for x, y in zip(h.kv, h2.kv):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+    rid_b = h2.admit(b)
+    assert b.stats["handoff_imports"] == 1
+    while b.pending():
+        b.step()
+    np.testing.assert_array_equal(b.result(rid_b), want)
+
+
+def test_drained_batcher_stats_and_queued_export(params):
+    """Zero-step guards: a batcher drained (or exported empty) before
+    its first decode block answers every stats call — no
+    ZeroDivisionError, no IndexError — and a queued request exports
+    without KV and re-imports as a plain submission."""
+    cb = _make(params)
+    assert cb.utilization() == 0.0
+    assert cb.emitted_per_slot_step() == 0.0
+    assert cb.timing_stats()["_total_s"] == 0.0
+    assert cb.latency_stats() == {"completed": 0}
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 256, (7,)).astype(np.int32)
+    rid = cb.submit(prompt, max_new=6)
+    h = KVHandoff.extract(cb, rid)  # still queued: no KV to move
+    assert h.kv is None and h.emitted == []
+    assert not cb.pending()
+    assert cb.utilization() == 0.0  # still zero dispatched blocks
+    other = _make(params)
+    rid2 = KVHandoff.from_bytes(h.to_bytes()).admit(other)
+    while other.pending():
+        other.step()
+    np.testing.assert_array_equal(other.result(rid2),
+                                  _greedy_oracle(params, prompt, 6))
+    # mid-stream continuations NEED the pages: without them the batcher
+    # refuses (re-prefilling is the router's fallback, not an implicit
+    # silent recompute)
+    h.emitted = [1, 2]
+    with pytest.raises(ValueError, match="router"):
+        h.admit(other)
+    # PhaseTimer with percentiles disabled (window=0) still summarizes
+    from distributed_pytorch_tpu.utils.tracing import PhaseTimer
+    t = PhaseTimer(window=0)
+    t.add("x", 0.5)
+    s = t.summary()["x"]
+    assert s["segments"] == 1 and s["p50_s"] == 0.0 and s["max_s"] == 0.5
+
+
+def test_prefix_aware_routing_picks_page_holder(params):
+    """Acceptance (b): a request sharing a full cached prompt page
+    routes to the replica holding it — even though LPT would pick the
+    idle one — and admits over the shared pages there."""
+    rng = np.random.default_rng(12)
+    shared = rng.integers(0, 256, (512,)).astype(np.int32)
+    pa = np.concatenate([shared, rng.integers(0, 256, (9,))]).astype(np.int32)
+    pb = np.concatenate([shared, rng.integers(0, 256, (5,))]).astype(np.int32)
+
+    def make():
+        return _make(params, max_len=1024, prompt_buckets=(32, 544),
+                     prefix_cache=True)
+
+    fleet = make_fleet(make, 2)
+    ga = fleet.submit(pa, max_new=24)
+    assert fleet.stats["routed_lpt"] == 1  # nothing cached yet
+    rep_a = fleet._streams[ga]["replica"]
+    for _ in range(2):
+        fleet.step()  # admit pa -> its full pages register
+    # replica rep_a is now LOADED; LPT alone would pick the other one
+    gb = fleet.submit(pb, max_new=8)
+    assert fleet.stats["routed_prefix"] == 1
+    assert fleet._streams[gb]["replica"] == rep_a
+    while fleet.pending():
+        fleet.step()
+    assert fleet.replicas[rep_a].cb.stats["prefix_hits"] >= 1
+    np.testing.assert_array_equal(fleet.result(ga),
+                                  _greedy_oracle(params, pa, 24))
+    np.testing.assert_array_equal(fleet.result(gb),
+                                  _greedy_oracle(params, pb, 8))
+    fleet.close()
+
+
+def test_lpt_fallback_and_session_affinity(params):
+    """No cached prefix: placement is least-outstanding-budget (LPT);
+    a session pins to its replica even when load says otherwise."""
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (6, 8, 7)]
+    fleet = make_fleet(lambda: _make(params), 2)
+    g0 = fleet.submit(prompts[0], max_new=20, session="s0")
+    r0 = fleet._streams[g0]["replica"]
+    g1 = fleet.submit(prompts[1], max_new=12)
+    assert fleet._streams[g1]["replica"] != r0  # LPT: the idle one
+    assert fleet.stats["routed_lpt"] == 2
+    # session s0's replica carries MORE load, affinity still wins
+    assert fleet.replicas[r0].load() > 0
+    g2 = fleet.submit(prompts[2], max_new=4, session="s0")
+    assert fleet._streams[g2]["replica"] == r0
+    assert fleet.stats["routed_affinity"] == 1
+    while fleet.pending():
+        fleet.step()
+    for gid, p, n in ((g0, prompts[0], 20), (g1, prompts[1], 12),
+                      (g2, prompts[2], 4)):
+        np.testing.assert_array_equal(fleet.result(gid),
+                                      _greedy_oracle(params, p, n))
+    fleet.close()
+
+
+def test_replica_loss_rescue_token_exact(params, tmp_path):
+    """Acceptance (a)+(c): an injected replica_loss kills one replica
+    mid-stream; the router detects it, re-prefills its orphans on the
+    survivor, and every stream still matches the oracle — zero lost,
+    zero duplicated tokens.  All of it lands replica-tagged on the
+    merged Chrome trace (pid = replica / router lanes)."""
+    run_dir = str(tmp_path / "tel")
+    # the serving driver is not a training rank: park it on its own
+    # negative pid lane so replica 0's lane (pid 0) is unambiguous
+    telemetry.enable(run_dir, rank=-3, label="host")
+    try:
+        rng = np.random.default_rng(14)
+        prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+                   for L in (5, 9, 7)]
+        fleet = make_fleet(lambda: _make(params), 2,
+                           hb_dir=str(tmp_path / "hb"))
+        gids = [fleet.submit(p, max_new=20) for p in prompts]
+        victim = fleet._streams[gids[0]]["replica"]
+        for _ in range(2):
+            fleet.step()  # several tokens flow before the kill
+        faults.install(faults.FaultPlan("replica_loss", step=3,
+                                        rank=victim))
+        while fleet.pending():
+            fleet.step()
+        assert not fleet.replicas[victim].alive
+        assert fleet.stats["replicas_lost"] == 1
+        assert fleet.stats["rescued"] >= 1
+        for gid, p in zip(gids, prompts):
+            np.testing.assert_array_equal(
+                fleet.result(gid), _greedy_oracle(params, p, 20))
+        # liveness was heartbeat-published the elastic-worker way
+        assert (tmp_path / "hb" / f"hb_rank{victim}.json").exists()
+        fleet.close()
+    finally:
+        faults.reset()
+        telemetry.disable()
+    trace = telemetry.merge_chrome_trace(run_dir)
+    by_pid = {}
+    for e in trace["traceEvents"]:
+        by_pid.setdefault(e["pid"], []).append(e)
+    assert {0, 1, -2} <= set(by_pid)  # replica lanes + the router lane
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert {"replica 0", "replica 1", "router"} <= names
+    fleet_events = [e for e in trace["traceEvents"]
+                    if e.get("tid") == "fleet"]
+    assert any(e["name"] == "replica_lost" and e["pid"] == -2
+               for e in fleet_events)
+    assert any(e["name"] == "rescue" for e in fleet_events)
+    assert any(e["name"] == "poll_step" and e["pid"] == victim
+               for e in fleet_events)
+
+
+def test_graceful_drain_moves_requests_and_readmits(params):
+    """Planned retirement: drain() exports every live request as a KV
+    handoff onto the survivor (pages travel, nothing re-prefills), the
+    drained replica takes no new work until readmit()."""
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (8, 6)]
+    fleet = make_fleet(lambda: _make(params), 2)
+    gids = [fleet.submit(p, max_new=18) for p in prompts]
+    donor = fleet._streams[gids[0]]["replica"]
+    for _ in range(2):
+        fleet.step()
+    moved = fleet.drain(donor)
+    assert moved >= 1
+    assert fleet.stats["handoffs"] == moved
+    assert fleet.stats["handoff_ms"] > 0.0
+    survivor = next(i for i in fleet.replicas if i != donor)
+    g_new = fleet.submit(rng.integers(0, 256, (5,)).astype(np.int32),
+                         max_new=4)
+    assert fleet._streams[g_new]["replica"] == survivor
+    while fleet.pending():
+        fleet.step()
+    assert fleet.stats["rescued"] == 0  # handoff, not re-prefill
+    for gid, p in zip(gids, prompts):
+        np.testing.assert_array_equal(
+            fleet.result(gid), _greedy_oracle(params, p, 18))
+    fleet.readmit(donor)
+    g_back = fleet.submit(prompts[0][:4], max_new=3)
+    assert fleet._streams[g_back]["replica"] == donor  # idle again
+    while fleet.pending():
+        fleet.step()
+    fleet.close()
+
+
+def test_disaggregated_prefill_decode(params):
+    """--disaggregate topology: the prefill replica admits and exports
+    every request as a KV handoff; the decode replica finishes them.
+    Streams stay oracle-exact and every request crossed exactly once."""
+    rng = np.random.default_rng(16)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (7, 11, 5)]
+    fleet = make_fleet(lambda: _make(params), 2, disaggregate=True)
+    gids = [fleet.submit(p, max_new=16) for p in prompts]
+    while fleet.pending():
+        fleet.step()
+    assert fleet.stats["handoffs"] == len(prompts)
+    pre, dec = fleet.replicas[0], fleet.replicas[1]
+    assert pre.role == "prefill" and dec.role == "decode"
+    assert dec.cb.stats["handoff_imports"] == len(prompts)
+    for gid, p in zip(gids, prompts):
+        np.testing.assert_array_equal(
+            fleet.result(gid), _greedy_oracle(params, p, 16))
+    with pytest.raises(RuntimeError, match="decode-only"):
+        dec.submit(99, prompts[0], 4)
+    fleet.close()
