@@ -28,6 +28,42 @@ def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def bench_meta() -> dict:
+    """Provenance block stamped into every bench JSON: git sha,
+    jax/jaxlib versions, platform/device, host, UTC timestamp.  The
+    BENCH_r*.json trajectory spans hosts and runtimes — without this a
+    round-over-round comparison (scripts/bench_compare.py) cannot tell
+    a code regression from a host change, so the comparator refuses to
+    gate across mismatched platforms unless told otherwise."""
+    import socket
+    import subprocess
+
+    import jax
+    import jaxlib
+
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        sha = "unknown"
+    dev = jax.devices()[0]
+    return {
+        "git_sha": sha,
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "device_count": jax.device_count(),
+        "hostname": socket.gethostname(),
+        "python": sys.version.split()[0],
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+    }
+
+
 def vgg11_train_flops_per_sample() -> float:
     """Analytic training FLOPs/sample for VGG-11 on 32x32 (reference
     model.py:3-8 cfg): conv MACs = H*W*Cin*Cout*9 at each stage's
@@ -1253,6 +1289,9 @@ def main() -> None:
 
     print(json.dumps({
         "metric": "cifar10_vgg11_train_samples_per_sec_per_chip",
+        # provenance (round 15): who/what/when produced these numbers —
+        # bench_compare.py gates regressions only within one platform
+        "meta": bench_meta(),
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / baseline, 3),

@@ -26,7 +26,7 @@ from __future__ import annotations
 import time
 
 from ..parallel.elastic import Heartbeat
-from ..utils import faults, telemetry
+from ..utils import faults, monitor, telemetry
 from .handoff import KVHandoff
 
 ROLES = ("unified", "prefill", "decode")
@@ -141,6 +141,13 @@ class BatcherReplica:
             if self.tel is not None:
                 self.tel.span_at("poll_step", t0,
                                  time.perf_counter() - t0, phase="fleet")
+        if self.tel is not None and self._tick % 32 == 1:
+            # memory lane (round 15): the replica's KV pool is the
+            # dominant serving allocation — sample its nbytes (and the
+            # device watermarks where the backend reports them) every
+            # ~32 polls so a leaking pool shows up as a rising gauge
+            monitor.record_memory(self.tel, phase="fleet",
+                                  kv_pool=self.cb.cache)
         emissions, done = self._scan()
         handoffs = []
         if self.role == "prefill":

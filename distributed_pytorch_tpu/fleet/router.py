@@ -34,7 +34,7 @@ import time
 import numpy as np
 
 from ..serve import prefix_page_hashes
-from ..utils import telemetry
+from ..utils import monitor, telemetry
 from .handoff import KVHandoff
 from .replica import BatcherReplica
 
@@ -255,6 +255,29 @@ class FleetRouter:
             self.tel.event("replica_lost", phase="fleet",
                            replica=rep.replica_id,
                            orphans=len(rep.orphans()))
+            # flight recorder (round 15): snapshot fleet state before
+            # the rescue mutates it — request-level stats ride the
+            # bundle's serve section
+            monitor.write_postmortem(
+                "replica_loss", run_dir=self.tel.run_dir, tel=self.tel,
+                detail={"replica": rep.replica_id,
+                        "orphans": len(rep.orphans())},
+                serve_stats={
+                    "router": {k: float(v)
+                               for k, v in self.stats.items()},
+                    "streams": {
+                        str(gid): {"replica": s["replica"],
+                                   "done": s["done"],
+                                   "delivered": len(s["tokens"]),
+                                   "max_new": s["max_new"]}
+                        for gid, s in self._streams.items()},
+                    "replicas": {
+                        str(r.replica_id): {
+                            "alive": r.alive, "role": r.role,
+                            "accepting": r.accepting,
+                            "load": int(r.load())}
+                        for r in self.replicas.values()},
+                })
         for gid in rep.orphans():
             s = self._streams[gid]
             if s["done"]:

@@ -76,7 +76,7 @@ from dataclasses import dataclass, field
 # utils.telemetry and utils.logging import no jax — the agent's gang
 # lifecycle events and structured logs ride the same machinery as the
 # workers' without breaking the process-model contract above.
-from .utils import telemetry
+from .utils import monitor, telemetry
 from .utils.logging import get_logger, setup_logging
 
 
@@ -377,7 +377,13 @@ class LocalAgent:
                 "worker-per-'node' CPU-simulation topology); coordinated "
                 "multi-agent membership is the carried-forward half "
                 "(ROADMAP 'Elastic gang + async relaxations')")
-        self.log = log
+        # agent log lines also feed the monitor's bounded log ring so a
+        # postmortem bundle carries the supervision trail
+
+        def _log(msg, _inner=log):
+            monitor.log_line(str(msg))
+            _inner(msg)
+        self.log = _log
         self._procs: dict[int, subprocess.Popen] = {}
         self._gen = 0  # current rendezvous generation (RESTART_ATTEMPT)
         # graceful-drain accounting across every teardown of this run
@@ -455,6 +461,32 @@ class LocalAgent:
             _tel_event("gang_drain", gen=self._gen, **outcome)
         return outcome
 
+    def _gang_view(self, size: int | None = None) -> dict:
+        """Gang membership as the agent sees it (the bundle's ``gang``
+        section): topology, generation, and each rank's exit state."""
+        return {
+            "nnodes": self.nnodes, "node_rank": self.node_rank,
+            "world_size": self.nnodes * (size if size is not None
+                                         else self.nproc),
+            "gen": self._gen,
+            "ranks": {r: p.poll() for r, p in self._procs.items()},
+        }
+
+    def _postmortem(self, trigger: str, size: int | None = None,
+                    **detail) -> str | None:
+        """Flight recorder at the agent's failure-classification points
+        (round 15).  Only fires when the run has a telemetry dir — the
+        agent's own registry or the exported TELEMETRY_DIR the workers
+        wrote to; a bare gang has nowhere to put a bundle."""
+        tel = telemetry.active()
+        run_dir = (tel.run_dir if tel is not None
+                   else os.environ.get(telemetry.TELEMETRY_DIR_ENV))
+        if not run_dir:
+            return None
+        return monitor.write_postmortem(
+            trigger, run_dir=run_dir, tel=tel, detail=detail,
+            gang=self._gang_view(size))
+
     def _monitor(self, watch_remote: bool = False) -> GangResult:
         """Block until the gang finishes or any worker fails.
 
@@ -478,6 +510,8 @@ class LocalAgent:
                              f"{code} ({kind}); terminating gang")
                     _tel_event("worker_exit", rank=rank, gen=self._gen,
                                code=code, kind=kind)
+                    self._postmortem("worker_fault", rank=rank,
+                                     code=code, classified=kind)
                     self._terminate_all()
                     return GangResult(
                         returncode=code,
@@ -645,6 +679,9 @@ class LocalAgent:
                            "from_size": size, "to_size": new_size,
                            "reason": reason, "rank": rank})
             _tel_event("gang_resize", **events[-1])
+            self._postmortem("elastic_shrink", size=new_size,
+                             **{k: v for k, v in events[-1].items()
+                                if k != "kind"})
             lost_at.append(time.monotonic())
             size = new_size
             self._gen += 1
@@ -678,6 +715,9 @@ class LocalAgent:
                              f"{code} ({kind})")
                     _tel_event("worker_exit", rank=rank, gen=self._gen,
                                code=code, kind=kind)
+                    self._postmortem("worker_fault", size=size,
+                                     rank=rank, code=code,
+                                     classified=kind)
                     return "lost", (rank, code, kind)
             if not running:
                 return "done", per_rank
@@ -699,6 +739,10 @@ class LocalAgent:
                     _tel_event("heartbeat_stale", rank=rank,
                                gen=self._gen, age_s=hb["age_s"],
                                timeout_s=cfg.heartbeat_timeout_s)
+                    self._postmortem("worker_fault", size=size,
+                                     rank=rank, code=None,
+                                     classified="heartbeat_stale",
+                                     age_s=hb["age_s"])
                     try:
                         self._procs[rank].kill()
                     except OSError:

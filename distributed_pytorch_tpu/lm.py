@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .models import transformer as tfm
 from .utils import faults
 from .utils import compat
+from .utils import monitor
 from .utils import telemetry
 from .utils.compat import shard_map
 from .ops.nn import IGNORE_INDEX, masked_ce, step_metrics
@@ -1823,7 +1824,7 @@ class LMTrainer:
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)
-            self.step_fn = make_lm_1f1b_train_step(cfg, self.mesh)
+            self.step_fn = self._build_step_fn(cfg, self.mesh)
         elif cfg.pp > 1:
             from .parallel import pipeline as pp
             stages, shared = pp.split_layer_params(
@@ -1837,13 +1838,13 @@ class LMTrainer:
                 "shared": jax.device_put(
                     shared, NamedSharding(self.mesh, P())),
             }
-            self.step_fn = make_lm_pp_train_step(cfg, self.mesh)
+            self.step_fn = self._build_step_fn(cfg, self.mesh)
         else:
             specs = param_specs(cfg)
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)
-            self.step_fn = make_lm_train_step(cfg, self.mesh)
+            self.step_fn = self._build_step_fn(cfg, self.mesh)
         # zeros_like/elementwise init inherits each param's sharding; leaves
         # with no param ancestry (Adam's step count) come out single-device —
         # normalize them to replicated-on-mesh so every training-state leaf
@@ -1871,6 +1872,7 @@ class LMTrainer:
         self._eval_fn = None
         self._multi_fn = None
         self._step = 0
+        self._last_cache_size = None  # compile-lane gauge change-detect
         self.last_ok = None     # health flag(s) of the last dispatch
         # [grad gnorm, param gnorm] of the last dispatch (round-13
         # telemetry scalars; (K, 2) from train_steps), fetched lazily
@@ -1878,6 +1880,39 @@ class LMTrainer:
         self._ckptr = None
         self._ckptr_key = None
         self.restored_meta: dict = {}
+
+    def _emit_cache_size(self, tel, fn) -> None:
+        """Compile-lane gauge: the dispatched function's jit-cache entry
+        count, emitted only when it CHANGES (a growing cache mid-run is
+        a shape leak — exactly what the gauge exists to surface)."""
+        size_of = getattr(fn, "_cache_size", None)
+        if size_of is None:
+            return
+        try:
+            n = size_of()
+        except Exception:
+            return
+        if n != self._last_cache_size:
+            self._last_cache_size = n
+            tel.gauge("step_fn_cache_size", float(n), phase="compile")
+
+    def _build_step_fn(self, cfg, mesh):
+        """Build the compiled train step for ``cfg``/``mesh``, timed on
+        the compile lane (round 15): one phase-"compile" span per build,
+        keyed by layout + clip so a sentry tighten or elastic rebuild
+        shows up as a NEW program in the trace.  Telemetry off: the
+        span is a no-op and the build is byte-identical."""
+        if cfg.pp_size > 0:
+            kind, builder = "1f1b", make_lm_1f1b_train_step
+        elif cfg.pp > 1:
+            kind, builder = "pp", make_lm_pp_train_step
+        else:
+            kind, builder = "spmd", make_lm_train_step
+        with monitor.compile_span(
+                "lm_step_build",
+                key=(kind, cfg.grad_clip, tuple(mesh.shape.items())),
+                kind=kind):
+            return builder(cfg, mesh)
 
     def tighten_grad_clip(self, factor: float = 0.5) -> float:
         """Multiply the gradient-clip norm by ``factor`` and rebuild the
@@ -1887,12 +1922,7 @@ class LMTrainer:
         opt_state carries over unchanged; the recompile is a fault-path
         cost, not a hot-path one.  Returns the new clip norm."""
         self.cfg.grad_clip *= factor
-        if self.cfg.pp_size > 0:
-            self.step_fn = make_lm_1f1b_train_step(self.cfg, self.mesh)
-        elif self.cfg.pp > 1:
-            self.step_fn = make_lm_pp_train_step(self.cfg, self.mesh)
-        else:
-            self.step_fn = make_lm_train_step(self.cfg, self.mesh)
+        self.step_fn = self._build_step_fn(self.cfg, self.mesh)
         self._multi_fn = None
         return self.cfg.grad_clip
 
@@ -1965,7 +1995,7 @@ class LMTrainer:
             lambda old, tgt: (jax.device_put(np.asarray(old), tgt.sharding)
                               if isinstance(tgt, jax.Array) else old),
             opt_host, target)
-        self.step_fn = make_lm_train_step(cfg, new_mesh)
+        self.step_fn = self._build_step_fn(cfg, new_mesh)
         self.sync_state = None
         if cfg.dcn_compress is not None:
             n_dev = new_mesh.devices.size
@@ -2113,6 +2143,7 @@ class LMTrainer:
             telemetry.emit_train_steps(
                 tel, t0, self._step - 1, 1, loss, self.last_ok,
                 self.last_metrics, span_name="lm_train_step")
+            self._emit_cache_size(tel, self.step_fn)
         return loss
 
     def train_steps(self, tokens: np.ndarray, targets: np.ndarray):
@@ -2142,7 +2173,11 @@ class LMTrainer:
                              "sync-state (EF residual) carry; with "
                              "dcn_compress use train_step")
         if self._multi_fn is None:
-            self._multi_fn = make_lm_multi_step(self.cfg, self.mesh)
+            with monitor.compile_span(
+                    "lm_multi_build",
+                    key=("multi", self.cfg.grad_clip,
+                         tuple(self.mesh.shape.items()))):
+                self._multi_fn = make_lm_multi_step(self.cfg, self.mesh)
         shd = NamedSharding(self.mesh, P(None, *self._batch_spec))
         if jax.process_count() > 1:
             tokens = jax.make_array_from_process_local_data(shd, tokens)
@@ -2162,4 +2197,5 @@ class LMTrainer:
                 tel, t0, self._step - tokens.shape[0], tokens.shape[0],
                 losses, self.last_ok, self.last_metrics,
                 span_name="lm_train_steps")
+            self._emit_cache_size(tel, self._multi_fn)
         return losses

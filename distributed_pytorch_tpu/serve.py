@@ -131,7 +131,7 @@ import numpy as np
 
 from .models import transformer as tfm
 from . import generate as gen
-from .utils import compat
+from .utils import compat, monitor
 from .utils.tracing import PhaseTimer
 
 
@@ -1042,18 +1042,27 @@ class ContinuousBatcher:
                                  lw=c["lw"])
                 return packed, c["cache"], carry_out
 
-            if self.mesh is None:
-                fn = jax.jit(block_body, donate_argnums=compat.donate(1, 4))
-            else:
-                from .utils.compat import shard_map
-                from jax.sharding import PartitionSpec as P
-                fn = jax.jit(shard_map(
-                    block_body, mesh=self.mesh,
-                    in_specs=(self._param_specs, self._cache_spec,
-                              P(), P(), P(), P()),
-                    out_specs=(P(), self._cache_spec, P())),
-                    donate_argnums=compat.donate(1, 4))
-            self._decode_fns[n_slots] = fn
+            # compile lane (round 15): one program per slot width — a
+            # fleet whose drained-tail compaction churns widths shows up
+            # as cache growth here; telemetry off = no-op
+            with monitor.compile_span(
+                    "decode_build",
+                    key=("decode", n_slots, k_steps, width),
+                    cache_size=lambda: len(self._decode_fns),
+                    n_slots=n_slots):
+                if self.mesh is None:
+                    fn = jax.jit(block_body,
+                                 donate_argnums=compat.donate(1, 4))
+                else:
+                    from .utils.compat import shard_map
+                    from jax.sharding import PartitionSpec as P
+                    fn = jax.jit(shard_map(
+                        block_body, mesh=self.mesh,
+                        in_specs=(self._param_specs, self._cache_spec,
+                                  P(), P(), P(), P()),
+                        out_specs=(P(), self._cache_spec, P())),
+                        donate_argnums=compat.donate(1, 4))
+                self._decode_fns[n_slots] = fn
         return self._decode_fns[n_slots]
 
     def _decode_spec_for(self, n_slots: int, gather_cols: int = 0):

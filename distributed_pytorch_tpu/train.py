@@ -36,7 +36,7 @@ from .models import vgg
 from .ops import nn as ops
 from .parallel import strategies as strat
 from .parallel.mesh import DATA_AXIS, make_mesh, replicated
-from .utils import compat, debug as dbg, faults, telemetry, tracing
+from .utils import compat, debug as dbg, faults, monitor, telemetry, tracing
 from .utils.compat import pcast, shard_map, vma_of
 from .utils.metrics import IterTimeMeter, LossMeter
 
@@ -650,19 +650,25 @@ class Trainer:
         key = (args[6].shape, args[7].shape)  # (images, labels)
         exe = self._compiled.get(key)
         if exe is None:
-            if self._multi_fn is None:
-                self._multi_fn = make_multi_step(self.cfg, self.strategy,
-                                                 self.mesh,
-                                                 fault_sig=self._fault_sig)
-            if compat.AOT_EXECUTION_SAFE:
-                exe = self._multi_fn.lower(*args).compile()
-            else:
-                # old runtimes abort EXECUTING a cache-loaded AOT
-                # executable (utils/compat.py) — run through jit there;
-                # compile then lands inside the first timed step (a
-                # metrics skew on legacy hosts, not a correctness loss)
-                exe = self._multi_fn
-            self._compiled[key] = exe
+            # compile lane (round 15): per-program-hash compile time +
+            # cache size on the unified stream; telemetry off = no-op
+            with monitor.compile_span(
+                    "aot_compile", key=key,
+                    cache_size=lambda: len(self._compiled)):
+                if self._multi_fn is None:
+                    self._multi_fn = make_multi_step(
+                        self.cfg, self.strategy, self.mesh,
+                        fault_sig=self._fault_sig)
+                if compat.AOT_EXECUTION_SAFE:
+                    exe = self._multi_fn.lower(*args).compile()
+                else:
+                    # old runtimes abort EXECUTING a cache-loaded AOT
+                    # executable (utils/compat.py) — run through jit
+                    # there; compile then lands inside the first timed
+                    # step (a metrics skew on legacy hosts, not a
+                    # correctness loss)
+                    exe = self._multi_fn
+                self._compiled[key] = exe
             if self._vma_opaque:
                 # new executable, no static vma proof: re-verify
                 # replication after ITS first real step (see __init__)

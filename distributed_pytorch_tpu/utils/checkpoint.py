@@ -35,7 +35,7 @@ import jax
 import numpy as np
 
 from ..parallel.mesh import replicated
-from . import faults, telemetry
+from . import faults, monitor, telemetry
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 
@@ -44,11 +44,15 @@ def _tel_span(name: str, t0: float, **args) -> None:
     """Checkpoint IO on the unified timeline (round 13): every
     save/restore/reshard lands as a span in the 'ckpt' lane —
     duration + bytes — when the process registry is active; one
-    registry read otherwise."""
+    registry read otherwise.  Round 15 rides a host-RSS gauge along:
+    checkpoint IO is where host memory peaks (a full host copy of the
+    training state is in flight), so the memory lane samples here."""
     tel = telemetry.active()
     if tel is not None:
         tel.span_at(name, t0, time.perf_counter() - t0, phase="ckpt",
                     **args)
+        tel.gauge("host_rss_bytes", monitor.host_rss_bytes(),
+                  phase="mem", at=name)
 
 
 class CorruptCheckpointError(RuntimeError):

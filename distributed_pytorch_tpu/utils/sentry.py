@@ -51,7 +51,7 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
-from . import telemetry
+from . import monitor, telemetry
 from .metrics import SpikeDetector
 
 
@@ -113,7 +113,14 @@ class TrainingSentry:
         # elastic agent then reshards the gang one smaller).
         self.on_resize = on_resize
         self._resize_used = False
-        self.log = log
+        # every sentry log line also lands in the monitor's bounded log
+        # ring, so a postmortem bundle shows the escalation trail the
+        # operator saw
+
+        def _log(msg, _inner=log):
+            monitor.log_line(str(msg))
+            _inner(msg)
+        self.log = _log
         self.detector = SpikeDetector(
             window=self.cfg.spike_window,
             threshold=self.cfg.spike_threshold,
@@ -181,6 +188,36 @@ class TrainingSentry:
                    rewound=rewound)
         return rewound
 
+    # -- escalation rungs --------------------------------------------------
+    def request_resize(self, reason: str = "ladder") -> bool:
+        """The resize rung as a public entry point: roll back to
+        last-good once and hand the decision to the ``on_resize`` hook —
+        exactly what the exhausted escalation ladder does, but callable
+        from OUTSIDE the step loop too (monitor.sentry_breach_hook wires
+        an SLO breach here, so a breached step-time objective recovers
+        through the same resize machinery a loss-spike storm would).
+        True iff the hook resized in-process and training continues with
+        a fresh recovery horizon; False when no hook is wired, the one
+        resize was already spent, or the hook declined (a gang worker's
+        hook never returns — it exits ELASTIC_RESIZE_EXIT_CODE)."""
+        if self.on_resize is None or self._resize_used:
+            return False
+        self._resize_used = True
+        self.stats["resizes"] += 1
+        rewound = self.rollback()
+        self.stats["skipped_steps"] += rewound
+        self.log(f"[sentry] requesting gang RESIZE ({reason}): rolled "
+                 f"back {rewound} step(s) to last-good")
+        _tel_event("sentry_resize", step=self.trainer._step,
+                   rewound=rewound, reason=reason)
+        if self.on_resize(dict(self.stats)):
+            # resized in-process: the rebuilt trainer's state is the
+            # new last-good; give recovery a fresh horizon
+            self._ladder = 0
+            self.snapshot()
+            return True
+        return False
+
     # -- the guarded step --------------------------------------------------
     def _trainer_ok(self) -> bool:
         ok = getattr(self.trainer, "last_ok", None)
@@ -228,25 +265,23 @@ class TrainingSentry:
             # more and hand the decision to the resize hook (a gang
             # worker exits ELASTIC_RESIZE_EXIT_CODE from inside it; an
             # in-process hook rebuilds the trainer and returns True)
-            if self.on_resize is not None and not self._resize_used:
-                self._resize_used = True
-                self.stats["resizes"] += 1
-                rewound = self.rollback()
-                self.stats["skipped_steps"] += rewound
-                self.log(f"[sentry] escalation ladder exhausted at step "
-                         f"{self.trainer._step}: requesting gang RESIZE "
-                         f"(rolled back {rewound} step(s) to last-good)")
-                _tel_event("sentry_resize", step=self.trainer._step,
-                           rewound=rewound)
-                if self.on_resize(dict(self.stats)):
-                    # resized in-process: the rebuilt trainer's state is
-                    # the new last-good; give recovery a fresh horizon
-                    self._ladder = 0
-                    self.snapshot()
-                    return None
+            if self.request_resize(f"ladder:{trigger}"):
+                return None
             _tel_event("sentry_abort", kind=trigger,
                        step=self.trainer._step - 1,
                        rollbacks=self.stats["rollbacks"])
+            # flight recorder (round 15): snapshot the run's last
+            # moments before the abort unwinds the training loop
+            monitor.write_postmortem(
+                "sentry_abort",
+                detail={"kind": trigger,
+                        "step": int(self.trainer._step - 1),
+                        "loss": loss_val,
+                        "stats": {k: float(v)
+                                  for k, v in self.stats.items()}},
+                memory=monitor.memory_watermarks(
+                    **{a: getattr(self.trainer, a, None)
+                       for a in _STATE_ATTRS}))
             raise SentryAbort(
                 f"{trigger} at step {self.trainer._step - 1} after "
                 f"{self.stats['rollbacks']} rollbacks — escalation "

@@ -130,6 +130,10 @@ class Telemetry:
         self._gauges: dict[tuple[str, str], float] = {}
         self._spans: dict[tuple[str, str], list] = {}  # [n, total, max]
         self._events: dict[tuple[str, str], int] = {}
+        # live-record subscribers (the run doctor): called OUTSIDE the
+        # lock — a subscriber is allowed to emit its own records (breach
+        # events) and the lock is not reentrant
+        self._subs: list = []
         # keep the ONE bound-method object: atexit.unregister matches
         # the registered callable, and `self.close` evaluates to a
         # fresh (non-matching) bound method on every access
@@ -160,6 +164,28 @@ class Telemetry:
             self._pending.append(json.dumps(rec))
             if len(self._pending) >= self.flush_every:
                 self._flush_locked()
+            subs = self._subs if self._subs else None
+        if subs:
+            # snapshot taken under the lock; delivery outside it so a
+            # subscriber may emit records (breach events) without
+            # deadlocking on the non-reentrant lock
+            for fn in subs:
+                try:
+                    fn(rec)
+                except Exception:
+                    pass  # a broken monitor must never break the run
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(record_dict)`` to see every record as it lands
+        (the run doctor's live feed).  No subscribers (the default) costs
+        one truthiness test per record."""
+        with self._lock:
+            if fn not in self._subs:
+                self._subs = self._subs + [fn]
+
+    def unsubscribe(self, fn) -> None:
+        with self._lock:
+            self._subs = [s for s in self._subs if s is not fn]
 
     def _flush_locked(self) -> None:
         if not self._pending:

@@ -5,6 +5,8 @@ percentiles, the event log, and the merged Chrome-trace export.
     python scripts/telemetry_summary.py RUN_DIR
     python scripts/telemetry_summary.py RUN_DIR --chrome-trace trace.json
     python scripts/telemetry_summary.py RUN_DIR --json
+    python scripts/telemetry_summary.py RUN_DIR --slo [--rules rules.json]
+    python scripts/telemetry_summary.py --postmortem BUNDLE_OR_RUN_DIR
 
 The run directory is whatever ``--telemetry-dir`` (cli.py / lm_cli.py /
 launch.py) pointed at: one rank-tagged JSONL file per process
@@ -27,7 +29,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributed_pytorch_tpu.utils import telemetry  # noqa: E402
+from distributed_pytorch_tpu.utils import monitor, telemetry  # noqa: E402
 
 
 def _fmt_s(v: float) -> str:
@@ -116,11 +118,53 @@ def print_tables(run_dir: str, summary: dict, *, max_events: int) -> None:
                   f"[{rec.get('phase')}] {rec.get('name')} {arg_s}")
 
 
+def print_slo_table(run_dir: str, rules) -> int:
+    """Offline doctor pass (monitor.evaluate_run) rendered as a breach
+    table; returns the number of rules currently in breach."""
+    states = monitor.evaluate_run(run_dir, rules)
+    print(f"\nSLO monitors ({len(states)} rules):")
+    print(f"  {'rule':<24} {'state':<9} {'metric':<22} {'agg':>5} "
+          f"{'current':>12} {'bound':>14} {'breaches':>8} "
+          f"{'samples':>8}")
+    breached = 0
+    for name in sorted(states):
+        st = states[name]
+        rule = st["rule"]
+        mark = "BREACHED" if st["breached"] else "ok"
+        breached += int(bool(st["breached"]))
+        cur = st["current"]
+        cur_s = f"{cur:.4g}" if isinstance(cur, (int, float)) else "-"
+        bound = f"{rule['op']} {rule['threshold']:g}"
+        print(f"  {name:<24} {mark:<9} {rule['metric']:<22} "
+              f"{rule['agg']:>5} {cur_s:>12} {bound:>14} "
+              f"{st['breaches']:>8} {st['samples']:>8}")
+    return breached
+
+
+def print_postmortems(target: str) -> int:
+    """Render one bundle, or every bundle under a run dir — via the
+    SAME loader/renderer the monitor tests validate against (one
+    schema, two consumers).  Returns bundles rendered."""
+    paths = (monitor.find_postmortems(target) if os.path.isdir(target)
+             else [target])
+    if not paths:
+        print(f"no postmortem bundles "
+              f"({monitor.BUNDLE_PREFIX}*.json) under {target!r}")
+        return 0
+    for i, path in enumerate(paths):
+        if i:
+            print()
+        print(f"== {path}")
+        print(monitor.format_postmortem(monitor.load_postmortem(path)))
+    return len(paths)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="merge/inspect a unified-telemetry run directory")
-    p.add_argument("run_dir", help="directory of events_*.jsonl files "
-                                   "(a --telemetry-dir)")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory of events_*.jsonl files "
+                        "(a --telemetry-dir)")
     p.add_argument("--chrome-trace", default=None, metavar="OUT_JSON",
                    help="write the merged Chrome-trace/Perfetto JSON "
                         "(pid=rank, tid=phase, generation-tagged)")
@@ -129,8 +173,23 @@ def main(argv: list[str] | None = None) -> int:
                         "of tables")
     p.add_argument("--max-events", type=int, default=40,
                    help="event-log rows to print (tables mode)")
+    p.add_argument("--slo", action="store_true",
+                   help="evaluate SLO rules over the run (offline "
+                        "doctor pass) and print the breach table; "
+                        "exits 2 when any rule is in breach")
+    p.add_argument("--rules", default=None, metavar="RULES_JSON",
+                   help="SLO rule list (monitor.SloRule dicts); "
+                        "default: monitor.default_rules()")
+    p.add_argument("--postmortem", default=None, metavar="BUNDLE",
+                   help="render a postmortem bundle (or every bundle "
+                        "under a run dir) and exit")
     args = p.parse_args(argv)
 
+    if args.postmortem is not None:
+        return 0 if print_postmortems(args.postmortem) else 1
+
+    if args.run_dir is None:
+        p.error("run_dir is required (unless --postmortem)")
     if not os.path.isdir(args.run_dir):
         p.error(f"{args.run_dir!r} is not a directory")
     summary = telemetry.run_summary(args.run_dir)
@@ -144,6 +203,12 @@ def main(argv: list[str] | None = None) -> int:
     else:
         print_tables(args.run_dir, summary, max_events=args.max_events)
 
+    breached = 0
+    if args.slo:
+        rules = (monitor.rules_from_json(args.rules)
+                 if args.rules else monitor.default_rules())
+        breached = print_slo_table(args.run_dir, rules)
+
     if args.chrome_trace:
         trace = telemetry.merge_chrome_trace(args.run_dir)
         tmp = args.chrome_trace + ".tmp"
@@ -153,7 +218,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"\nchrome trace: {args.chrome_trace} "
               f"({len(trace['traceEvents'])} events) — open in "
               f"https://ui.perfetto.dev", file=sys.stderr)
-    return 0
+    return 2 if breached else 0
 
 
 if __name__ == "__main__":

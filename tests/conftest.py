@@ -139,3 +139,12 @@ def pytest_configure(config):
         "session affinity, replica-loss rescue).  All fleet tests are "
         "fast and ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "monitor: run-doctor lane (round 15) — `pytest -m monitor` runs "
+        "the observability machinery (tests/test_monitor.py: SLO rule "
+        "windows, breach->sentry-resize and breach->fleet-drain hooks, "
+        "postmortem bundles for all four trigger classes, memory/compile "
+        "profiling lanes, zero-overhead compile pin).  All monitor tests "
+        "are fast and ride tier-1 via `-m 'not slow'` (wired like the "
+        "`faults`/`elastic`/`fleet` lanes).")

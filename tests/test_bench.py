@@ -376,3 +376,121 @@ def test_bench_json_keys_include_pp_gate():
     ppsrc = inspect.getsource(bench.bench_train_pp)
     assert "assert_pipeline_schedule" in ppsrc
     assert "bubble_fraction" in ppsrc
+
+
+def test_bench_meta_block_schema():
+    """Round-15 schema: every bench JSON carries a provenance meta block
+    (git sha, jax/jaxlib versions, platform, device kind, hostname, UTC
+    timestamp) so bench_compare.py can refuse cross-host gating."""
+    import inspect
+    src = inspect.getsource(bench.bench_meta)
+    for key in ("git_sha", "jax_version", "jaxlib_version", "platform",
+                "device_kind", "device_count", "hostname", "python",
+                "timestamp_utc"):
+        assert key in src, key
+    assert '"meta": bench_meta()' in inspect.getsource(bench.main)
+    meta = bench.bench_meta()
+    assert set(meta) >= {"git_sha", "jax_version", "platform",
+                         "device_kind", "hostname", "timestamp_utc"}
+    assert meta["platform"]  # a live backend answered
+    assert meta["timestamp_utc"].endswith("Z")
+    import json
+    json.dumps(meta)  # JSON-serializable as emitted
+
+
+def _compare_mod():
+    import importlib
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    try:
+        return importlib.import_module("bench_compare")
+    finally:
+        sys.path.pop(0)
+
+
+def _bench_json(tmp_path, name, metrics, *, meta=None, wrap=False):
+    import json
+    data = {"metric": "images_per_sec_per_chip", **metrics}
+    if meta is not None:
+        data["meta"] = meta
+    if wrap:  # the driver's BENCH_r*.json wrapper
+        data = {"n": 1, "cmd": "bench", "rc": 0, "tail": "",
+                "parsed": data}
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_bench_compare_detects_regressions_and_unwraps(tmp_path, capsys):
+    """The perf gate: a throughput drop / latency rise beyond tolerance
+    exits 1; within-tolerance noise and improvements pass — and the
+    driver's BENCH_r*.json wrapper is unwrapped transparently."""
+    bc = _compare_mod()
+    old = _bench_json(tmp_path, "old.json",
+                      {"value": 100.0, "mfu": 0.30,
+                       "decode_ms_per_token": 10.0,
+                       "telemetry_overhead_pct": -0.15}, wrap=True)
+    ok = _bench_json(tmp_path, "ok.json",
+                     {"value": 95.0, "mfu": 0.31,
+                      "decode_ms_per_token": 10.5,
+                      "telemetry_overhead_pct": 0.4})
+    bad = _bench_json(tmp_path, "bad.json",
+                      {"value": 80.0, "mfu": 0.31,
+                       "decode_ms_per_token": 13.0,
+                       "telemetry_overhead_pct": 3.5})
+    assert bc.main([old, ok]) == 0
+    capsys.readouterr()
+    assert bc.main([old, bad]) == 1
+    out = capsys.readouterr().out
+    # value -20% (>10% drop), decode +30% (>15% rise), overhead > 2.0
+    assert out.count("REGRESSED") == 3
+    assert "value" in out and "decode_ms_per_token" in out
+    # keys absent from either side are skipped, not judged
+    assert "fleet_tokens_per_sec" not in out
+    # trajectory mode: consecutive pairs, any regression gates
+    assert bc.main(["--trajectory", old, ok, bad]) == 1
+
+
+def test_bench_compare_meta_gating(tmp_path, capsys):
+    """A platform/device change makes results incomparable: regressions
+    are reported but NOT gated unless --across-hosts; legacy JSONs
+    without meta compare unconditionally."""
+    bc = _compare_mod()
+    cpu = {"platform": "cpu", "device_kind": "cpu", "hostname": "a"}
+    tpu = {"platform": "tpu", "device_kind": "TPU v5 lite", "hostname": "b"}
+    old = _bench_json(tmp_path, "o.json", {"value": 100.0}, meta=tpu)
+    new = _bench_json(tmp_path, "n.json", {"value": 10.0}, meta=cpu)
+    assert bc.main([old, new]) == 0  # host changed: not a regression
+    assert "NOT gated" in capsys.readouterr().out
+    assert bc.main([old, new, "--across-hosts"]) == 1  # forced gate
+    capsys.readouterr()
+    # same host: gated normally
+    new_same = _bench_json(tmp_path, "ns.json", {"value": 10.0}, meta=tpu)
+    assert bc.main([old, new_same]) == 1
+    capsys.readouterr()
+    # legacy (no meta): gated normally
+    old_legacy = _bench_json(tmp_path, "ol.json", {"value": 100.0})
+    assert bc.main([old_legacy, new]) == 1
+    capsys.readouterr()
+    # a non-bench JSON fails loudly, not silently-passes
+    junk = tmp_path / "junk.json"
+    junk.write_text("{}")
+    with pytest.raises(ValueError, match="not a bench JSON"):
+        bc.main([old, str(junk)])
+
+
+def test_bench_compare_rule_table_covers_baseline_keys():
+    """Every gated BASELINE.md figure has a rule with the right
+    direction: throughput/MFU/speedups up, latencies down, the
+    telemetry overhead held to its round-13 acceptance ceiling."""
+    bc = _compare_mod()
+    for key in ("value", "mfu", "lm_tokens_per_sec_per_chip", "lm_mfu",
+                "serving_tokens_per_sec", "train_overlap_speedup",
+                "train_dcn_overlap_speedup", "lm_pp_speedup",
+                "train_autotune_speedup", "serving_overlap_speedup",
+                "fleet_tokens_per_sec", "fleet_prefix_hit_rate"):
+        assert bc.RULES[key][0] == "higher", key
+    for key in ("decode_ms_per_token", "decode_ms_per_token_p95",
+                "elastic_recovery_ms", "fleet_handoff_ms"):
+        assert bc.RULES[key][0] == "lower", key
+    assert bc.ABS_CEILINGS["telemetry_overhead_pct"] == 2.0

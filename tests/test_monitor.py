@@ -1,0 +1,671 @@
+"""Run doctor (round 15, utils/monitor.py).
+
+Pins:
+- SloRule schema validation + dict round-trip, window aggregation for
+  every agg, breach/clear transitions emitting ``slo_breach`` /
+  ``slo_clear`` events on the run's own stream (phase ``"slo"``,
+  ignored on input so the doctor never eats its own events);
+- both feeds: live (``Telemetry.subscribe`` via ``attach``) and
+  cross-process (``RunTailer`` over the rank JSONL files, torn tails
+  re-read whole);
+- the profiling lanes: pytree nbytes / host RSS memory watermarks and
+  the compile spans + cache-size gauges the trainers emit;
+- BOTH wired hooks end-to-end under real subsystems: an SLO breach
+  escalating through TrainingSentry's resize rung, and a rank-scoped
+  breach draining (then readmitting) a FleetRouter replica;
+- the flight recorder: schema-valid strict-JSON postmortem bundles for
+  all four trigger classes (sentry_abort, worker_fault, elastic_shrink,
+  replica_loss) written at the existing failure-classification points;
+- the zero-overhead contract: monitors OFF (the default) is bitwise
+  free, and monitors ON (doctor attached, rules live) changes NO
+  compiled program — identical losses and ``_cache_size``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_pytorch_tpu.utils import (faults, monitor,  # noqa: E402
+                                           telemetry)
+
+pytestmark = pytest.mark.monitor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _quiet(*a, **k):
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _gauge_rec(name, value, *, rank=0, phase="serve"):
+    return {"type": "gauge", "name": name, "value": float(value),
+            "phase": phase, "rank": rank, "gen": 0,
+            "ts": time.perf_counter()}
+
+
+# -- rules -------------------------------------------------------------------
+
+def test_slo_rule_validation_and_roundtrip():
+    rule = monitor.SloRule(name="r", metric="m", threshold=1.0,
+                           op=">=", agg="mean", record="gauge",
+                           severity="critical", rank=3, phase="fleet")
+    assert monitor.SloRule.from_dict(rule.to_dict()) == rule
+    # unknown keys in a dict are dropped, not fatal (forward compat)
+    d = rule.to_dict()
+    d["future_field"] = 1
+    assert monitor.SloRule.from_dict(d) == rule
+    for bad in (dict(op="=="), dict(agg="median"), dict(severity="meh"),
+                dict(record="metric"), dict(window=0)):
+        with pytest.raises(ValueError):
+            monitor.SloRule(name="r", metric="m", threshold=1.0, **bad)
+
+
+def test_rule_matching_is_scoped_and_values_typed():
+    rule = monitor.SloRule(name="r", metric="step", threshold=1.0,
+                           record="span", phase="train", rank=1)
+    rec = {"type": "span", "name": "step", "phase": "train", "rank": 1,
+           "dur": 0.25}
+    assert rule.matches(rec)
+    assert rule.value_of(rec) == 250.0  # span durations surface in ms
+    assert not rule.matches({**rec, "type": "hist"})
+    assert not rule.matches({**rec, "rank": 0})
+    assert not rule.matches({**rec, "phase": "serve"})
+    assert not rule.matches({**rec, "name": "other"})
+    g = monitor.SloRule(name="g", metric="m", threshold=1.0,
+                        record="gauge")
+    assert g.value_of({"type": "gauge", "name": "m", "value": 2}) == 2.0
+    assert g.value_of({"type": "gauge", "name": "m",
+                       "value": "NaN"}) is None  # jsonsafe'd nonfinite
+    c = monitor.SloRule(name="c", metric="m", threshold=1.0,
+                        record="counter")
+    assert c.value_of({"type": "counter", "name": "m", "inc": 3}) == 3.0
+    e = monitor.SloRule(name="e", metric="m", threshold=1.0,
+                        record="event")
+    assert e.value_of({"type": "event", "name": "m"}) == 1.0
+
+
+def test_breach_and_clear_transitions_emit_events(tmp_path):
+    """Windowed mean rule: entering breach fires hooks + an slo_breach
+    event ONCE (not per sample), leaving it fires slo_clear — and the
+    doctor's own phase-"slo" events never feed back into its windows."""
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="lat", metric="latency_ms", threshold=100.0, op="<=",
+        window=4, agg="mean", record="gauge", min_samples=2)])
+    fired = {"breach": 0, "clear": 0}
+    doctor.on_breach(lambda st: fired.__setitem__(
+        "breach", fired["breach"] + 1))
+    doctor.on_clear(lambda st: fired.__setitem__(
+        "clear", fired["clear"] + 1))
+    assert doctor.attach(tel)
+    try:
+        tel.gauge("latency_ms", 50.0, phase="serve")
+        assert not doctor.states["lat"].breached  # min_samples gate
+        for _ in range(3):
+            tel.gauge("latency_ms", 500.0, phase="serve")
+        st = doctor.states["lat"]
+        assert st.breached and st.breaches == 1 and fired["breach"] == 1
+        for _ in range(4):  # flush the window back under threshold
+            tel.gauge("latency_ms", 1.0, phase="serve")
+        assert not st.breached and fired == {"breach": 1, "clear": 1}
+        assert st.samples == 8  # the slo events were not ingested
+    finally:
+        doctor.detach()
+        telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["events"]["rank0/slo/slo_breach"]["count"] == 1
+    assert summary["events"]["rank0/slo/slo_clear"]["count"] == 1
+    breach = [r for _, rs in telemetry.read_run(str(tmp_path))
+              for r in rs if r.get("name") == "slo_breach"][0]
+    assert breach["args"]["rule"] == "lat"
+    assert breach["args"]["value"] > 100.0
+    assert breach["args"]["severity"] == "warn"
+    # detached: further records no longer reach the doctor
+    before = doctor.states["lat"].samples
+    tel2 = telemetry.enable(str(tmp_path), rank=0)
+    tel2.gauge("latency_ms", 9.0, phase="serve")
+    assert doctor.states["lat"].samples == before
+
+
+def test_age_rule_flags_silence():
+    """The heartbeat-staleness shape: the breach signal is the ABSENCE
+    of records, judged at check() time against last-seen."""
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="hb", metric="heartbeat", threshold=10.0, op="<=",
+        agg="age", record="event")])
+    t0 = time.perf_counter()
+    doctor.observe({"type": "event", "name": "heartbeat", "phase": "gang",
+                    "rank": 0, "ts": t0})
+    seen = doctor.states["hb"].last_seen_mono
+    doctor.check(now=seen + 5.0)
+    assert not doctor.states["hb"].breached
+    doctor.check(now=seen + 11.0)
+    assert doctor.states["hb"].breached
+    doctor.observe({"type": "event", "name": "heartbeat", "phase": "gang",
+                    "rank": 0, "ts": t0})  # it beats again
+    doctor.check(now=doctor.states["hb"].last_seen_mono + 1.0)
+    assert not doctor.states["hb"].breached
+
+
+def test_spike_rule_delegates_to_spike_detector():
+    """agg="spike" rides metrics.SpikeDetector (median/MAD): the window
+    holds spike FLAGS and the aggregate is spikes-in-window."""
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="loss_spike", metric="loss", threshold=0.5, op="<=",
+        window=64, agg="spike", record="gauge",
+        spike_min_history=8, spike_threshold=10.0)])
+    for i in range(20):
+        doctor.observe(_gauge_rec("loss", 2.0 + 0.01 * (i % 3)))
+    assert not doctor.states["loss_spike"].breached
+    doctor.observe(_gauge_rec("loss", 500.0))
+    st = doctor.states["loss_spike"]
+    assert st.breached and st.current >= 1.0
+
+
+def test_run_tailer_incremental_and_torn_tail(tmp_path):
+    tel = telemetry.Telemetry(str(tmp_path), rank=2, flush_every=1)
+    tailer = monitor.RunTailer(str(tmp_path))
+    tel.gauge("g", 1.0, phase="serve")
+    first = tailer.poll()
+    assert [r["type"] for r in first] == ["epoch", "gauge"]
+    assert tailer.poll() == []  # nothing new
+    tel.gauge("g", 2.0, phase="serve")
+    assert [r["value"] for r in tailer.poll()] == [2.0]  # only the delta
+    tel.close()
+    # a torn tail (writer mid-crash) is invisible until the line closes
+    with open(tel.path, "a") as f:
+        f.write('{"type": "gauge", "name": "torn", "val')
+    assert tailer.poll() == []
+    with open(tel.path, "a") as f:
+        f.write('ue": 3.0, "phase": "serve", "rank": 2, "ts": 1.0}\n')
+    assert [r["value"] for r in tailer.poll()] == [3.0]
+    # pump() drives a doctor from the same feed
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="g", metric="g", threshold=1.0, op="<=", agg="last",
+        record="gauge")])
+    with open(tel.path, "a") as f:
+        f.write(json.dumps(_gauge_rec("g", 9.0, rank=2)) + "\n")
+    assert doctor.pump(tailer) == 1
+    assert doctor.states["g"].breached
+
+
+def test_default_rules_json_roundtrip_and_evaluate_run(tmp_path):
+    rules = monitor.default_rules(step_ms_p95=123.0)
+    assert [r.name for r in rules] == ["step_time", "heartbeat_fresh",
+                                      "slot_utilization",
+                                      "fleet_handoff"]
+    assert rules[0].threshold == 123.0
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([r.to_dict() for r in rules]))
+    assert monitor.rules_from_json(str(path)) == rules
+    # offline replay: a run whose slot utilization sat below the floor
+    tel = telemetry.Telemetry(str(tmp_path / "run"), rank=0)
+    for v in (0.1, 0.2, 0.1):
+        tel.gauge("slot_utilization", v, phase="serve")
+    tel.close()
+    states = monitor.evaluate_run(str(tmp_path / "run"), rules)
+    assert states["slot_utilization"]["breached"]
+    assert states["slot_utilization"]["samples"] == 3
+    assert not states["step_time"]["breached"]  # no samples, no verdict
+    # age rules are judged at the run's LAST timestamp, not wall-now:
+    # a long-finished run is not retroactively "stale"
+    assert not states["heartbeat_fresh"]["breached"]
+
+
+# -- profiling lanes ---------------------------------------------------------
+
+def test_memory_lanes_trees_rss_and_gauges(tmp_path):
+    tree = {"a": np.zeros((4, 8), np.float32),
+            "b": [np.zeros(16, np.int8), None]}
+    assert monitor.tree_nbytes(tree) == 4 * 8 * 4 + 16
+    assert monitor.host_rss_bytes() > 1 << 20  # a real RSS, not zero
+    assert monitor.record_memory() is None  # telemetry off: nothing
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    wm = monitor.record_memory(tel, phase="mem", params=tree)
+    telemetry.disable()
+    assert wm["trees"]["params"] == monitor.tree_nbytes(tree)
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["gauges"]["rank0/mem/host_rss_bytes"]["last"] > 0
+    assert summary["gauges"]["rank0/mem/params_bytes"]["last"] == \
+        monitor.tree_nbytes(tree)
+
+
+def test_compile_span_lane(tmp_path):
+    # off: the block runs, nothing is recorded, nothing is timed
+    with monitor.compile_span("build", key=("k", 1),
+                              cache_size=lambda: 1 / 0):
+        pass
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    cache = {}
+    with monitor.compile_span("build", key=("k", 1),
+                              cache_size=lambda: len(cache), kind="spmd"):
+        cache["k"] = object()
+    telemetry.disable()
+    recs = [r for _, rs in telemetry.read_run(str(tmp_path)) for r in rs]
+    span = [r for r in recs if r["type"] == "span"][0]
+    assert span["phase"] == "compile" and span["name"] == "build"
+    assert span["args"]["program"] == monitor.program_key(("k", 1))
+    assert span["args"]["kind"] == "spmd"
+    gauge = [r for r in recs if r["type"] == "gauge"][0]
+    # evaluated AFTER the build: sees the inserted entry
+    assert gauge["name"] == "build_cache_size" and gauge["value"] == 1.0
+
+
+def test_trainer_compile_spans_and_cache_gauge(tmp_path):
+    """The instrumented compile points: building an LMTrainer with the
+    registry live lands a phase-"compile" lm_step_build span, and the
+    first step gauges the jit cache size."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, 1).astype(np.int32)
+    telemetry.enable(str(tmp_path), rank=0)
+    tr = LMTrainer(LMTrainConfig(model=model, dp=2, fsdp=True,
+                                 compute_dtype=None))
+    tr.train_step(toks, tgts)
+    telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["spans"]["rank0/compile/lm_step_build"]["count"] >= 1
+    if hasattr(tr.step_fn, "_cache_size"):
+        cache = summary["gauges"]["rank0/compile/step_fn_cache_size"]
+        assert cache["last"] >= 1
+    recs = [r for _, rs in telemetry.read_run(str(tmp_path)) for r in rs
+            if r.get("name") == "lm_step_build"]
+    assert all("program" in r["args"] for r in recs)
+
+
+# -- the two wired hooks -----------------------------------------------------
+
+def test_breach_drives_sentry_resize_and_training_continues(tmp_path):
+    """End-to-end rung: a breached step-time SLO escalates through
+    TrainingSentry.request_resize — rollback to last-good, the on_resize
+    hook rebuilds the trainer on a smaller mesh, training continues —
+    and the resize lands in sentry stats + the event stream."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.utils.sentry import TrainingSentry
+
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, 1).astype(np.int32)
+
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    tr = LMTrainer(LMTrainConfig(model=model, dp=2, fsdp=True,
+                                 compute_dtype=None))
+    resized = []
+
+    def on_resize(stats):
+        tr.rebuild(dp=1, fsdp=False)  # the in-process shrink
+        resized.append(dict(stats))
+        return True
+
+    sentry = TrainingSentry(tr, on_resize=on_resize, log=_quiet)
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="step_time", metric="lm_train_step", record="span",
+        agg="p95", op="<=", threshold=1e-4,  # any real step breaches
+        window=8, severity="critical")])
+    doctor.on_breach(monitor.sentry_breach_hook(sentry))
+    doctor.attach(tel)
+    try:
+        losses = [sentry.step(toks, tgts) for _ in range(3)]
+    finally:
+        doctor.detach()
+        telemetry.disable()
+    assert doctor.states["step_time"].breached
+    assert sentry.stats["resizes"] == 1 and len(resized) == 1
+    assert tr.cfg.dp == 1 and not tr.cfg.fsdp  # the hook really resized
+    # training continued across the resize: every step returned a loss
+    assert all(l is not None and np.isfinite(l) for l in losses)
+    summary = telemetry.run_summary(str(tmp_path))
+    assert summary["events"]["rank0/sentry/sentry_resize"]["count"] == 1
+    assert summary["events"]["rank0/slo/slo_breach"]["count"] == 1
+
+
+def test_breach_severity_floor_gates_sentry_hook():
+    class _Sentry:
+        calls = 0
+
+        def request_resize(self, reason):
+            self.calls += 1
+            return True
+
+    s = _Sentry()
+    hook = monitor.sentry_breach_hook(s, severity="critical")
+    warn_st = monitor.SloState(rule=monitor.SloRule(
+        name="w", metric="m", threshold=1.0, severity="warn"))
+    crit_st = monitor.SloState(rule=monitor.SloRule(
+        name="c", metric="m", threshold=1.0, severity="critical"))
+    hook(warn_st)
+    assert s.calls == 0  # below the floor: observed, not escalated
+    hook(crit_st)
+    assert s.calls == 1
+
+
+@pytest.fixture(scope="module")
+def _serve_setup():
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                n_heads=2, head_dim=16, n_kv_heads=2,
+                                d_ff=64)
+    params = tfm.init(jax.random.key(0), cfg)
+
+    def make():
+        return ContinuousBatcher(params, cfg, slots=2, max_len=128,
+                                 temperature=0.0, prompt_buckets=(16,),
+                                 steps_per_sync=2, paged=True)
+    return cfg, params, make
+
+
+def test_breach_drains_fleet_replica_then_readmits(tmp_path,
+                                                   _serve_setup):
+    """End-to-end fleet hook: a rank-scoped SLO breach (fed through the
+    cross-process tailer, the way an external doctor would watch a
+    fleet) drains the breaching replica through FleetRouter.drain —
+    live requests move, routing stops — and the clear readmits it."""
+    from distributed_pytorch_tpu.fleet import make_fleet
+
+    _, _, make = _serve_setup
+    run_dir = str(tmp_path / "tel")
+    fleet = make_fleet(make, 2)
+    rng = np.random.default_rng(3)
+    gids = [fleet.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                         max_new=6) for _ in range(3)]
+    for _ in range(2):
+        fleet.step()
+
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="replica1_latency", metric="poll_latency_ms",
+        record="gauge", agg="mean", op="<=", threshold=100.0,
+        window=4, min_samples=2, rank=1)])
+    hook = monitor.FleetBreachHook(fleet, log=_quiet).register(doctor)
+    feed = telemetry.Telemetry(run_dir, rank=1, flush_every=1)
+    tailer = monitor.RunTailer(run_dir)
+    for _ in range(3):
+        feed.gauge("poll_latency_ms", 500.0, phase="fleet")
+    doctor.pump(tailer)
+    assert hook.degraded == {1}
+    assert not fleet.replicas[1].accepting
+    assert fleet.replicas[1].alive  # drained, not killed
+    # drained requests still finish (moved or already done elsewhere)
+    while fleet.pending():
+        fleet.step()
+    assert all(len(fleet.result(g)) > 0 for g in gids)
+    for _ in range(4):  # latency recovers -> clear -> readmit
+        feed.gauge("poll_latency_ms", 1.0, phase="fleet")
+    doctor.pump(tailer)
+    assert hook.degraded == set()
+    assert fleet.replicas[1].accepting
+    feed.close()
+    fleet.close()
+
+
+# -- flight recorder: all four trigger classes -------------------------------
+
+def test_postmortem_sentry_abort_bundle_strict_json(tmp_path):
+    """A diverging (NaN-loss) run exhausts the ladder: the abort path
+    writes a bundle BEFORE SentryAbort unwinds, and the bundle is
+    strict JSON even though the loss it carries is NaN."""
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.utils.sentry import (SentryAbort,
+                                                      SentryConfig,
+                                                      TrainingSentry)
+
+    class _NaNTrainer:
+        _step = 0
+        params = {"w": jnp.zeros((8,))}
+
+        def train_step(self, loss):
+            self._step += 1
+            self.last_ok = np.float32(1.0)
+            return jnp.float32(loss)
+
+    telemetry.enable(str(tmp_path), rank=0)
+    sentry = TrainingSentry(_NaNTrainer(),
+                            SentryConfig(max_rollbacks=1), log=_quiet)
+    try:
+        with pytest.raises(SentryAbort):
+            for _ in range(3):
+                sentry.step(float("nan"))
+    finally:
+        telemetry.disable()
+    paths = monitor.find_postmortems(str(tmp_path))
+    assert len(paths) == 1
+    bundle = monitor.load_postmortem(paths[0])  # strict-JSON validator
+    assert bundle["trigger"]["kind"] == "sentry_abort"
+    assert bundle["trigger"]["loss"] == "NaN"  # jsonsafe'd, not bare
+    assert bundle["trigger"]["stats"]["rollbacks"] >= 1
+    assert bundle["memory"]["trees"]["params"] == 8 * 4
+    assert bundle["ring"], "ring empty: the sentry events never flushed"
+    assert any(r.get("name") == "sentry_trigger" for r in bundle["ring"])
+    assert any("[sentry]" in ln for ln in bundle["log_tail"])
+
+
+def test_postmortem_worker_fault_from_agent(tmp_path):
+    """An injected worker death (FAULT_EXIT_CODE) at the agent's
+    failure-classification point writes a worker_fault bundle carrying
+    the gang view — and the agent stays jax-free doing it."""
+    from distributed_pytorch_tpu.launch import LocalAgent
+
+    telemetry.enable(str(tmp_path), rank=-1, label="agent")
+    try:
+        result = LocalAgent(["-c", "import sys; sys.exit(77)"],
+                            nproc_per_node=1, max_restarts=0,
+                            monitor_interval_s=0.02, log=_quiet).run()
+    finally:
+        telemetry.disable()
+    assert result.returncode == 77
+    paths = monitor.find_postmortems(str(tmp_path))
+    assert len(paths) == 1
+    bundle = monitor.load_postmortem(paths[0])
+    assert bundle["trigger"]["kind"] == "worker_fault"
+    assert bundle["trigger"]["classified"] == "injected fault"
+    assert bundle["trigger"]["rank"] == 0
+    assert bundle["trigger"]["code"] == 77
+    assert bundle["gang"]["world_size"] == 1
+    assert "0" in {str(k) for k in bundle["gang"]["ranks"]}
+
+
+_HB_PRELUDE = r"""
+import json, os, signal, sys, time
+d = os.environ["ELASTIC_DIR"]; rank = os.environ["RANK"]
+gen = int(os.environ["RESTART_ATTEMPT"])
+flag = []
+signal.signal(signal.SIGTERM, lambda *a: flag.append(1))
+def beat(step):
+    p = os.path.join(d, "hb_rank%s.json" % rank); t = p + ".tmp"
+    with open(t, "w") as f:
+        json.dump({"rank": int(rank), "step": step, "gen": gen}, f)
+    os.replace(t, p)
+"""
+
+
+def test_postmortem_elastic_shrink(tmp_path):
+    """A gen-0 worker fault under an elastic gang writes BOTH bundles:
+    the worker_fault classification and the elastic_shrink transition
+    (from_size/to_size/reason), before the gang reshards and finishes
+    clean."""
+    from distributed_pytorch_tpu.launch import ElasticConfig, LocalAgent
+
+    prog = r"""
+for step in range(400):
+    beat(step)
+    if flag: sys.exit(78)
+    if gen == 0 and rank == "1" and step == 2: sys.exit(77)
+    if gen >= 1: sys.exit(0)
+    time.sleep(0.03)
+sys.exit(0)
+"""
+    telemetry.enable(str(tmp_path / "tel"), rank=-1, label="agent")
+    try:
+        result = LocalAgent(
+            ["-c", _HB_PRELUDE + prog], nproc_per_node=2,
+            monitor_interval_s=0.02,
+            elastic=ElasticConfig(min_workers=1, max_workers=2,
+                                  heartbeat_timeout_s=60.0,
+                                  drain_grace_s=10.0, rejoin_delay_s=0.0,
+                                  grow_after_steps=10_000,
+                                  run_dir=str(tmp_path / "elastic")),
+            log=_quiet).run()
+    finally:
+        telemetry.disable()
+    assert result.returncode == 0, result
+    bundles = {monitor.load_postmortem(p)["trigger"]["kind"]:
+               monitor.load_postmortem(p)
+               for p in monitor.find_postmortems(str(tmp_path / "tel"))}
+    assert set(bundles) == {"worker_fault", "elastic_shrink"}
+    shrink = bundles["elastic_shrink"]
+    assert shrink["trigger"]["from_size"] == 2
+    assert shrink["trigger"]["to_size"] == 1
+    assert shrink["trigger"]["reason"] == "injected fault"
+    assert shrink["gang"]["world_size"] == 1  # the post-shrink view
+    fault = bundles["worker_fault"]
+    assert fault["trigger"]["classified"] == "injected fault"
+    assert fault["trigger"]["rank"] == 1
+
+
+def test_postmortem_replica_loss(tmp_path, _serve_setup):
+    """An injected replica_loss at the router's rescue point writes a
+    bundle carrying router stats, per-stream delivery state, and the
+    replica roster."""
+    from distributed_pytorch_tpu.fleet import make_fleet
+
+    _, _, make = _serve_setup
+    run_dir = str(tmp_path / "tel")
+    telemetry.enable(run_dir, rank=-3, label="host")
+    try:
+        fleet = make_fleet(make, 2)
+        rng = np.random.default_rng(5)
+        gids = [fleet.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                             max_new=8) for _ in range(2)]
+        victim = fleet._streams[gids[0]]["replica"]
+        for _ in range(2):
+            fleet.step()
+        faults.install(faults.FaultPlan("replica_loss", step=3,
+                                        rank=victim))
+        while fleet.pending():
+            fleet.step()
+        fleet.close()
+    finally:
+        faults.reset()
+        telemetry.disable()
+    paths = monitor.find_postmortems(run_dir)
+    assert len(paths) == 1
+    bundle = monitor.load_postmortem(paths[0])
+    assert bundle["trigger"]["kind"] == "replica_loss"
+    assert bundle["trigger"]["replica"] == victim
+    assert bundle["serve"]["router"]["replicas_lost"] == 1.0
+    roster = bundle["serve"]["replicas"]
+    assert roster[str(victim)]["alive"] is False
+    assert len(bundle["serve"]["streams"]) == 2
+    # the ring spans the fleet's rank lanes, not just the host's
+    assert {r.get("rank") for r in bundle["ring"]} >= {-2}
+
+
+def test_write_postmortem_guards(tmp_path):
+    # unknown trigger / no run dir: swallowed, never raises
+    assert monitor.write_postmortem("bogus_kind",
+                                    run_dir=str(tmp_path)) is None
+    assert monitor.write_postmortem("worker_fault") is None  # no tel
+    path = monitor.write_postmortem("worker_fault",
+                                    run_dir=str(tmp_path),
+                                    detail={"kind": "overridden",
+                                            "rank": 4})
+    bundle = monitor.load_postmortem(path)
+    # the trigger class wins over a detail dict's own "kind"
+    assert bundle["trigger"]["kind"] == "worker_fault"
+    assert bundle["trigger"]["rank"] == 4
+    for key in monitor.BUNDLE_KEYS:
+        assert key in bundle, key
+    # a corrupt bundle fails validation loudly
+    bad = tmp_path / f"{monitor.BUNDLE_PREFIX}x.json"
+    bad.write_text(json.dumps({"version": 1}))
+    with pytest.raises(ValueError, match="missing keys"):
+        monitor.load_postmortem(str(bad))
+
+
+def test_postmortem_script_and_summary_render(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import postmortem as pm_script
+        import telemetry_summary
+    finally:
+        sys.path.pop(0)
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    tel.gauge("slot_utilization", 0.1, phase="serve")
+    path = monitor.write_postmortem(
+        "worker_fault", detail={"rank": 1, "code": 77},
+        gang={"world_size": 2})
+    telemetry.disable()
+    assert pm_script.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "postmortem: worker_fault" in out and "ring:" in out
+    assert pm_script.main([str(tmp_path), "--json"]) == 0
+    json.loads(capsys.readouterr().out)  # validated machine output
+    assert pm_script.main([str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+    # telemetry_summary: --postmortem renders, --slo gates (exit 2)
+    assert telemetry_summary.main(["--postmortem", str(tmp_path)]) == 0
+    assert "worker_fault" in capsys.readouterr().out
+    rc = telemetry_summary.main([str(tmp_path), "--slo"])
+    out = capsys.readouterr().out
+    assert rc == 2 and "slot_utilization" in out and "BREACHED" in out
+
+
+# -- the zero-overhead contract ---------------------------------------------
+
+def test_monitors_off_and_on_are_bitwise_free(tmp_path):
+    """THE acceptance pin (PR-9 methodology): monitors disabled (the
+    default) AND monitors fully live (registry + attached doctor +
+    rules) produce bitwise-identical 3-step loss trajectories and
+    identical compile counts — the doctor watches the stream, it never
+    touches the program."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                  n_heads=2, head_dim=16, d_ff=64)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, 1).astype(np.int32)
+
+    def run():
+        tr = LMTrainer(LMTrainConfig(model=model, dp=2, fsdp=True,
+                                     compute_dtype=None))
+        losses = [float(tr.train_step(toks, tgts)) for _ in range(3)]
+        compiles = (tr.step_fn._cache_size()
+                    if hasattr(tr.step_fn, "_cache_size") else None)
+        return losses, compiles
+
+    off_losses, off_compiles = run()
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    doctor = monitor.RunDoctor(monitor.default_rules())
+    doctor.attach(tel)
+    on_losses, on_compiles = run()
+    doctor.detach()
+    telemetry.disable()
+    assert off_losses == on_losses  # bitwise
+    assert off_compiles == on_compiles
+    # the live leg really monitored: step spans fed the step_time rule
+    assert doctor.states["step_time"].samples == 3
