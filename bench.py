@@ -311,14 +311,17 @@ def canon_dcn_size_env(value: str | None) -> int:
 
 def canon_dcn_compress_env(value: str | None) -> str | None:
     """Validate BENCH_DCN_COMPRESS (the slow-hop compression the DCN A/B
-    runs with): unset/''/'none' = exact full-precision psum, 'int8' = the
-    quantized ring exchange.  Fails loudly pre-bench like BENCH_KV_DTYPE."""
+    runs with): unset/''/'none' = exact full-precision psum, 'int8' /
+    'int4' = the quantized ring exchange at that width (round 16 adds
+    the nibble-packed int4 rung).  Fails loudly pre-bench like
+    BENCH_KV_DTYPE."""
     if value is None or value in ("", "none"):
         return None
-    if value == "int8":
-        return "int8"
+    if value in ("int8", "int4"):
+        return value
     raise ValueError(
-        f"BENCH_DCN_COMPRESS must be ''/'none' or 'int8', got {value!r}")
+        f"BENCH_DCN_COMPRESS must be ''/'none', 'int8', or 'int4', "
+        f"got {value!r}")
 
 
 def bench_train_dcn(dcn_size: int, compress: str | None,
@@ -399,6 +402,138 @@ def bench_train_dcn(dcn_size: int, compress: str | None,
     return {"speedup": speedup, "ms_overlap": med[True],
             "ms_post_backward": med[False], "dcn_bytes_per_step": dcn_bytes,
             "ici_bytes_per_step": ici_bytes}
+
+
+def canon_fsdp_gather_env(value: str | None) -> str | None:
+    """Validate BENCH_FSDP_GATHER (round 16): unset/''/'none' skips the
+    quantized ZeRO-3 gather A/B; 'int8' runs it (fsdp weight all-gathers
+    quantized per-row, dequant at the consumer).  Fails loudly pre-bench
+    like BENCH_DCN_COMPRESS."""
+    if value is None or value in ("", "none"):
+        return None
+    if value == "int8":
+        return "int8"
+    raise ValueError(
+        f"BENCH_FSDP_GATHER must be ''/'none' or 'int8', got {value!r}")
+
+
+def bench_lm_q8_gather(iters: int = 20, batch_per_dev: int = 1,
+                       seq: int = 256, reps: int = 5) -> dict | None:
+    """Quantized ZeRO-3 gather A/B (round 16, BENCH_FSDP_GATHER=int8):
+    the LM fsdp step with ``fsdp_gather_dtype="int8"`` vs the f32 weight
+    all-gathers, same model/batch/mesh, hardened-window discipline
+    (alternating reps, median, value-fetch barrier).  ``speedup`` is
+    ms_f32 / ms_int8 — >1 when the quartered gather wire wins, ~1.0 on
+    CPU meshes (no real interconnect; the wire accounting in
+    scripts/bench_strategies.py's lm_fsdp_q8gather row is the CPU
+    content).  Needs >= 2 devices; returns None (JSON null) otherwise."""
+    import jax
+
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _log(f"[bench] lm-q8gather A/B needs >= 2 devices (have {n_dev}); "
+             f"omitting")
+        return None
+    model = tfm.TransformerConfig(vocab_size=256, d_model=256, n_layers=4,
+                                  n_heads=4, head_dim=64, d_ff=512)
+
+    def build(gather_dtype: str | None) -> LMTrainer:
+        return LMTrainer(LMTrainConfig(
+            model=model, dp=n_dev, fsdp=True,
+            fsdp_gather_dtype=gather_dtype))
+
+    trainers = {None: build(None), "int8": build("int8")}
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, (batch_per_dev * n_dev,
+                                 seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        float(tr.train_step(toks, tgts))
+
+    times: dict[str | None, list[float]] = {None: [], "int8": []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = tr.train_step(toks, tgts)
+            float(loss)  # value fetch: the honest end-of-window barrier
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[None] / max(med["int8"], 1e-9)
+    _log(f"[bench] lm-q8gather A/B (fsdp, {n_dev} dev): "
+         f"{med['int8']:.2f} ms/step int8 vs {med[None]:.2f} f32 -> "
+         f"{speedup:.3f}x ({reps} reps median)")
+    return {"speedup": speedup, "ms_int8": med["int8"],
+            "ms_f32": med[None]}
+
+
+def canon_matmul_dtype_env(value: str | None) -> str | None:
+    """Validate BENCH_MATMUL_DTYPE (round 16): unset/''/'none' skips the
+    int8-matmul flip-rate gate; 'int8' runs it (transformer dense
+    projections through the quantized matmul forward).  Fails loudly
+    pre-bench like BENCH_KV_DTYPE."""
+    if value is None or value in ("", "none"):
+        return None
+    if value == "int8":
+        return "int8"
+    raise ValueError(
+        f"BENCH_MATMUL_DTYPE must be ''/'none' or 'int8', got {value!r}")
+
+
+def bench_lm_int8_matmul(train_steps: int = 30, batch: int = 8,
+                         seq: int = 256) -> dict | None:
+    """int8-matmul flip-rate gate (round 16, BENCH_MATMUL_DTYPE=int8):
+    the measure_fliprate methodology applied to the compute path —
+    briefly train the small byte-LM on the synthetic corpus (so logits
+    are a language model's, not random init's), then TEACHER-FORCE one
+    held-out corpus batch through the bf16 forward and the
+    ``matmul_dtype="int8"`` forward (identical context at every
+    position) and report per-position argmax flips / positions.  The
+    BASELINE round-7 kernel-vs-XLA bf16 near-tie baseline is 0.0024;
+    the int8-vs-bf16 rate is a few x that (the quantization
+    perturbation is wider than bf16 accumulation noise, flips still
+    concentrate at |top1-top2| < 0.05 near-ties) — BASELINE.md's
+    round-16 flip-rate table records the measured numbers, and
+    tests/test_lowbit.py pins the ceiling."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.data import lm_corpus
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=256, n_layers=4,
+                                  n_heads=4, head_dim=64, d_ff=512)
+    tr = LMTrainer(LMTrainConfig(model=model))
+    data = lm_corpus.encode(lm_corpus.synthetic_corpus(1 << 18, seed=3))
+    rng = np.random.default_rng(0)
+    for _ in range(train_steps):
+        idx = rng.integers(0, len(data) - seq - 1, batch)
+        toks = np.stack([data[i:i + seq] for i in idx]).astype(np.int32)
+        tgts = np.stack([data[i + 1:i + seq + 1]
+                         for i in idx]).astype(np.int32)
+        tr.train_step(toks, tgts)
+    idx = rng.integers(0, len(data) - seq, batch)
+    held = jnp.asarray(np.stack([data[i:i + seq]
+                                 for i in idx]).astype(np.int32))
+
+    def argmax_with(md: str | None) -> np.ndarray:
+        f = jax.jit(lambda p, t: tfm.apply(p, t, cfg=model,
+                                           dtype=jnp.bfloat16,
+                                           matmul_dtype=md))
+        return np.asarray(jnp.argmax(f(tr.params, held), axis=-1))
+
+    ref = argmax_with(None)
+    q = argmax_with("int8")
+    flips = int((ref != q).sum())
+    total = int(ref.size)
+    _log(f"[bench] lm-int8matmul flip rate: {flips}/{total} = "
+         f"{flips / total:.5f} (bf16 vs matmul_dtype=int8, "
+         f"teacher-forced)")
+    return {"fliprate": flips / total, "flips": flips, "positions": total}
 
 
 def canon_autotune_env(value: str | None) -> bool:
@@ -1149,6 +1284,12 @@ def main() -> None:
     dcn_size = canon_dcn_size_env(os.environ.get("BENCH_DCN_SIZE"))
     dcn_compress = canon_dcn_compress_env(
         os.environ.get("BENCH_DCN_COMPRESS"))
+    # Low-bit knobs (round 16), validated loudly pre-bench:
+    # BENCH_FSDP_GATHER=int8 A/Bs the quantized ZeRO-3 weight gathers;
+    # BENCH_MATMUL_DTYPE=int8 measures the int8-projection flip rate.
+    fsdp_gather = canon_fsdp_gather_env(os.environ.get("BENCH_FSDP_GATHER"))
+    matmul_dtype = canon_matmul_dtype_env(
+        os.environ.get("BENCH_MATMUL_DTYPE"))
     # Interleaved-1F1B pipeline A/B knobs (round 10), validated loudly
     # pre-bench: BENCH_PP_SIZE >= 2 runs the LM pipeline A/B on a
     # pp_size-staged virtual mesh; BENCH_MICROBATCHES sets M (default
@@ -1202,6 +1343,24 @@ def main() -> None:
             dcn_ab = bench_train_dcn(dcn_size, dcn_compress)
         except Exception as e:
             _log(f"[bench] train-dcn A/B failed ({e}); omitting")
+
+    # Quantized ZeRO-3 gather A/B (round 16): fsdp weight all-gathers
+    # at int8 vs f32; optional like the other gates.
+    q8gather_ab = None
+    if fsdp_gather == "int8":
+        try:
+            q8gather_ab = bench_lm_q8_gather()
+        except Exception as e:
+            _log(f"[bench] lm-q8gather A/B failed ({e}); omitting")
+
+    # int8-matmul flip-rate gate (round 16): quantized dense projections
+    # vs the bf16 forward; optional like the other gates.
+    int8mm = None
+    if matmul_dtype == "int8":
+        try:
+            int8mm = bench_lm_int8_matmul()
+        except Exception as e:
+            _log(f"[bench] lm-int8matmul gate failed ({e}); omitting")
 
     # Interleaved-1F1B pipeline A/B (round 10): LM pp_size stages vs
     # single-stage on the virtual mesh; optional like the other gates.
@@ -1323,6 +1482,19 @@ def main() -> None:
                                      if dcn_ab is not None else None),
         "train_dcn_compress": ((dcn_compress or "none")
                                if dcn_ab is not None else None),
+        # low-bit wire/compute gates (round 16): the int4 DCN payload
+        # when BENCH_DCN_COMPRESS=int4 ran (~0.51x the int8 bytes:
+        # nibble-packed chunks, full-width scale rows), the quantized
+        # ZeRO-3 gather A/B (BENCH_FSDP_GATHER=int8), and the int8
+        # dense-projection argmax flip rate vs the bf16 forward
+        # (BENCH_MATMUL_DTYPE=int8).  All null when skipped.
+        "train_dcn_int4_bytes_per_step": (
+            dcn_ab["dcn_bytes_per_step"]
+            if dcn_ab is not None and dcn_compress == "int4" else None),
+        "lm_q8_gather_speedup": (round(q8gather_ab["speedup"], 3)
+                                 if q8gather_ab is not None else None),
+        "lm_int8_matmul_fliprate": (round(int8mm["fliprate"], 5)
+                                    if int8mm is not None else None),
         # interleaved-1F1B pipeline A/B (round 10, BENCH_PP_SIZE):
         # tokens/sec of the pp_size-stage LM step, its measured
         # steady-state bubble fraction (from the emitted 1F1B timetable

@@ -80,10 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of slices for --strategy hierarchical: the "
                         "data axis factors into Mesh(('dcn','ici')) and "
                         "cross-slice traffic drops to payload/ici")
-    p.add_argument("--dcn-compress", default=None, choices=["int8"],
+    p.add_argument("--dcn-compress", default=None,
+                   choices=["int8", "int4"],
                    help="quantize the cross-slice (dcn) hop of --strategy "
-                        "hierarchical: int8 ring exchange with per-row "
-                        "scales and error-feedback residuals; the ICI "
+                        "hierarchical: int8 (or int4, two nibbles per "
+                        "wire byte) ring exchange with per-row scales "
+                        "and error-feedback residuals; the ICI "
                         "reduce-scatter/all-gather stay full-precision")
     p.add_argument("--overlap", action="store_true",
                    help="emit each ~25 MB gradient bucket's collective "

@@ -106,10 +106,14 @@ class LMTrainConfig:
     # ``overlap`` each layer group's streamed point consumes/refills
     # its own residual segment.  EF invariant (test-pinned): delivered
     # shard sum + psum_dcn(residuals) == the exact two-level shard sum
-    # — nothing lost, only delayed one step.  Requires dcn_size > 1;
-    # does not compose with pp/pp_size (their gradient paths are
-    # hand-emitted; open item).  Dropping the carry on restart is safe
-    # (residuals re-accumulate within a step; checkpoints skip it).
+    # — nothing lost, only delayed one step.  "int4" (round 16) is the
+    # same machinery one rung lower: [-7, 7] levels, two nibbles packed
+    # per int8 lane around every DCN ppermute (~0.51x the int8 wire
+    # bytes), identical residual layout and EF invariant.  Requires
+    # dcn_size > 1; does not compose with pp/pp_size (their gradient
+    # paths are hand-emitted; open item).  Dropping the carry on
+    # restart is safe (residuals re-accumulate within a step;
+    # checkpoints skip it).
     dcn_compress: str | None = None
     # Streaming bucket size (MB) for the factored-mesh exchange
     # (default: strategies.BUCKET_CAP_MB's ~25 MB): feeds the
@@ -154,6 +158,27 @@ class LMTrainConfig:
     # tick count.
     pp_remat_block: int | None = 0
     fsdp: bool = False   # ZeRO-3: shard params+optimizer over 'data' too
+    # Quantized ZeRO-3 weight all-gathers (round 16): "int8" runs every
+    # fsdp param gather (the post-backward whole-tree path and the
+    # streamed per-layer-group boundary path alike — both route through
+    # ``_fsdp_gather``) as an int8 exchange with per-row f32 scales:
+    # quantize the local shard, all-gather int8 payload + scales over
+    # 'data', dequantize at the consumer.  Weights-not-grads, so there
+    # is no EF carry — the pin is a convergence-curve follow of the
+    # full-precision run plus a jaxpr pin that i8 is on the wire.
+    # Requires fsdp=True (there is no gather to quantize otherwise);
+    # does not compose with pp_size (the 1F1B stacked gather is a
+    # different code path, kept full-precision).  None = exact gathers.
+    fsdp_gather_dtype: str | None = None
+    # Low-bit dense compute (round 16): "int8" routes the transformer's
+    # dense projections (attention q/k/v/o and the MLP matmuls) through
+    # ops/quantized.py's int8xint8->int32 matmul on the FORWARD pass —
+    # per-row activation scales, per-col weight scales, dequant in the
+    # epilogue (Pallas kernel on TPU, lax.dot_general-on-int8 XLA
+    # fallback elsewhere) — while the backward stays in the configured
+    # compute dtype (straight-through estimator).  Flip-rate-measured
+    # against bf16 like the int8 KV cache was.  None = stock matmuls.
+    matmul_dtype: str | None = None
     # Backward-overlapped sync (rounds 8-9): stream the step's bulk
     # communication through the layer-group boundaries (transformer.apply
     # boundary hook) instead of emitting it all-at-once.  With fsdp
@@ -257,21 +282,47 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
     if cfg.bucket_mb is not None and cfg.bucket_mb <= 0:
         raise ValueError(f"bucket_mb must be > 0, got {cfg.bucket_mb}")
     if cfg.dcn_compress is not None:
-        if cfg.dcn_compress != "int8":
+        if cfg.dcn_compress not in ("int8", "int4"):
             raise ValueError(
-                f"dcn_compress must be None or 'int8', got "
+                f"dcn_compress must be None, 'int8', or 'int4', got "
                 f"{cfg.dcn_compress!r}")
         if cfg.dcn_size < 2:
             raise ValueError(
-                "dcn_compress='int8' quantizes the cross-slice (dcn) hop "
-                "of the factored-mesh sync; with dcn_size="
-                f"{cfg.dcn_size} there is no DCN hop to compress")
+                f"dcn_compress={cfg.dcn_compress!r} quantizes the "
+                "cross-slice (dcn) hop of the factored-mesh sync; with "
+                f"dcn_size={cfg.dcn_size} there is no DCN hop to compress")
         if cfg.pp > 1 or cfg.pp_size > 0:
             raise ValueError(
                 "dcn_compress does not compose with pipeline parallelism "
                 "(pp/pp_size): the pipeline gradient paths are "
                 "hand-emitted without the stateful sync-state channel "
                 "(open item); drop the pipeline or the compression")
+    if cfg.fsdp_gather_dtype is not None:
+        if cfg.fsdp_gather_dtype != "int8":
+            raise ValueError(
+                f"fsdp_gather_dtype must be None or 'int8', got "
+                f"{cfg.fsdp_gather_dtype!r}")
+        if not cfg.fsdp:
+            raise ValueError(
+                "fsdp_gather_dtype='int8' quantizes the ZeRO-3 weight "
+                "all-gather; with fsdp=False there is no gather to "
+                "quantize")
+        if cfg.pp_size > 0:
+            raise ValueError(
+                "fsdp_gather_dtype does not compose with pp_size: the "
+                "1F1B stacked per-chunk gather is a separate path kept "
+                "full-precision (open item); drop one")
+    if cfg.matmul_dtype is not None:
+        if cfg.matmul_dtype != "int8":
+            raise ValueError(
+                f"matmul_dtype must be None or 'int8', got "
+                f"{cfg.matmul_dtype!r}")
+        if cfg.pp > 1 or cfg.pp_size > 0:
+            raise ValueError(
+                "matmul_dtype does not compose with pipeline parallelism "
+                "(pp/pp_size): the stage runners call the block body "
+                "directly without the matmul_dtype plumbing (open item); "
+                "drop one")
     if cfg.fsdp and cfg.dp // max(cfg.dcn_size, 1) == 1:
         # param_specs shards ZeRO-3 leaves over the INNER 'data' axis
         # (slice-local); at inner size 1 there is nothing to shard and
@@ -407,16 +458,60 @@ def param_specs(cfg: LMTrainConfig) -> PyTree:
     return jax.tree.map(add_data, specs, shapes)
 
 
-def _fsdp_gather(params: PyTree, specs: PyTree) -> PyTree:
+def _q8_shard_gather(p: jax.Array, dim: int) -> jax.Array:
+    """One fsdp leaf's all-gather, int8 on the wire (round 16,
+    ``fsdp_gather_dtype="int8"``): quantize the LOCAL shard against
+    per-row f32 scales (row = index along the gathered dim, so scales
+    gather along the same axis as the payload), all_gather the int8
+    tensor + scales over 'data', dequantize at the consumer — 4x fewer
+    gather bytes for f32 params, 2x for bf16, plus one f32 scale per
+    row.  Weights-not-grads: the BACKWARD is the PLAIN tiled gather's
+    transpose (the ZeRO reduce-scatter of cotangents, full precision),
+    a straight-through estimator — rounding the forward weights is a
+    small perturbation the optimizer tracks, rounding the gradient
+    stream would need the EF machinery the grad paths use."""
+    axes = tuple(i for i in range(p.ndim) if i != dim)
+
+    def _quantized(x):
+        x32 = x.astype(jnp.float32)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / 127.0,
+            1e-30)
+        q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+        qg = jax.lax.all_gather(q, DATA, axis=dim, tiled=True)
+        sg = jax.lax.all_gather(scale, DATA, axis=dim, tiled=True)
+        return (qg.astype(jnp.float32) * sg).astype(x.dtype)
+
+    @jax.custom_vjp
+    def g(x):
+        return _quantized(x)
+
+    def fwd(x):
+        return _quantized(x), None
+
+    def bwd(_, ct):
+        return (jax.lax.psum_scatter(ct, DATA, scatter_dimension=dim,
+                                     tiled=True),)
+
+    g.defvjp(fwd, bwd)
+    return g(p)
+
+
+def _fsdp_gather(params: PyTree, specs: PyTree,
+                 dtype: str | None = None) -> PyTree:
     """all_gather fsdp-sharded leaves back to full (tp shards stay local).
 
     Inside shard_map; the transpose of these gathers is the reduce-scatter
     that delivers each device only its shard's gradient — ZeRO's comm
-    pattern, synthesized by autodiff.
+    pattern, synthesized by autodiff.  ``dtype="int8"`` swaps each leaf's
+    gather for the quantized exchange (``_q8_shard_gather``); the
+    gradient reduce-scatter stays full-precision either way.
     """
     def gather(p, spec):
         for dim, ax in enumerate(spec):
             if ax == DATA:
+                if dtype == "int8":
+                    return _q8_shard_gather(p, dim)
                 return jax.lax.all_gather(p, DATA, axis=dim, tiled=True)
         return p
 
@@ -628,9 +723,11 @@ def _two_level_sync(g: PyTree, specs: PyTree,
     exchange with ``QuantizedRing._ring_sum`` — int8 payloads + per-row
     f32 scales on each cross-slice transfer, the ICI steps untouched —
     consuming/refilling ``residual`` segments in partition order and
-    returning ``(synced, new_residual)``.  Numerics become
-    bucket-LAYOUT-dependent through the row scales (the layout is the
-    partition above, shared with the residual sizing)."""
+    returning ``(synced, new_residual)``.  ``"int4"`` (round 16) is the
+    same exchange one rung lower: nibble-packed payloads, half the DCN
+    bytes, identical residual layout (``_chunk`` is bits-independent).
+    Numerics become bucket-LAYOUT-dependent through the row scales (the
+    layout is the partition above, shared with the residual sizing)."""
     from .parallel.strategies import QuantizedRing, two_level_psum
 
     g_leaves, td = jax.tree.flatten(g)
@@ -656,9 +753,10 @@ def _two_level_sync(g: PyTree, specs: PyTree,
             for i, s in zip(idxs, synced):
                 out[i] = s
         return jax.tree.unflatten(td, out)
-    # int8 DCN hop (round 11): ring-exchange each bucket, EF residual
-    # segments consumed and refilled in partition order
-    ring = QuantizedRing()
+    # quantized DCN hop (int8 round 11, int4 round 16): ring-exchange
+    # each bucket at the configured bit width, EF residual segments
+    # consumed and refilled in partition order
+    ring = QuantizedRing(bits=4 if dcn_compress == "int4" else 8)
     n_dcn = jax.lax.axis_size(DCN)
     n_ici = jax.lax.axis_size(DATA)
     offset = 0
@@ -703,9 +801,10 @@ def _two_level_sync(g: PyTree, specs: PyTree,
 
 def _dcn_sync_point_stateful(params: PyTree, residual: jax.Array,
                              specs: PyTree,
-                             bucket_bytes: int | None) -> PyTree:
-    """``_dcn_sync_point`` with the int8-compressed DCN hop: the EF
-    residual rides the forward as an inert second input and its
+                             bucket_bytes: int | None,
+                             dcn_compress: str = "int8") -> PyTree:
+    """``_dcn_sync_point`` with the quantized (int8 or int4) DCN hop:
+    the EF residual rides the forward as an inert second input and its
     COTANGENT channel carries the updated residual out of the backward
     (the strategies.sync_boundary_stateful trick) — differentiate the
     loss w.r.t. ``(params, sync_state)`` and the sync-state "gradient"
@@ -719,7 +818,8 @@ def _dcn_sync_point_stateful(params: PyTree, residual: jax.Array,
 
     def bwd(r, g):
         synced, new_r = _two_level_sync(g, specs, bucket_bytes=bucket_bytes,
-                                        dcn_compress="int8", residual=r)
+                                        dcn_compress=dcn_compress,
+                                        residual=r)
         return synced, new_r
 
     point.defvjp(fwd, bwd)
@@ -821,11 +921,12 @@ def _stream_group_boundary(cfg: LMTrainConfig, specs, *, dcn_sync: bool,
                 a = state["off"]
                 state["off"] = a + seg
                 sub = _dcn_sync_point_stateful(sub, residual[a:a + seg],
-                                               specs[k], bucket_bytes)
+                                               specs[k], bucket_bytes,
+                                               cfg.dcn_compress)
             else:
                 sub = _dcn_sync_point(sub, specs[k])
         if cfg.fsdp:
-            sub = _fsdp_gather(sub, specs[k])
+            sub = _fsdp_gather(sub, specs[k], cfg.fsdp_gather_dtype)
         p[k] = sub
         return p
 
@@ -864,10 +965,12 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
         else:
             if dcn_sync:
                 if residual is not None:
-                    # stateful whole-tree point: the int8-ring exchange
-                    # with the EF residual channel (round 11)
+                    # stateful whole-tree point: the quantized-ring
+                    # exchange with the EF residual channel (round 11;
+                    # int4 rung round 16)
                     params = _dcn_sync_point_stateful(
-                        params, residual, specs, bucket_bytes)
+                        params, residual, specs, bucket_bytes,
+                        cfg.dcn_compress)
                 else:
                     # route the data-axis cotangent sync through the
                     # explicit two-level reduction (shard-sized DCN
@@ -875,13 +978,15 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
                     # post-backward contrast shape
                     params = _dcn_sync_point(params, specs)
             if cfg.fsdp:
-                params = _fsdp_gather(params, specs)
+                params = _fsdp_gather(params, specs,
+                                      cfg.fsdp_gather_dtype)
         pos = _shard_positions(cfg, tokens.shape[1])
         logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                                 seq_axis=seq_axis, seq_layout=cfg.seq_layout,
                                 tp_axis=tp_axis, pos=pos,
                                 ep_axis=EXPERT if cfg.ep > 1 else None,
-                                return_aux=True, boundary=boundary)
+                                return_aux=True, boundary=boundary,
+                                matmul_dtype=cfg.matmul_dtype)
         ce_sum, _ = masked_ce(logits, targets)
         # Global mean over every shard's tokens; the batch shards over
         # (data, expert), so 'expert' reduces like a data axis ('model'
@@ -997,15 +1102,16 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
             in_specs=(specs, mspec, mspec, P(), P()),
             out_specs=(P(), specs))
 
-    # int8 DCN hop (round 11): the one post-accumulation exchange rides
-    # the ring with the EF residual threaded through directly (no
-    # custom-vjp needed — the sync runs OUTSIDE the microbatch autodiff)
+    # quantized DCN hop (round 11; int4 rung round 16): the one
+    # post-accumulation exchange rides the ring with the EF residual
+    # threaded through directly (no custom-vjp needed — the sync runs
+    # OUTSIDE the microbatch autodiff)
     rspec = P(tuple(mesh.axis_names))
 
     def local_accum_st(params, res, micro_t, micro_y, n_total, aux_w):
         loss, g = local_grads(params, micro_t, micro_y, n_total, aux_w)
         synced, new_r = _two_level_sync(g, specs, bucket_bytes=bucket_bytes,
-                                        dcn_compress="int8",
+                                        dcn_compress=cfg.dcn_compress,
                                         residual=res[0])
         return loss, synced, new_r[None]
 
@@ -1650,12 +1756,15 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
 
     def local_eval(params, tokens, targets):
         if cfg.fsdp:
-            params = _fsdp_gather(params, specs)
+            # same gather dtype as training: eval sees the weights the
+            # train forward saw (quantized when fsdp_gather_dtype is on)
+            params = _fsdp_gather(params, specs, cfg.fsdp_gather_dtype)
         pos = _shard_positions(cfg, tokens.shape[1])
         logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
                            seq_axis=SEQ if cfg.sp > 1 else None,
                            seq_layout=cfg.seq_layout, tp_axis=MODEL,
-                           ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos)
+                           ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos,
+                           matmul_dtype=cfg.matmul_dtype)
         ce, n = masked_ce(logits, targets)
         axes = _batch_axes(cfg) + (SEQ,)
         return (jax.lax.psum(ce, axes), jax.lax.psum(n, axes))

@@ -92,12 +92,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "dcn-size slices x (dp / dcn-size) chips; the DP "
                         "gradient sync becomes the explicit two-level "
                         "reduction (shard-sized cross-slice payload)")
-    p.add_argument("--dcn-compress", default=None, choices=["int8"],
+    p.add_argument("--dcn-compress", default=None,
+                   choices=["int8", "int4"],
                    help="quantize the cross-slice (dcn) hop of the "
-                        "two-level sync: int8 ring exchange with per-row "
-                        "scales and error-feedback residuals threaded "
-                        "through the train step's sync-state carry "
-                        "(requires --dcn-size >= 2; round 11)")
+                        "two-level sync: int8 (round 11) or int4 (round "
+                        "16, two nibbles per wire byte) ring exchange "
+                        "with per-row scales and error-feedback "
+                        "residuals threaded through the train step's "
+                        "sync-state carry (requires --dcn-size >= 2)")
+    p.add_argument("--fsdp-gather-dtype", default=None, choices=["int8"],
+                   help="quantize the ZeRO-3 weight all-gathers (round "
+                        "16): parameters travel the wire as int8 + "
+                        "per-row f32 scales and dequantize at the "
+                        "consumer; gradient reduce-scatters stay "
+                        "full-precision (requires --fsdp)")
+    p.add_argument("--matmul-dtype", default=None, choices=["int8"],
+                   help="run the transformer's dense projections "
+                        "(q/k/v/o and the non-MoE MLP) through the int8 "
+                        "forward / straight-through backward quantized "
+                        "matmul (round 16; per-row activation x per-col "
+                        "weight scales, Pallas kernel on TPU, the "
+                        "bitwise-equal XLA int8 dot elsewhere)")
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="streaming bucket size for the factored-mesh "
                         "exchange (default: the 25 MB torch-DDP cap)")
@@ -270,6 +285,8 @@ def main(argv: list[str] | None = None) -> int:
         dcn_size=args.dcn_size, grad_accum=args.grad_accum,
         interleave=args.interleave, fsdp=args.fsdp, overlap=args.overlap,
         dcn_compress=args.dcn_compress, bucket_mb=args.bucket_mb,
+        fsdp_gather_dtype=args.fsdp_gather_dtype,
+        matmul_dtype=args.matmul_dtype,
         sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
     trainer = LMTrainer(cfg)
     heartbeat = drain_guard = None

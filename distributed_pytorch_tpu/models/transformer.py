@@ -230,6 +230,7 @@ def block(
     seq_layout: str = "contiguous",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    matmul_dtype: str | None = None,
 ) -> tuple[Array, Array]:
     """One transformer block: (layer params, (B, S, D)) -> (x, moe aux).
 
@@ -243,13 +244,36 @@ def block(
     experts with each expert's FFN width tp-sharded, and the all_to_all
     rides the expert axis.  Without it, experts shard over ``tp_axis``
     (the round-2 layout).
+
+    ``matmul_dtype="int8"`` (round 16) routes the DENSE projections —
+    q/k/v/o and the (non-MoE) MLP matmuls — through the int8 forward /
+    straight-through backward ``ops.quantized.quantized_matmul`` (3D
+    einsum weights reshaped to 2D around the call); ``None`` traces the
+    historical einsums bit-for-bit.
     """
     b, s, d = x.shape
+    q8 = matmul_dtype == "int8"
+
+    def proj2d(h2: Array, w2: Array) -> Array:
+        from ..ops import quantized as qz
+        return qz.quantized_matmul(h2, w2)
+
     # -- attention ---------------------------------------------------------
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
-    k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
-    v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
+    if q8:
+        hf = h.reshape(b * s, d)
+
+        def head_proj(w):
+            heads, dh = w.shape[1], w.shape[2]
+            out = proj2d(hf, w.reshape(d, heads * dh).astype(h.dtype))
+            return out.reshape(b, s, heads, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = (head_proj(lp["wq"]), head_proj(lp["wk"]),
+                   head_proj(lp["wv"]))
+    else:
+        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(h.dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(h.dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(h.dtype))
     q = rotary(q, pos, cfg.rope_theta)
     k = rotary(k, pos, cfg.rope_theta)
     if cfg.kv_heads != cfg.n_heads:
@@ -266,7 +290,12 @@ def block(
         o = attn_ops.flash_attention(q, k, v, causal=True)
     else:
         o = attn_ops.attention_reference(q, k, v, causal=True)
-    o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
+    if q8:
+        of = o.transpose(0, 2, 1, 3).reshape(b * s, -1)
+        o = proj2d(of, lp["wo"].reshape(-1, d).astype(o.dtype)
+                   ).reshape(b, s, d)
+    else:
+        o = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"].astype(o.dtype))
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)  # Megatron row-parallel reduction 1
     x = x + o
@@ -320,6 +349,12 @@ def block(
                 top_k=cfg.moe_top_k, router_mode=cfg.moe_router,
                 z_coef=cfg.router_z_coef)
         down = down.reshape(b, s, d)
+    elif q8:
+        hf = h.reshape(b * s, d)
+        gate = jax.nn.silu(proj2d(hf, lp["w_gate"].astype(h.dtype)))
+        up = proj2d(hf, lp["w_up"].astype(h.dtype))
+        down = proj2d(gate * up, lp["w_down"].astype(h.dtype)
+                      ).reshape(b, s, d)
     else:
         gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
         up = h @ lp["w_up"].astype(h.dtype)
@@ -344,6 +379,7 @@ def apply(
     pos: Array | None = None,      # explicit absolute positions (S,)
     return_aux: bool = False,
     boundary=None,                 # layer-group hook (sync_group_index)
+    matmul_dtype: str | None = None,  # "int8": quantized dense projections
 ) -> Array | tuple[Array, Array]:
     """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
 
@@ -381,7 +417,8 @@ def apply(
         x, aux = block(
             params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
             pos=pos, attn_impl=attn_impl, seq_axis=seq_axis,
-            seq_layout=seq_layout, tp_axis=tp_axis, ep_axis=ep_axis)
+            seq_layout=seq_layout, tp_axis=tp_axis, ep_axis=ep_axis,
+            matmul_dtype=matmul_dtype)
         aux_total = aux_total + aux
 
     if boundary is not None:

@@ -15,7 +15,11 @@ the model; this module closes the loop:
    alpha-beta cost model per link (``LinkModel``: launch latency
    ``alpha_s`` + inverse bandwidth ``beta_s_per_byte``), using each
    algorithm's analytic launch/wire factors so all observations
-   constrain one (alpha, beta) pair.  Profiles cache to a versioned
+   constrain one (alpha, beta) pair.  Round 16 adds one quantize/
+   dequantize round-trip to the same pass (``quant_s_per_byte``): the
+   compute a compressed hop spends to earn its wire saving, so the
+   chooser stops recommending compression on hosts where quantize
+   compute eats the win (the round-11 CPU 0.71x mischoice).  Profiles cache to a versioned
    repo-local JSON (like the XLA compile cache; ``save_profile`` /
    ``load_profile``; a version mismatch invalidates silently), and
    deterministic synthetic profiles (``synthetic_profile``) are
@@ -25,7 +29,8 @@ the model; this module closes the loop:
    given the grad-tree byte census (the same ``make_bucket_plan``
    packing the strategies execute) and a fitted profile, pick the
    bucket size, the ring-vs-tree-vs-two-level algorithm, and per-hop
-   compression (none / int8+EF) by minimizing predicted step-sync
+   compression (none / int8+EF / int4+EF) by minimizing predicted
+   step-sync
    time, emitting an explainable ``SyncPlan`` (predicted ms + operand
    bytes per axis, printable table).  The chooser is a pure function
    of (census, profile, config flags) — deterministic given a fixed
@@ -67,7 +72,11 @@ import numpy as np
 from . import strategies as strat
 from ..utils import telemetry
 
-PROFILE_VERSION = 1
+# 2 since round 16: the cost model gained the quantize-compute term, so
+# a version-1 profile (no quant_s_per_byte) would cost compression the
+# optimistic old way — the exact mischoice this round fixes.  The cache
+# version bump forces recalibration instead of silently steering.
+PROFILE_VERSION = 2
 
 # Bucket-size candidates (MB).  25 first: the torch-DDP default wins
 # ties (strict-improvement argmin), so the chooser only moves off it
@@ -78,6 +87,18 @@ BUCKET_LADDER_MB = (25.0, 4.0, 100.0)
 # 256-element row = chunk * (1 + 4/(4*256)) relative to chunk elements.
 _RING_BLOCK = 256
 _INT8_ROW_OVERHEAD = 1.0 + 1.0 / 64.0  # (1 int8 + 4/256 scale bytes)/elem
+# int4 (round 16): two nibbles per int8 lane halve the chunk payload;
+# the per-row f32 scale rides at full width either way.
+_INT4_ROW_OVERHEAD = 0.5 + 1.0 / 64.0  # (0.5 packed + 4/256 scale)/elem
+
+# Quantize-COMPUTE f32 passes per chunk element per ring hop: every hop
+# dequantizes the incoming chunk and requantizes the outgoing one (2
+# full f32 passes); the int4 rung adds the nibble pack/unpack pair on
+# top.  Charged at the link's calibrated ``quant_s_per_byte`` — this is
+# the term whose absence produced the round-11 CPU mischoice (predicted
+# win, measured 0.71x: the wire saving was real, the quantize compute
+# that paid for it was not in the model).
+_QUANT_PASSES = {"int8": 2.0, "int4": 4.0}
 
 # The two-level gather-back runs all_gather_invariant where available;
 # legacy runtimes fall back to an embed + full-width psum over the fast
@@ -92,11 +113,19 @@ _GATHER_FALLBACK = strat._all_gather_inv is None
 
 @dataclass(frozen=True)
 class LinkModel:
-    """Alpha-beta cost model of one mesh-axis link: a collective costs
-    ``launches * alpha_s + wire_bytes * beta_s_per_byte`` seconds."""
+    """Alpha-beta-quant cost model of one mesh-axis link: a collective
+    costs ``launches * alpha_s + wire_bytes * beta_s_per_byte +
+    quant_bytes * quant_s_per_byte`` seconds, where ``quant_bytes`` is
+    the f32 traffic a compressed hop pushes through quantize/dequantize
+    (and, at int4, nibble pack/unpack) on the way to the wire.  The
+    quant term (round 16) is calibrated from the same pass as alpha/
+    beta; it defaults to 0.0 only for hand-built profile dicts — cached
+    profiles without it are version-1 and recalibrate (PROFILE_VERSION
+    bump)."""
 
     alpha_s: float
     beta_s_per_byte: float
+    quant_s_per_byte: float = 0.0
 
 
 @dataclass
@@ -125,7 +154,8 @@ class TopologyProfile:
         return {"version": self.version, "device_kind": self.device_kind,
                 "axes": dict(self.axes),
                 "links": {a: {"alpha_s": l.alpha_s,
-                              "beta_s_per_byte": l.beta_s_per_byte}
+                              "beta_s_per_byte": l.beta_s_per_byte,
+                              "quant_s_per_byte": l.quant_s_per_byte}
                           for a, l in self.links.items()},
                 "source": self.source, "measured": self.measured}
 
@@ -135,7 +165,11 @@ class TopologyProfile:
                    device_kind=d["device_kind"],
                    axes={a: int(s) for a, s in d["axes"].items()},
                    links={a: LinkModel(float(l["alpha_s"]),
-                                       float(l["beta_s_per_byte"]))
+                                       float(l["beta_s_per_byte"]),
+                                       # pre-round-16 profiles have no
+                                       # quant term: load, cost it free
+                                       float(l.get("quant_s_per_byte",
+                                                   0.0)))
                           for a, l in d["links"].items()},
                    source=d.get("source", "cache"),
                    measured=d.get("measured", {}))
@@ -150,22 +184,48 @@ class TopologyProfile:
 # - uniform:           equal medium links, launch-latency-dominated ->
 #                      the flat fused psum (fewest launches) wins.
 # - fast_ici_slow_dcn: ~400x bandwidth gap -> two-level + int8 on the
-#                      scarce hop (the DynamiQ design point).
+#                      scarce hop (the DynamiQ design point).  int8, NOT
+#                      int4: at 0.5 GB/s the int4 rung's halved wire
+#                      (saves ~2 ns/elem) no longer pays for its doubled
+#                      quantize passes (~1.6 ns/elem extra at the preset
+#                      quant rate) plus the 16x-coarser rounding — the
+#                      quant term keeps the ladder honest.
 # - inverted:          the INNER link is the bottleneck -> two-level
 #                      buys nothing (its reduce-scatter/gather ride the
 #                      slow link either way); flat psum wins on launches.
 # - slow:              one slow flat link -> the int8+EF ring (true
 #                      per-hop wire compression) wins.
 # - fast:              one fast flat link -> plain fused psum wins.
-_FAST = LinkModel(alpha_s=1e-6, beta_s_per_byte=5e-12)     # ~200 GB/s
-_SLOW = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-9)      # ~0.5 GB/s
-_MEDIUM_HIGH_ALPHA = LinkModel(alpha_s=2e-4, beta_s_per_byte=1e-11)
+# - wan_dcn:           a WAN-grade cross-site hop (~0.05 GB/s, round
+#                      16): wire is 10x scarcer than fast_ici_slow_dcn,
+#                      so halving it dominates the extra quantize
+#                      passes -> two-level + int4+EF on the slow hop.
+# - quant_bound:       same 0.5 GB/s DCN hop but a quantize throughput
+#                      of ~0.5 GB/s (a host-bound mesh, e.g. the CPU
+#                      mesh of BASELINE round 11 that measured 0.71x on
+#                      a predicted win): quantize compute eats the wire
+#                      saving -> the chooser DECLINES compression.
+_QUANT = 2e-10  # ~5 GB/s quantize/dequantize throughput (accelerator)
+_FAST = LinkModel(alpha_s=1e-6, beta_s_per_byte=5e-12,     # ~200 GB/s
+                  quant_s_per_byte=_QUANT)
+_SLOW = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-9,      # ~0.5 GB/s
+                  quant_s_per_byte=_QUANT)
+_WAN = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-8,       # ~0.05 GB/s
+                 quant_s_per_byte=_QUANT)
+_SLOW_QUANT_BOUND = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-9,
+                              quant_s_per_byte=2e-9)  # ~0.5 GB/s quant
+_MEDIUM_HIGH_ALPHA = LinkModel(alpha_s=2e-4, beta_s_per_byte=1e-11,
+                               quant_s_per_byte=_QUANT)
 SYNTHETIC_PRESETS = {
     "uniform": lambda axis: _MEDIUM_HIGH_ALPHA,
     "fast_ici_slow_dcn": lambda axis: _SLOW if axis == "dcn" else _FAST,
     "inverted": lambda axis: _FAST if axis == "dcn" else _SLOW,
-    "slow": lambda axis: LinkModel(alpha_s=2e-6, beta_s_per_byte=2e-9),
+    "slow": lambda axis: LinkModel(alpha_s=2e-6, beta_s_per_byte=2e-9,
+                                   quant_s_per_byte=_QUANT),
     "fast": lambda axis: _MEDIUM_HIGH_ALPHA,
+    "wan_dcn": lambda axis: _WAN if axis == "dcn" else _FAST,
+    "quant_bound": lambda axis: (_SLOW_QUANT_BOUND if axis == "dcn"
+                                 else _FAST),
 }
 
 
@@ -316,20 +376,58 @@ def _time_axis_collective(mesh, axis: str, payload_bytes: int, algo: str,
     return best / inner
 
 
+def _time_quantize(payload_bytes: int = 4 << 20, *,
+                   reps: int = 3) -> float:
+    """Seconds per f32 byte of ONE quantize-or-dequantize pass on the
+    default device: time a jitted per-row symmetric int8 round-trip
+    (the ring hops' exact compute shape) over a ``payload_bytes``
+    buffer, best-of-``reps``, and divide by the two passes' f32 bytes.
+    This is the round-16 calibration of ``LinkModel.quant_s_per_byte``
+    — measured on the same pass as alpha/beta so the chooser can weigh
+    wire saved against quantize compute spent on THIS host."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    elems = max(payload_bytes // 4, _RING_BLOCK)
+    elems += (-elems) % _RING_BLOCK
+
+    @jax.jit
+    def roundtrip(x):
+        rows = x.reshape(-1, _RING_BLOCK)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(rows), axis=1, keepdims=True), 1e-30) / 127.0
+        q = jnp.clip(jnp.round(rows / scale), -127, 127).astype(jnp.int8)
+        return (q.astype(jnp.float32) * scale).reshape(x.shape)
+
+    x = jnp.linspace(-1.0, 1.0, elems, dtype=jnp.float32)
+    np.asarray(roundtrip(x))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        roundtrip(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best / (2.0 * elems * 4.0)
+
+
 def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
               algos=("psum", "rs_ag", "ring"),
               inner: int = 4, reps: int = 2) -> TopologyProfile:
     """Fit a ``TopologyProfile`` by timing real collectives per axis of
-    ``mesh`` (the calibration pass).  Axes of size 1 get a zero-cost
-    link (nothing ever crosses them)."""
+    ``mesh`` (the calibration pass), plus one quantize/dequantize
+    round-trip for the compute half of the compressed-hop cost (shared
+    across axes — it runs on the device, not the link).  Axes of size 1
+    get a zero-cost link (nothing ever crosses them)."""
     import time
 
     import jax
 
     t0 = time.perf_counter()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    quant = _time_quantize()
     links: dict[str, LinkModel] = {}
-    measured: dict[str, dict] = {}
+    measured: dict[str, dict] = {"quantize_s_per_byte": quant}
     for axis, n in sizes.items():
         if n < 2:
             links[axis] = LinkModel(alpha_s=0.0, beta_s_per_byte=0.0)
@@ -344,7 +442,8 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
                 launches, wire_per_byte = _algo_factors(algo, n)
                 obs.append((launches, wire_per_byte * b, t))
                 raw[algo][str(b)] = t
-        links[axis] = fit_alpha_beta(obs)
+        links[axis] = dataclasses.replace(fit_alpha_beta(obs),
+                                          quant_s_per_byte=quant)
         measured[axis] = raw
     tel = telemetry.active()
     if tel is not None:
@@ -353,7 +452,8 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
         tel.span_at("autotune_calibrate", t0, time.perf_counter() - t0,
                     phase="autotune", axes=sizes,
                     links={a: {"alpha_s": l.alpha_s,
-                               "beta_s_per_byte": l.beta_s_per_byte}
+                               "beta_s_per_byte": l.beta_s_per_byte,
+                               "quant_s_per_byte": l.quant_s_per_byte}
                            for a, l in links.items()})
     return TopologyProfile(
         version=PROFILE_VERSION,
@@ -533,26 +633,35 @@ def _ring_chunk_elems(elems: int, n: int) -> int:
     return -(-elems // (n * _RING_BLOCK)) * _RING_BLOCK
 
 
-def _int8_ring_bytes(elems: int, n: int) -> tuple[int, int]:
-    """(executed ppermute operand bytes, launches) of one
-    ``QuantizedRing._ring_sum`` over an n-way axis: the reduce-scatter
-    and all-gather scans each run n-1 trips of one int8-chunk ppermute
-    plus one f32 row-scale ppermute."""
+def _quant_ring_bytes(elems: int, n: int, compress: str = "int8"
+                      ) -> tuple[int, int, int]:
+    """(executed ppermute operand bytes, launches, quantize-compute f32
+    bytes) of one ``QuantizedRing._ring_sum`` over an n-way axis: the
+    reduce-scatter and all-gather scans each run n-1 trips of one
+    quantized-chunk ppermute (int8 lanes, or nibble-packed int4 at half
+    width) plus one f32 row-scale ppermute.  The third number is the
+    f32 traffic through quantize/dequantize (+ pack/unpack at int4)
+    those hops cost in COMPUTE — charged at the link's
+    ``quant_s_per_byte``."""
     if n < 2:
-        return 0, 0
+        return 0, 0, 0
     chunk = _ring_chunk_elems(elems, n)
-    per_hop = int(chunk * _INT8_ROW_OVERHEAD)
-    return 2 * (n - 1) * per_hop, 2 * (n - 1)
+    overhead = (_INT4_ROW_OVERHEAD if compress == "int4"
+                else _INT8_ROW_OVERHEAD)
+    hops = 2 * (n - 1)
+    return (hops * int(chunk * overhead), hops,
+            int(hops * chunk * 4 * _QUANT_PASSES[compress]))
 
 
 def _two_level_axis_costs(bucket_elems: list[int], n_ici: int, n_dcn: int,
                           compress: str | None) -> dict[str, tuple]:
-    """Per-axis (operand bytes, launches, wire bytes) of the two-level
-    reduction over the given f32 bucket element counts: reduce-scatter
-    over the fast axis, shard exchange over the slow one (stock psum or
-    the int8 ring), gather back (all_gather_invariant, or the legacy
-    embed + full-width psum fallback)."""
-    ici_bytes = ici_wire = dcn_bytes = dcn_wire = 0
+    """Per-axis (operand bytes, launches, wire bytes, quantize-compute
+    bytes) of the two-level reduction over the given f32 bucket element
+    counts: reduce-scatter over the fast axis, shard exchange over the
+    slow one (stock psum or the int8/int4 ring), gather back
+    (all_gather_invariant, or the legacy embed + full-width psum
+    fallback)."""
+    ici_bytes = ici_wire = dcn_bytes = dcn_wire = dcn_quant = 0
     ici_launch = dcn_launch = 0
     for e in bucket_elems:
         padded = e + (-e) % max(n_ici, 1)
@@ -570,17 +679,18 @@ def _two_level_axis_costs(bucket_elems: list[int], n_ici: int, n_dcn: int,
                 ici_wire += shard * 4 * (n_ici - 1)
             ici_launch += 1
         if n_dcn > 1:
-            if compress == "int8":
-                b, l = _int8_ring_bytes(shard, n_dcn)
+            if compress in ("int8", "int4"):
+                b, l, q = _quant_ring_bytes(shard, n_dcn, compress)
                 dcn_bytes += b
                 dcn_wire += b
                 dcn_launch += l
+                dcn_quant += q
             else:
                 dcn_bytes += shard * 4
                 dcn_wire += 2 * shard * 4 * (n_dcn - 1) // n_dcn
                 dcn_launch += 1
-    return {"ici": (ici_bytes, ici_launch, ici_wire),
-            "dcn": (dcn_bytes, dcn_launch, dcn_wire)}
+    return {"ici": (ici_bytes, ici_launch, ici_wire, 0),
+            "dcn": (dcn_bytes, dcn_launch, dcn_wire, dcn_quant)}
 
 
 def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
@@ -601,9 +711,10 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
     axes = list(profile.axes.items())
     links = profile.links
 
-    def axis_plan(axis, algo, launches, op_bytes, wire, n):
+    def axis_plan(axis, algo, launches, op_bytes, wire, n, qbytes=0):
         link = links[axis]
-        ms = (launches * link.alpha_s + wire * link.beta_s_per_byte) * 1e3
+        ms = (launches * link.alpha_s + wire * link.beta_s_per_byte
+              + qbytes * link.quant_s_per_byte) * 1e3
         return AxisPlan(axis=axis, algorithm=algo, launches=int(launches),
                         predicted_bytes=int(op_bytes), predicted_ms=ms)
 
@@ -650,11 +761,12 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
             sizes = census.bucket_plan(bucket_bytes)
             n_buckets = len(sizes)
             n_tot = int(np.prod([s for _, s in axes]))
-            op_bytes = launches = 0
+            op_bytes = launches = qb = 0
             for b in sizes:
-                bb, ll = _int8_ring_bytes(b // 4, n_tot)
+                bb, ll, qq = _quant_ring_bytes(b // 4, n_tot)
                 op_bytes += bb
                 launches += ll
+                qb += qq
             algo = "int8 ring reduce-scatter/all-gather"
             wire_f = None  # wire == operand bytes for ppermute payloads
         # time: cross every link of the profile at the strategy's width
@@ -671,6 +783,12 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
                 wire = wire_f / 2.0 * 2.0 * B * (n - 1) / n
             ms += (launches * link.alpha_s
                    + wire * link.beta_s_per_byte) * 1e3
+        if name in ("quantized_ring", "quantized_ring_ef"):
+            # quantize COMPUTE happens once per hop on the device, not
+            # per link crossed — charge it once, at the rate of the
+            # slowest active quantizer
+            ms += qb * max((links[a].quant_s_per_byte
+                            for a, s in axes if s > 1), default=0.0) * 1e3
         emitted = "data" if len(axes) > 1 or axes[0][0] == "data" \
             else axes[0][0]
         per_axis = [AxisPlan(axis=emitted, algorithm=algo,
@@ -684,7 +802,7 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
         sizes = {a: s for a, s in axes}
         fast = next((a for a, _ in axes if a != "dcn"), "ici")
         n_dcn, n_fast = sizes.get("dcn", 1), sizes.get(fast, 1)
-        if overlap or dcn_compress == "int8":
+        if overlap or dcn_compress in ("int8", "int4"):
             bucket_elems = [b // 4 for b in census.bucket_plan(bucket_bytes)]
         else:
             # the post-backward plain path flattens the WHOLE tree once
@@ -693,13 +811,13 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
         costs = _two_level_axis_costs(bucket_elems, n_fast, n_dcn,
                                       dcn_compress)
         for axis, row in (("dcn", costs["dcn"]), (fast, costs["ici"])):
-            ob, la, wi = row
-            algo = ("int8 ring exchange" if axis == "dcn"
-                    and dcn_compress == "int8" else
+            ob, la, wi, qb = row
+            algo = (f"{dcn_compress} ring exchange" if axis == "dcn"
+                    and dcn_compress in ("int8", "int4") else
                     "shard-sized psum" if axis == "dcn" else
                     "reduce-scatter + gather")
             per_axis.append(axis_plan(axis, algo, la, ob, wi,
-                                      sizes.get(axis, 1)))
+                                      sizes.get(axis, 1), qbytes=qb))
     else:
         return None
 
@@ -740,9 +858,9 @@ def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
                       ladder: tuple = BUCKET_LADDER_MB) -> SyncPlan:
     """Pick the VGG trainer's sync plan: flat fused psum (``ddp``) vs
     bucketed psum vs the int8+EF ring on flat topologies; flat psum vs
-    two-level (``hierarchical``) with an optional int8 DCN hop on
-    factored ones — each at every ``ladder`` bucket size — by minimum
-    predicted exposed sync time.  Pure function of its arguments
+    two-level (``hierarchical``) with an optional int8 or int4 DCN hop
+    on factored ones — each at every ``ladder`` bucket size — by
+    minimum predicted exposed sync time.  Pure function of its arguments
     (deterministic given a profile; candidate order breaks exact ties
     toward the simpler plan).  A caller with a pinned bucket size
     passes a one-rung ladder so the recorded prediction describes the
@@ -755,6 +873,7 @@ def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
         for mb in ladder:
             candidates.append(("hierarchical", None, mb))
             candidates.append(("hierarchical", "int8", mb))
+            candidates.append(("hierarchical", "int4", mb))
         if overlap:
             for mb in ladder:
                 candidates.append(("bucketed", None, mb))
@@ -785,10 +904,11 @@ def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
     """Pick the LM trainer's sync knobs.  The LM data-axis algorithm is
     structurally fixed (autodiff cotangent psums on flat meshes, the
     explicit two-level reduction when ``dcn_size > 1``); what the
-    profile decides is the slow-hop compression (none vs int8+EF —
-    ``allow_compress=False`` removes the int8 candidates for configs
-    whose step has no sync-state channel, e.g. the pipeline paths) and
-    the streaming bucket size.  Deterministic given a profile.
+    profile decides is the slow-hop compression (none vs int8+EF vs
+    int4+EF — ``allow_compress=False`` removes the compressed
+    candidates for configs whose step has no sync-state channel, e.g.
+    the pipeline paths) and the streaming bucket size.  Deterministic
+    given a profile.
 
     Stated approximation: leaves are costed as if they all ride the
     grouped two-level path; under fsdp the shard-sized leaves skip the
@@ -804,13 +924,14 @@ def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
                         profile=profile, census=census)
         return plan
     best: SyncPlan | None = None
-    for compress in ((None, "int8") if allow_compress else (None,)):
+    for compress in ((None, "int8", "int4") if allow_compress else (None,)):
         for mb in ladder:
             pred = predict_named("hierarchical", census, profile,
                                  bucket_mb=mb, dcn_compress=compress,
                                  overlap=overlap and grad_accum == 1)
             plan = _mk_plan(
-                "two_level" if compress is None else "two_level_int8",
+                "two_level" if compress is None
+                else f"two_level_{compress}",
                 pred, bucket_mb=mb, dcn_compress=compress,
                 dcn_size=dcn_size, overlap=overlap,
                 profile=profile, census=census)

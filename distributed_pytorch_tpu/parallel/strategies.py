@@ -414,7 +414,10 @@ class QuantizedRing:
 
     def __init__(self, bits: int = 8, block: int = 256,
                  bucket_mb: float = BUCKET_CAP_MB):
-        self.levels = 2 ** (bits - 1) - 1
+        if bits not in (4, 8):
+            raise ValueError(f"bits must be 4 or 8, got {bits}")
+        self.bits = bits
+        self.levels = 2 ** (bits - 1) - 1  # 127 at int8, 7 at int4
         self.block = block
         # One ring per ~bucket_mb bucket (make_bucket_plan, round 8): the
         # per-hop block scales are computed within each bucket's own flat
@@ -444,6 +447,35 @@ class QuantizedRing:
 
     def _dequant(self, q: jax.Array, scale: jax.Array) -> jax.Array:
         return (q.astype(jnp.float32) * scale).ravel()
+
+    # -- int4 wire format (bits=4, round 16) ---------------------------
+    # Quantized values live in [-7, 7]; two 4-bit two's-complement
+    # nibbles ride per int8 lane on every ppermute, so the slow hop
+    # moves HALF the int8 payload bytes.  block=256 keeps every chunk
+    # even, so the lane pairing never needs padding.
+
+    def _pack(self, q: jax.Array) -> jax.Array:
+        """(..., even) int4-valued int8 -> flat int8 of half the size,
+        low nibble first."""
+        u = q.reshape(-1, 2).astype(jnp.uint8) & jnp.uint8(0xF)
+        return (u[:, 0] | (u[:, 1] << 4)).astype(jnp.int8)
+
+    def _unpack(self, packed: jax.Array, shape) -> jax.Array:
+        """Inverse of ``_pack``: sign-extend both nibbles back to int8
+        and restore ``shape``."""
+        u = packed.astype(jnp.uint8)
+        lo = ((u & jnp.uint8(0xF)).astype(jnp.int8) ^ 8) - 8
+        hi = (((u >> 4) & jnp.uint8(0xF)).astype(jnp.int8) ^ 8) - 8
+        return jnp.stack([lo, hi], axis=-1).astype(jnp.int8).reshape(shape)
+
+    def _wire(self, q: jax.Array, axis: str, perm) -> jax.Array:
+        """ppermute the quantized payload; at bits=4 the lanes are
+        nibble-packed around the hop so the wire carries q.size/2
+        bytes (the jaxpr pin in tests/test_lowbit.py measures this)."""
+        if self.bits == 8:
+            return lax.ppermute(q, axis, perm)
+        return self._unpack(lax.ppermute(self._pack(q), axis, perm),
+                            q.shape)
 
     def _ring_sum(self, flat: jax.Array, axis: str, n,
                   residual: jax.Array | None = None):
@@ -476,7 +508,7 @@ class QuantizedRing:
             # dropped error (EF uses it; otherwise DCE'd)
             err_rows = lax.dynamic_update_index_in_dim(
                 err_rows, acc - self._dequant(q, s), jnp.mod(me - t, n), 0)
-            q = lax.ppermute(q, axis, perm)
+            q = self._wire(q, axis, perm)
             s = lax.ppermute(s, axis, perm)
             idx = jnp.mod(me - t - 1, n)
             nxt = self._dequant(q, s) + lax.dynamic_index_in_dim(
@@ -500,7 +532,7 @@ class QuantizedRing:
 
         def ag_step(carry, t):
             q_all, s_all, cur_q, cur_s = carry
-            cur_q = lax.ppermute(cur_q, axis, perm)
+            cur_q = self._wire(cur_q, axis, perm)
             cur_s = lax.ppermute(cur_s, axis, perm)
             # payload received at hop t originated at device me-(t+1),
             # i.e. holds reduced chunk (me - t) mod n
@@ -674,6 +706,14 @@ class Hierarchical:
     numerics become bucket-LAYOUT-dependent through the row scales, so
     post-backward and overlap share ONE ``make_bucket_plan`` packing
     exactly like the int8 rings.
+
+    ``dcn_compress="int4"`` (round 16) is the same machinery one rung
+    lower: the ring quantizes to [-7, 7] and nibble-packs two values
+    per int8 lane around every ppermute, so the scarce hop carries
+    ~0.51x the int8 bytes (0.5 + 1/64 scale overhead per element vs
+    1 + 1/64).  Error feedback absorbs the coarser rounding the same
+    way — the EF invariant and the ddp-curve pins hold bit-for-bit in
+    structure, only the per-step quantization noise grows.
     """
 
     name = "hierarchical"
@@ -684,18 +724,21 @@ class Hierarchical:
     def __init__(self, dcn_compress: str | None = None, dcn_size: int = 2,
                  bucket_mb: float = BUCKET_CAP_MB):
         self.bucket_bytes = int(bucket_mb * 1024 * 1024)
-        self._ring = QuantizedRing()  # int8 quant/dequant/_ring_sum helpers
         self.set_dcn(dcn_compress, dcn_size)
 
     def set_dcn(self, compress: str | None, dcn_size: int) -> None:
         """Configure the slow-hop compression (the trainers propagate
         ``TrainConfig.dcn_compress``/``dcn_size`` here before building the
         step OR the sync state — the EF residual layout needs dcn_size)."""
-        if compress not in (None, "int8"):
-            raise ValueError(
-                f"dcn_compress must be None or 'int8', got {compress!r}")
+        if compress not in (None, "int8", "int4"):
+            raise ValueError(f"dcn_compress must be None, 'int8', or "
+                             f"'int4', got {compress!r}")
         self.dcn_compress = compress
         self.dcn_size = dcn_size
+        # quant/dequant/_ring_sum at the wire's bit width; the _chunk
+        # layout is bits-independent, so the EF residual sizing (and
+        # every sync-state contract built on it) is stable across rungs
+        self._ring = QuantizedRing(bits=4 if compress == "int4" else 8)
         # compression adds the EF residual carry and gives up the static
         # replication proof (ppermute ring on the dcn hop)
         self.stateful = compress is not None
@@ -737,7 +780,8 @@ class Hierarchical:
     def _int8_dcn_reduce(self, dcn, n_dcn, residual, out: dict):
         """The compressed slow hop: a ``shard -> summed_shard`` callable
         for ``two_level_psum(dcn_reduce=...)`` that runs the shard
-        exchange as an int8 ring over ``dcn`` and records the dropped
+        exchange as a quantized ring over ``dcn`` at the configured bit
+        width (int8, or nibble-packed int4) and records the dropped
         quantization error (the EF residual) in ``out``."""
         def reduce(shard):
             if n_dcn == 1:  # degraded topology: nothing crosses, no loss

@@ -85,9 +85,11 @@ class TrainConfig:
     # "int8" runs the cross-slice shard exchange as an int8 ring (per-row
     # scales, error-feedback residuals through the sync-state carry)
     # while the ICI reduce-scatter/all-gather stay full-precision — see
-    # strategies.Hierarchical's dcn_compress docstring.  None (default)
-    # keeps the exact full-precision psum.  Rejected for strategies with
-    # no DCN hop.
+    # strategies.Hierarchical's dcn_compress docstring.  "int4" (round
+    # 16) drops one more rung: two nibbles per int8 lane on the wire,
+    # ~0.51x the int8 DCN bytes, same EF carry.  None (default) keeps
+    # the exact full-precision psum.  Rejected for strategies with no
+    # DCN hop.
     dcn_compress: str | None = None
     # Profile source for strategy="auto" (parallel/autotune.py): None =
     # load the repo-local cached profile for this topology or calibrate

@@ -146,11 +146,14 @@ def comm_profile(tr: Trainer, images, labels) -> dict:
 def bench_strategy(name: str) -> tuple[float, dict, bool]:
     """(mean seconds/step over WINDOW iterations, comm profile, overlap
     used); compile + warm-up excluded (the reference's iter-0-excluded
-    window, main.py:43-48).  ``hierarchical_int8`` is the hierarchical
-    strategy with the int8-compressed DCN hop (TrainConfig.dcn_compress)."""
+    window, main.py:43-48).  ``hierarchical_int8`` / ``hierarchical_int4``
+    are the hierarchical strategy with the int8- / int4-compressed DCN
+    hop (TrainConfig.dcn_compress); the per-axis MB column shows the
+    compression on the wire: ~9.23 MB f32 -> ~2.34 MB int8 -> ~1.17 MB
+    int4 over DCN for VGG11, inspector-measured."""
     compress = None
-    if name == "hierarchical_int8":
-        name, compress = "hierarchical", "int8"
+    if name in ("hierarchical_int8", "hierarchical_int4"):
+        name, compress = "hierarchical", name.rsplit("_", 1)[1]
     if name == "auto":
         # the autotuner row (round 11): resolve from the CPU-calibrated
         # factored profile, then measure the resolved plan like any row
@@ -196,6 +199,53 @@ def bench_strategy(name: str) -> tuple[float, dict, bool]:
         float(loss)  # value fetch: the honest end-of-step barrier
         times.append(time.perf_counter() - t0)
     return sum(times) / len(times), comm, overlap
+
+
+def bench_lm_fsdp_q8gather() -> tuple[float, dict, bool]:
+    """The quantized ZeRO-3 all-gather row (round 16): a small LM with
+    ``fsdp=True, fsdp_gather_dtype="int8"`` on the flat 8-way data mesh,
+    same window discipline as the strategy rows.  The wire profile's
+    'data'-axis bytes carry the int8 weight gathers (~1/4 the f32
+    gather width plus the per-row scale rows) next to the cotangent
+    psum_scatters; s/step is not comparable to the VGG rows (different
+    model/loss) — the per-axis bytes are the content."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=4,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    cfg = LMTrainConfig(model=model, dp=N_DEV, fsdp=True,
+                        fsdp_gather_dtype="int8", compute_dtype=None)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    batch, seq = 2 * N_DEV, 128
+    toks = rng.integers(0, 256, (batch, seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    tr.train_step(toks, tgts)  # compile + warm-up (excluded)
+    sched = dbg.op_schedule(tr.step_fn, tr.params, tr.opt_state, toks, tgts)
+    stats = dbg.collective_stats(sched)
+    per_axis = dbg.per_axis_collective_stats(sched)
+    comm = {"comm_bytes_per_step": stats["bytes_executed"],
+            "collective_count": stats["executions"],
+            "comm_bytes_static": stats["bytes"],
+            "collective_count_static": stats["total"],
+            "collectives_interleaved": stats["interleaved"],
+            "comm_bytes_by_axis": {a: s["bytes_executed"]
+                                   for a, s in per_axis.items()},
+            "collective_count_by_axis": {a: s["executions"]
+                                         for a, s in per_axis.items()},
+            "hlo_collective_count": None, "hlo_collectives": None,
+            # no cost-model formula for the fsdp gather row (the LM
+            # chooser owns dcn compression, not the ZeRO-3 gathers)
+            "predicted_ms": None}
+    times = []
+    for _ in range(WINDOW):
+        t0 = time.perf_counter()
+        loss = tr.train_step(toks, tgts)
+        float(loss)  # value fetch: the honest end-of-step barrier
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times), comm, False
 
 
 def bench_lm_pp(pp_size: int = 2,
@@ -255,8 +305,9 @@ def bench_lm_pp(pp_size: int = 2,
 
 def main() -> None:
     names = ["none", "ddp", "bucketed", "hierarchical", "hierarchical_int8",
-             "all_reduce", "gather_scatter_symmetric", "gather_scatter",
-             "quantized", "quantized_ring", "quantized_ring_ef", "auto"]
+             "hierarchical_int4", "all_reduce", "gather_scatter_symmetric",
+             "gather_scatter", "quantized", "quantized_ring",
+             "quantized_ring_ef", "auto"]
     results: dict[str, float] = {}
     comms: dict[str, dict] = {}
     for name in names:
@@ -273,6 +324,15 @@ def main() -> None:
     names.append("lm_pp2_1f1b")
     results["lm_pp2_1f1b"], comms["lm_pp2_1f1b"] = t, comm
     print(json.dumps({"strategy": "lm_pp2_1f1b",
+                      "sec_per_step": round(t, 4), "window": WINDOW,
+                      "per_dev_batch": PER_DEV_BATCH, "overlap": False,
+                      **comm}), flush=True)
+    # the quantized ZeRO-3 gather row (round 16): int8 weight
+    # all-gathers on the wire, same LM caveat as the pipeline row
+    t, comm, _ = bench_lm_fsdp_q8gather()
+    names.append("lm_fsdp_q8gather")
+    results["lm_fsdp_q8gather"], comms["lm_fsdp_q8gather"] = t, comm
+    print(json.dumps({"strategy": "lm_fsdp_q8gather",
                       "sec_per_step": round(t, 4), "window": WINDOW,
                       "per_dev_batch": PER_DEV_BATCH, "overlap": False,
                       **comm}), flush=True)

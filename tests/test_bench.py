@@ -68,7 +68,10 @@ def test_bench_json_keys_include_transformer_gates():
                 "train_step_ms_post_backward",
                 # round-9 factored-mesh DCN A/B keys
                 "train_dcn_overlap_speedup", "train_dcn_bytes_per_step",
-                "train_dcn_compress"):
+                "train_dcn_compress",
+                # round-16 low-bit keys
+                "train_dcn_int4_bytes_per_step", "lm_q8_gather_speedup",
+                "lm_int8_matmul_fliprate"):
         assert key in src, key
     # the knob reaches both inference gates
     assert "BENCH_KV_DTYPE" in src
@@ -79,6 +82,10 @@ def test_bench_json_keys_include_transformer_gates():
     # canonicalized before any measurement
     assert "canon_dcn_size_env" in src and "BENCH_DCN_SIZE" in src
     assert "canon_dcn_compress_env" in src and "BENCH_DCN_COMPRESS" in src
+    # round 16: the quantized-gather and int8-matmul gates follow the
+    # same canonicalize-pre-bench contract
+    assert "canon_fsdp_gather_env" in src and "BENCH_FSDP_GATHER" in src
+    assert "canon_matmul_dtype_env" in src and "BENCH_MATMUL_DTYPE" in src
 
 
 def test_bench_dcn_env_knobs_fail_loudly():
@@ -96,9 +103,25 @@ def test_bench_dcn_env_knobs_fail_loudly():
     assert bench.canon_dcn_compress_env("") is None
     assert bench.canon_dcn_compress_env("none") is None
     assert bench.canon_dcn_compress_env("int8") == "int8"
-    for bad in ("fp8", "INT8", "1"):
+    assert bench.canon_dcn_compress_env("int4") == "int4"
+    for bad in ("fp8", "INT8", "1", "int2"):
         with pytest.raises(ValueError, match="BENCH_DCN_COMPRESS"):
             bench.canon_dcn_compress_env(bad)
+    # round 16: the quantized-gather and int8-matmul knobs, same contract
+    assert bench.canon_fsdp_gather_env(None) is None
+    assert bench.canon_fsdp_gather_env("") is None
+    assert bench.canon_fsdp_gather_env("none") is None
+    assert bench.canon_fsdp_gather_env("int8") == "int8"
+    for bad in ("int4", "fp8", "INT8"):
+        with pytest.raises(ValueError, match="BENCH_FSDP_GATHER"):
+            bench.canon_fsdp_gather_env(bad)
+    assert bench.canon_matmul_dtype_env(None) is None
+    assert bench.canon_matmul_dtype_env("") is None
+    assert bench.canon_matmul_dtype_env("none") is None
+    assert bench.canon_matmul_dtype_env("int8") == "int8"
+    for bad in ("int4", "bf16", "INT8"):
+        with pytest.raises(ValueError, match="BENCH_MATMUL_DTYPE"):
+            bench.canon_matmul_dtype_env(bad)
 
 
 def test_bench_train_dcn_uses_hardened_window_and_inspector():
@@ -154,7 +177,10 @@ def test_bench_strategies_emits_comm_columns():
                 # round 9: per-axis (dcn vs ici) byte/count columns from
                 # per_axis_collective_stats, plus the compressed-hop row
                 "comm_bytes_by_axis", "collective_count_by_axis",
-                "per_axis_collective_stats", "hierarchical_int8"):
+                "per_axis_collective_stats", "hierarchical_int8",
+                # round 16: the half-width DCN row and the quantized
+                # ZeRO-3 gather row
+                "hierarchical_int4", "lm_fsdp_q8gather"):
         assert key in src, key
 
 

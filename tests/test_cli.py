@@ -51,6 +51,36 @@ def test_parser_overlap_and_dcn_flags():
     assert lm_args.dcn_size == 2 and lm_args.overlap
 
 
+def test_parser_lowbit_flags():
+    """Round-16 surface: --dcn-compress grows int4 on BOTH CLIs, and
+    the LM CLI gains --fsdp-gather-dtype / --matmul-dtype (defaults
+    None so historical invocations are byte-identical); the int4 wire
+    format has no gather/matmul analogue, so those parsers refuse it."""
+    import pytest
+
+    from distributed_pytorch_tpu import lm_cli
+
+    args = cli.build_parser().parse_args(
+        ["--strategy", "hierarchical", "--dcn-size", "2",
+         "--dcn-compress", "int4"])
+    assert args.dcn_compress == "int4"
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.fsdp_gather_dtype is None
+    assert lm_args.matmul_dtype is None
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--dp", "4", "--dcn-size", "2", "--dcn-compress", "int4",
+         "--fsdp", "--fsdp-gather-dtype", "int8",
+         "--matmul-dtype", "int8"])
+    assert lm_args.dcn_compress == "int4"
+    assert lm_args.fsdp_gather_dtype == "int8"
+    assert lm_args.matmul_dtype == "int8"
+    for bad in (["--fsdp-gather-dtype", "int4"],
+                ["--matmul-dtype", "int4"],
+                ["--dcn-compress", "fp8"]):
+        with pytest.raises(SystemExit):
+            lm_cli.build_parser().parse_args(bad)
+
+
 def test_init_single_host_is_noop():
     dist_init.init_distributed(None, num_nodes=1, rank=0)  # must not raise
 
