@@ -966,6 +966,95 @@ def bench_lm_large(iters: int = 12, batch: int = 4,
                         seq, sync_every=1)
 
 
+def canon_loss_impl_env(value: str | None) -> str | None:
+    """Validate BENCH_LOSS_IMPL (round 17): unset/'' skips the
+    activation-memory gate's loss leg (the default); 'dense' / 'chunked'
+    selects which head the gate measures.  Fails loudly pre-bench like
+    BENCH_KV_DTYPE."""
+    if value is None or value == "":
+        return None
+    if value in ("dense", "chunked"):
+        return value
+    raise ValueError(
+        f"BENCH_LOSS_IMPL must be ''/'dense'/'chunked', got {value!r}")
+
+
+def canon_remat_env(value: str | None) -> str | None:
+    """Validate BENCH_REMAT (round 17): unset/'' skips the gate's remat
+    leg; 'none' / 'full' / 'selective' selects the layer-stack
+    checkpointing the gate measures.  Fails loudly pre-bench like
+    BENCH_KV_DTYPE."""
+    if value is None or value == "":
+        return None
+    if value in ("none", "full", "selective"):
+        return value
+    raise ValueError(
+        f"BENCH_REMAT must be ''/'none'/'full'/'selective', got {value!r}")
+
+
+def bench_lm_memory(loss_impl: str | None, remat: str | None,
+                    iters: int = 10, batch: int = 4,
+                    seq: int = 512, reps: int = 3) -> dict | None:
+    """Activation-memory gate (round 17, BENCH_LOSS_IMPL /
+    BENCH_REMAT): A/B the requested (loss_impl, remat) LM step against
+    the stock (dense, none) step — same model, same data, alternating
+    timed windows, median-of-reps — and put the accountant's numbers
+    next to the measured ones:
+
+    - ``peak_activation_bytes``: utils.memacct's census-verified
+      prediction of the variant's saved-residual footprint;
+    - ``remat_saved_bytes``: bytes the remat knob shaves off the
+      no-remat footprint at the same head (0 when remat is 'none');
+    - ``step_overhead_pct``: the measured recompute price, (variant -
+      baseline)/baseline ms/step — what the memory chooser's
+      ``recompute_s_per_byte`` term is supposed to predict.
+
+    Both steps train the SAME losses to ~1e-6 (chunked) or bitwise
+    (remat; test-pinned), so the overhead is pure schedule + recompute.
+    """
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.utils import memacct
+
+    li = loss_impl or "dense"
+    rm = remat or "none"
+    model = _lm_cfg()
+
+    def build(li_: str, rm_: str) -> LMTrainer:
+        return LMTrainer(LMTrainConfig(model=model, loss_impl=li_,
+                                       remat=rm_))
+
+    trainers = {"base": build("dense", "none"), "var": build(li, rm)}
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, model.vocab_size, (batch, seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+    for tr in trainers.values():
+        float(tr.train_step(toks, tgts))  # compile + warm
+    times: dict[str, list[float]] = {"base": [], "var": []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = tr.train_step(toks, tgts)
+            float(loss)
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    overhead = (med["var"] - med["base"]) / max(med["base"], 1e-9) * 100.0
+    # dp defaults to 1 here, so the whole batch is the per-device batch
+    peak = memacct.predict_activation_bytes(
+        model, batch=batch, seq=seq, remat=rm, loss_impl=li)
+    saved = memacct.predict_activation_bytes(
+        model, batch=batch, seq=seq, remat="none", loss_impl=li) - peak
+    _log(f"[bench] lm-memory gate (loss_impl={li}, remat={rm}): "
+         f"{med['var']:.2f} ms/step vs {med['base']:.2f} dense/none "
+         f"({overhead:+.1f}%), predicted peak {peak / 1e6:.2f} MB, "
+         f"remat saves {saved / 1e6:.2f} MB")
+    return {"loss_impl": li, "remat": rm,
+            "peak_activation_bytes": int(peak),
+            "remat_saved_bytes": int(saved),
+            "step_overhead_pct": overhead,
+            "ms_variant": med["var"], "ms_base": med["base"]}
+
+
 def bench_decode(max_new: int = 4096, base: int = 256,
                  reps: int = 5,
                  kv_dtype: str | None = None
@@ -1290,6 +1379,11 @@ def main() -> None:
     fsdp_gather = canon_fsdp_gather_env(os.environ.get("BENCH_FSDP_GATHER"))
     matmul_dtype = canon_matmul_dtype_env(
         os.environ.get("BENCH_MATMUL_DTYPE"))
+    # Activation-memory knobs (round 17), validated loudly pre-bench:
+    # BENCH_LOSS_IMPL=chunked / BENCH_REMAT=full|selective A/B the
+    # memory-thrifty LM step against the stock dense/no-remat one.
+    mem_loss_impl = canon_loss_impl_env(os.environ.get("BENCH_LOSS_IMPL"))
+    mem_remat = canon_remat_env(os.environ.get("BENCH_REMAT"))
     # Interleaved-1F1B pipeline A/B knobs (round 10), validated loudly
     # pre-bench: BENCH_PP_SIZE >= 2 runs the LM pipeline A/B on a
     # pp_size-staged virtual mesh; BENCH_MICROBATCHES sets M (default
@@ -1361,6 +1455,16 @@ def main() -> None:
             int8mm = bench_lm_int8_matmul()
         except Exception as e:
             _log(f"[bench] lm-int8matmul gate failed ({e}); omitting")
+
+    # Activation-memory gate (round 17): the chunked-CE/remat LM step
+    # vs dense/no-remat, with the accountant's predicted footprint next
+    # to the measured overhead; optional like the other gates.
+    mem_ab = None
+    if mem_loss_impl is not None or mem_remat is not None:
+        try:
+            mem_ab = bench_lm_memory(mem_loss_impl, mem_remat)
+        except Exception as e:
+            _log(f"[bench] lm-memory gate failed ({e}); omitting")
 
     # Interleaved-1F1B pipeline A/B (round 10): LM pp_size stages vs
     # single-stage on the virtual mesh; optional like the other gates.
@@ -1495,6 +1599,20 @@ def main() -> None:
                                  if q8gather_ab is not None else None),
         "lm_int8_matmul_fliprate": (round(int8mm["fliprate"], 5)
                                     if int8mm is not None else None),
+        # activation-memory gate (round 17, BENCH_LOSS_IMPL/BENCH_REMAT):
+        # the accountant's census-verified predicted peak for the
+        # measured (loss_impl, remat) step, the bytes the remat knob
+        # saves vs no-remat at the same head, and the measured recompute
+        # price as a ms/step overhead vs the stock dense/none step.
+        # All null when the gate is skipped.
+        "lm_ce_peak_activation_bytes": (
+            mem_ab["peak_activation_bytes"]
+            if mem_ab is not None else None),
+        "lm_remat_saved_bytes": (mem_ab["remat_saved_bytes"]
+                                 if mem_ab is not None else None),
+        "lm_remat_step_overhead_pct": (
+            round(mem_ab["step_overhead_pct"], 3)
+            if mem_ab is not None else None),
         # interleaved-1F1B pipeline A/B (round 10, BENCH_PP_SIZE):
         # tokens/sec of the pp_size-stage LM step, its measured
         # steady-state bubble fraction (from the emitted 1F1B timetable

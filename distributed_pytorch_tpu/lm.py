@@ -41,7 +41,8 @@ from .utils import compat
 from .utils import monitor
 from .utils import telemetry
 from .utils.compat import shard_map
-from .ops.nn import IGNORE_INDEX, masked_ce, step_metrics
+from .ops.nn import IGNORE_INDEX, masked_ce, step_metrics  # noqa: F401
+from .ops import losses
 from .parallel import context as ctx
 from .parallel.mesh import make_mesh
 
@@ -206,6 +207,35 @@ class LMTrainConfig:
     # the aux term therefore shifts slightly, exactly as it does for any
     # other change of group size (dp/tp splits included).
     grad_accum: int = 1
+    # Head-loss implementation (round 17): "dense" materializes the full
+    # (B, T, V) f32 logits and calls masked_ce — the historical graph,
+    # bit-for-bit.  "chunked" streams the head projection + an online
+    # logsumexp over vocab chunks (ops/losses.py masked_ce_chunked, a
+    # custom-vjp whose backward recomputes each chunk's logits and emits
+    # the hidden/embedding cotangents directly) so the logits tensor
+    # never exists — on real TPUs it is the single largest activation
+    # and the cap on per-device batch size.  Under tp > 1 the chunked
+    # head additionally shards the vocab over 'model' (per-rank partial
+    # logsumexp + one pmax/psum combine).  Matches dense to ~1e-6.
+    loss_impl: str = "dense"
+    # Vocab rows per streamed chunk for loss_impl="chunked"; must divide
+    # the per-rank vocab (V, or V // tp when tp > 1).  None = the largest
+    # divisor <= 1024 (ops/losses.py default_chunk).
+    loss_chunk: int | None = None
+    # Activation rematerialization for the non-pp layer stack (round 17):
+    # "full" wraps each transformer block in jax.checkpoint (only the
+    # layer-boundary carries stay live through the backward; everything
+    # else recomputes), "selective" additionally saves the flash
+    # attention (o, lse) pair via checkpoint names so only the
+    # projections and MLP recompute — the usual best point on the
+    # memory/time curve.  Losses are bitwise-equal to remat="none" (the
+    # recompute replays the identical ops).  The sync custom-vjp
+    # boundaries (overlap streaming, ZeRO-3 gathers, two-level DCN
+    # points) sit OUTSIDE the checkpointed block, so no sync collective
+    # is re-emitted — schedule-inspector-pinned.  Does not compose with
+    # pp/pp_size: parallel/pipeline.py owns its own per-tick remat
+    # (pp_remat_block).  "none" = historical graph.
+    remat: str = "none"
     @property
     def dtype(self) -> jnp.dtype | None:
         """compute_dtype resolved to a jnp dtype (None = float32 params)."""
@@ -323,6 +353,42 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
                 "(pp/pp_size): the stage runners call the block body "
                 "directly without the matmul_dtype plumbing (open item); "
                 "drop one")
+    if cfg.loss_impl not in ("dense", "chunked"):
+        raise ValueError(
+            f"loss_impl must be 'dense' or 'chunked', got "
+            f"{cfg.loss_impl!r}")
+    if (cfg.loss_impl == "chunked" and cfg.tp > 1 and cfg.pp == 1
+            and cfg.pp_size == 0 and cfg.model.vocab_size % cfg.tp):
+        raise ValueError(
+            f"vocab_size {cfg.model.vocab_size} must divide over "
+            f"tp={cfg.tp} for the chunked (vocab-sharded) head")
+    if cfg.loss_chunk is not None:
+        if cfg.loss_impl != "chunked":
+            raise ValueError(
+                f"loss_chunk={cfg.loss_chunk} only applies to "
+                "loss_impl='chunked'; the dense head has no chunk size "
+                "(set loss_impl='chunked' or drop loss_chunk)")
+        v = cfg.model.vocab_size
+        # the streamed head shards the vocab over 'model' only on the
+        # non-pp SPMD path; the pipeline heads chunk the full vocab
+        v_local = v // cfg.tp if (cfg.tp > 1 and cfg.pp == 1
+                                  and cfg.pp_size == 0) else v
+        if cfg.loss_chunk <= 0 or v_local % cfg.loss_chunk:
+            raise ValueError(
+                f"loss_chunk={cfg.loss_chunk} must be a positive divisor "
+                f"of the per-rank vocab rows ({v_local}"
+                + (f" = {v} // tp={cfg.tp}" if v_local != v else "")
+                + ") — the streaming scan needs equal-sized chunks")
+    if cfg.remat not in ("none", "full", "selective"):
+        raise ValueError(
+            f"remat must be 'none', 'full' or 'selective', got "
+            f"{cfg.remat!r}")
+    if cfg.remat != "none" and (cfg.pp > 1 or cfg.pp_size > 0):
+        raise ValueError(
+            "remat does not compose with pipeline parallelism "
+            "(pp/pp_size): the pipeline schedulers own their own "
+            "rematerialization (pp_remat_block wraps each tick block in "
+            "jax.checkpoint already); drop one")
     if cfg.fsdp and cfg.dp // max(cfg.dcn_size, 1) == 1:
         # param_specs shards ZeRO-3 leaves over the INNER 'data' axis
         # (slice-local); at inner size 1 there is nothing to shard and
@@ -981,13 +1047,23 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
                 params = _fsdp_gather(params, specs,
                                       cfg.fsdp_gather_dtype)
         pos = _shard_positions(cfg, tokens.shape[1])
-        logits, aux = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
-                                seq_axis=seq_axis, seq_layout=cfg.seq_layout,
-                                tp_axis=tp_axis, pos=pos,
-                                ep_axis=EXPERT if cfg.ep > 1 else None,
-                                return_aux=True, boundary=boundary,
-                                matmul_dtype=cfg.matmul_dtype)
-        ce_sum, _ = masked_ce(logits, targets)
+        # the unified head-loss seam (round 17, ops/losses.py): apply
+        # hands the final-norm hidden states + the boundary-transformed
+        # tied embedding (under streaming ZeRO-3 the GATHERED copy) to
+        # head_loss, which routes dense (historical ops, bit-for-bit) or
+        # chunked (streamed logits; vocab tp-sharded when tp > 1)
+        head = partial(losses.head_loss, targets=targets,
+                       loss_impl=cfg.loss_impl, loss_chunk=cfg.loss_chunk,
+                       tp_axis=tp_axis if cfg.tp > 1 else None,
+                       tp_size=cfg.tp)
+        (ce_sum, _), aux = tfm.apply(
+            params, tokens, cfg=cfg.model, dtype=dtype,
+            seq_axis=seq_axis, seq_layout=cfg.seq_layout,
+            tp_axis=tp_axis, pos=pos,
+            ep_axis=EXPERT if cfg.ep > 1 else None,
+            return_aux=True, boundary=boundary,
+            matmul_dtype=cfg.matmul_dtype, remat=cfg.remat,
+            head_fn=head)
         # Global mean over every shard's tokens; the batch shards over
         # (data, expert), so 'expert' reduces like a data axis ('model'
         # shards compute identical values, no reduction needed there).
@@ -1279,7 +1355,8 @@ def make_lm_pp_train_step(cfg: LMTrainConfig, mesh: Mesh):
             tp_axis=tp_axis, seq_axis=seq_axis,
             seq_layout=cfg.seq_layout, pos=pos,
             interleave=cfg.interleave,
-            remat_block_ticks=cfg.pp_remat_block)
+            remat_block_ticks=cfg.pp_remat_block,
+            loss_impl=cfg.loss_impl, loss_chunk=cfg.loss_chunk)
         ce_sum = jax.lax.psum(ce_sum, (DATA, PIPE, SEQ))
         n = jax.lax.psum(n, (DATA, PIPE, SEQ))
         # aux: layers are SPLIT across 'pipe' (sum) and each rank's
@@ -1529,9 +1606,12 @@ def make_lm_1f1b_train_step(cfg: LMTrainConfig, mesh: Mesh):
                 tp_axis=tp_axis, seq_axis=seq_axis,
                 seq_layout=cfg.seq_layout, pos=pos, is_moe=is_moe)
             h = tfm.rms_norm(y, fn_, model.norm_eps)
-            logits = (h.astype(jnp.float32)
-                      @ emb_out.T.astype(jnp.float32))
-            ce, _ = masked_ce(logits, tgts)
+            # the unified head-loss seam (ops/losses.py): dense traces the
+            # historical logits matmul + masked_ce bit-for-bit; the 1F1B
+            # head keeps the full vocab per rank (no tp vocab sharding)
+            ce, _ = losses.head_loss(h, emb_out, tgts,
+                                     loss_impl=cfg.loss_impl,
+                                     loss_chunk=cfg.loss_chunk)
             return y, jnp.where(is_last, ce, 0.0), aux
 
         def at2(buf, i, j):
@@ -1760,12 +1840,18 @@ def make_lm_eval_step(cfg: LMTrainConfig, mesh: Mesh):
             # train forward saw (quantized when fsdp_gather_dtype is on)
             params = _fsdp_gather(params, specs, cfg.fsdp_gather_dtype)
         pos = _shard_positions(cfg, tokens.shape[1])
-        logits = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
-                           seq_axis=SEQ if cfg.sp > 1 else None,
-                           seq_layout=cfg.seq_layout, tp_axis=MODEL,
-                           ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos,
-                           matmul_dtype=cfg.matmul_dtype)
-        ce, n = masked_ce(logits, targets)
+        # same head-loss seam as training (ops/losses.py head_loss):
+        # dense is the historical graph bit-for-bit; no remat — there is
+        # no backward to hold activations for
+        head = partial(losses.head_loss, targets=targets,
+                       loss_impl=cfg.loss_impl, loss_chunk=cfg.loss_chunk,
+                       tp_axis=MODEL if cfg.tp > 1 else None,
+                       tp_size=cfg.tp)
+        ce, n = tfm.apply(params, tokens, cfg=cfg.model, dtype=dtype,
+                          seq_axis=SEQ if cfg.sp > 1 else None,
+                          seq_layout=cfg.seq_layout, tp_axis=MODEL,
+                          ep_axis=EXPERT if cfg.ep > 1 else None, pos=pos,
+                          matmul_dtype=cfg.matmul_dtype, head_fn=head)
         axes = _batch_axes(cfg) + (SEQ,)
         return (jax.lax.psum(ce, axes), jax.lax.psum(n, axes))
 
@@ -1861,7 +1947,8 @@ def make_lm_pp_eval_step(cfg: LMTrainConfig, mesh: Mesh):
             tp_axis=tp_axis, seq_axis=seq_axis,
             seq_layout=cfg.seq_layout, pos=pos,
             interleave=cfg.interleave,
-            remat_block_ticks=None)
+            remat_block_ticks=None,
+            loss_impl=cfg.loss_impl, loss_chunk=cfg.loss_chunk)
         return (jax.lax.psum(ce_sum, (DATA, PIPE, SEQ)),
                 jax.lax.psum(n, (DATA, PIPE, SEQ)))
 

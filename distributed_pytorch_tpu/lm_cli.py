@@ -113,6 +113,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "matmul (round 16; per-row activation x per-col "
                         "weight scales, Pallas kernel on TPU, the "
                         "bitwise-equal XLA int8 dot elsewhere)")
+    p.add_argument("--loss-impl", default=None,
+                   choices=["dense", "chunked"],
+                   help="cross-entropy head (round 17): 'dense' "
+                        "materializes the (B, T, V) f32 logits; "
+                        "'chunked' streams the head projection + "
+                        "logsumexp over vocab chunks so the full logits "
+                        "tensor never exists (matches dense to ~1e-6; "
+                        "composes with --tp via per-shard partial "
+                        "logsumexp)")
+    p.add_argument("--loss-chunk", type=int, default=None,
+                   help="vocab chunk size for --loss-impl chunked (must "
+                        "divide the per-rank vocab; default: largest "
+                        "divisor <= 1024)")
+    p.add_argument("--remat", default=None,
+                   choices=["none", "full", "selective"],
+                   help="layer-stack rematerialization (round 17): "
+                        "'full' saves only each block's input carry and "
+                        "recomputes the block in the backward; "
+                        "'selective' additionally saves the flash "
+                        "kernel's (o, lse) so only the projections/MLP "
+                        "recompute.  Losses bitwise-equal to 'none' "
+                        "(test-pinned); does not compose with --pp/"
+                        "--pp-size (the pipeline owns its own remat)")
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="streaming bucket size for the factored-mesh "
                         "exchange (default: the 25 MB torch-DDP cap)")
@@ -241,6 +264,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.mmap_corpus and not args.corpus:
         parser.error("--mmap-corpus requires --corpus (the synthetic "
                      "fallback is generated in RAM)")
+    if args.loss_chunk is not None and args.loss_impl != "chunked":
+        parser.error("--loss-chunk tunes the chunked head; pass "
+                     "--loss-impl chunked (or drop the chunk size)")
+    if args.remat in ("full", "selective") and (args.pp > 1
+                                                or args.pp_size > 0):
+        parser.error("--remat does not compose with --pp/--pp-size: the "
+                     "pipeline schedulers own their own rematerialization "
+                     "(each tick block is already checkpointed); drop one")
     if args.elastic:
         # refuse loudly anything that CANNOT resize: a pipeline's stage
         # placement is baked into the hand-emitted step, so a resized
@@ -287,6 +318,8 @@ def main(argv: list[str] | None = None) -> int:
         dcn_compress=args.dcn_compress, bucket_mb=args.bucket_mb,
         fsdp_gather_dtype=args.fsdp_gather_dtype,
         matmul_dtype=args.matmul_dtype,
+        loss_impl=args.loss_impl or "dense", loss_chunk=args.loss_chunk,
+        remat=args.remat or "none",
         sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
     trainer = LMTrainer(cfg)
     heartbeat = drain_guard = None

@@ -231,6 +231,7 @@ def block(
     tp_axis: str | None = None,
     ep_axis: str | None = None,
     matmul_dtype: str | None = None,
+    save_attn: bool = False,
 ) -> tuple[Array, Array]:
     """One transformer block: (layer params, (B, S, D)) -> (x, moe aux).
 
@@ -250,6 +251,12 @@ def block(
     straight-through backward ``ops.quantized.quantized_matmul`` (3D
     einsum weights reshaped to 2D around the call); ``None`` traces the
     historical einsums bit-for-bit.
+
+    ``save_attn`` (round 17, ``apply(remat="selective")``): request the
+    flash kernel's ``(o, lse)`` form so its residuals carry the
+    ``attn_out``/``attn_lse`` checkpoint names (ops/attention.py) that a
+    ``save_only_these_names`` policy pins — attention stays saved while
+    the MLP recomputes.  ``False`` traces the historical kernel call.
     """
     b, s, d = x.shape
     q8 = matmul_dtype == "int8"
@@ -287,7 +294,11 @@ def block(
             q, k, v, seq_axis, causal=True, layout=seq_layout,
             impl="flash" if attn_impl == "flash" else "reference")
     elif attn_impl == "flash":
-        o = attn_ops.flash_attention(q, k, v, causal=True)
+        if save_attn:
+            o, _ = attn_ops.flash_attention(q, k, v, causal=True,
+                                            with_lse=True)
+        else:
+            o = attn_ops.flash_attention(q, k, v, causal=True)
     else:
         o = attn_ops.attention_reference(q, k, v, causal=True)
     if q8:
@@ -380,6 +391,8 @@ def apply(
     return_aux: bool = False,
     boundary=None,                 # layer-group hook (sync_group_index)
     matmul_dtype: str | None = None,  # "int8": quantized dense projections
+    remat: str | None = None,      # None/"none" | "full" | "selective"
+    head_fn=None,                  # (h, embed) -> loss head replacement
 ) -> Array | tuple[Array, Array]:
     """Forward pass: (B, S) int32 tokens -> (B, S, vocab) float32 logits.
 
@@ -401,7 +414,29 @@ def apply(
     or streaming ZeRO-3 gathers exactly where each group's params are
     first consumed (lm.py overlap=True).  ``None`` traces the historical
     graph.
+
+    ``remat`` (round 17): activation rematerialization of the per-layer
+    body.  ``"full"`` wraps each block in ``jax.checkpoint`` with the
+    default policy (only the layer-boundary carry is saved; everything
+    recomputes in the backward); ``"selective"`` additionally saves the
+    flash kernel's ``(o, lse)`` via the ``attn_out``/``attn_lse``
+    checkpoint names so only the projections and MLP recompute.  The
+    ``boundary`` hook stays OUTSIDE the checkpointed region — its sync /
+    ZeRO-3-gather collectives are traced once, never re-emitted by the
+    remat backward.  ``None``/``"none"`` traces the historical graph
+    bit-for-bit.
+
+    ``head_fn``: when given, called as ``head_fn(h, params["embed"])`` on
+    the final-norm hidden states in place of the logits matmul and its
+    result returned where logits would be — the seam lm.py routes the
+    unified head loss through (ops/losses.py head_loss), keeping the tied
+    embedding the BOUNDARY-transformed one (under streaming ZeRO-3 the
+    gathered copy, not the caller's shard).
     """
+    if remat not in (None, "none", "full", "selective"):
+        raise ValueError(
+            f"unknown remat {remat!r}: expected 'none', 'full' or "
+            "'selective'")
     if boundary is not None:
         params = boundary(0, params)  # the tied embedding's group
     x = params["embed"][tokens]  # (B, S, D)
@@ -411,23 +446,41 @@ def apply(
         pos = pos0 + jnp.arange(x.shape[1])
     aux_total = jnp.zeros((), jnp.float32)
 
+    use_remat = remat in ("full", "selective")
+    remat_policy = (jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "attn_lse") if remat == "selective" else None)
+
     for i in range(cfg.n_layers):
         if boundary is not None:
             params = boundary(i + 1, params)
-        x, aux = block(
-            params[f"layer{i}"], x, cfg=cfg, is_moe=cfg.is_moe_layer(i),
-            pos=pos, attn_impl=attn_impl, seq_axis=seq_axis,
-            seq_layout=seq_layout, tp_axis=tp_axis, ep_axis=ep_axis,
-            matmul_dtype=matmul_dtype)
+
+        def run(lp, x_in, pos_in, _i=i):
+            return block(
+                lp, x_in, cfg=cfg, is_moe=cfg.is_moe_layer(_i),
+                pos=pos_in, attn_impl=attn_impl, seq_axis=seq_axis,
+                seq_layout=seq_layout, tp_axis=tp_axis, ep_axis=ep_axis,
+                matmul_dtype=matmul_dtype,
+                save_attn=remat == "selective")
+
+        if use_remat:
+            # prevent_cse=False: inside jit/shard_map the CSE concern
+            # jax.checkpoint guards against does not arise (same setting
+            # as the pipeline stage remat, parallel/pipeline.py)
+            run = jax.checkpoint(run, policy=remat_policy,
+                                 prevent_cse=False)
+        x, aux = run(params[f"layer{i}"], x, pos)
         aux_total = aux_total + aux
 
     if boundary is not None:
         params = boundary(cfg.n_layers + 1, params)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    if head_fn is not None:
+        out = head_fn(x, params["embed"])
+    else:
+        out = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     if return_aux:
-        return logits, aux_total
-    return logits
+        return out, aux_total
+    return out
 
 
 def param_count(params: PyTree) -> int:

@@ -25,6 +25,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -396,6 +397,13 @@ def _flash_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                   block_q=block_q, block_k=block_k, interpret=interpret)
+    # Selective-remat seam (models/transformer.py remat="selective"): name
+    # the kernel's OWN residuals so a save_only_these_names policy can pin
+    # exactly (o, lse) — the remat backward then rebuilds q/k/v from the
+    # layer input but never re-runs the forward kernel.  Outside a
+    # checkpoint policy the tags are identity no-ops.
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return (o, lse[:, 0]), (q, k, v, o, lse)
 
 

@@ -76,7 +76,11 @@ from ..utils import telemetry
 # a version-1 profile (no quant_s_per_byte) would cost compression the
 # optimistic old way — the exact mischoice this round fixes.  The cache
 # version bump forces recalibration instead of silently steering.
-PROFILE_VERSION = 2
+# 3 since round 17: the memory chooser (choose_lm_memory_plan) prices
+# remat/chunked-CE rungs with the device's calibrated
+# recompute-seconds-per-byte; a version-2 profile has no such term and
+# would cost rematerialization as free.
+PROFILE_VERSION = 3
 
 # Bucket-size candidates (MB).  25 first: the torch-DDP default wins
 # ties (strict-improvement argmin), so the chooser only moves off it
@@ -135,7 +139,16 @@ class TopologyProfile:
     ``axes`` preserves mesh order (outer first); ``measured`` carries the
     raw calibration observations (axis -> algo -> payload-bytes -> s) for
     auditability; ``source`` records provenance ("calibrated",
-    "synthetic:<preset>", "cache:<path>")."""
+    "synthetic:<preset>", "cache:<path>").
+
+    ``recompute_s_per_byte`` (round 17, version 3) is the DEVICE's cost
+    of re-producing one activation byte under rematerialization —
+    calibrated from a jitted transformer-shaped forward in the same pass
+    as alpha/beta/quant, and charged by the memory chooser against the
+    bytes ``utils.memacct.predict_recompute_bytes`` says a remat/chunked
+    rung re-runs.  Like ``quant_s_per_byte`` it defaults to 0.0 only for
+    hand-built dicts; cached profiles without it are stale and
+    recalibrate (version gate)."""
 
     version: int
     device_kind: str
@@ -143,6 +156,7 @@ class TopologyProfile:
     links: dict[str, LinkModel]
     source: str = "calibrated"
     measured: dict = field(default_factory=dict)
+    recompute_s_per_byte: float = 0.0
 
     def key(self) -> str:
         """Cache-file key: device kind + topology (axis names x sizes)."""
@@ -157,7 +171,8 @@ class TopologyProfile:
                               "beta_s_per_byte": l.beta_s_per_byte,
                               "quant_s_per_byte": l.quant_s_per_byte}
                           for a, l in self.links.items()},
-                "source": self.source, "measured": self.measured}
+                "source": self.source, "measured": self.measured,
+                "recompute_s_per_byte": self.recompute_s_per_byte}
 
     @classmethod
     def from_json(cls, d: dict) -> "TopologyProfile":
@@ -172,7 +187,9 @@ class TopologyProfile:
                                                    0.0)))
                           for a, l in d["links"].items()},
                    source=d.get("source", "cache"),
-                   measured=d.get("measured", {}))
+                   measured=d.get("measured", {}),
+                   recompute_s_per_byte=float(
+                       d.get("recompute_s_per_byte", 0.0)))
 
 
 # Deterministic synthetic profiles for CPU tests and the dryrun: each
@@ -206,6 +223,10 @@ class TopologyProfile:
 #                      a predicted win): quantize compute eats the wire
 #                      saving -> the chooser DECLINES compression.
 _QUANT = 2e-10  # ~5 GB/s quantize/dequantize throughput (accelerator)
+# ~5 GB/s of re-produced activation bytes: the synthetic presets' stand-
+# in for the calibrated recompute rate (same order as _QUANT — both are
+# device compute, not wire)
+_RECOMPUTE_SYNTH = 2e-10
 _FAST = LinkModel(alpha_s=1e-6, beta_s_per_byte=5e-12,     # ~200 GB/s
                   quant_s_per_byte=_QUANT)
 _SLOW = LinkModel(alpha_s=1e-5, beta_s_per_byte=2e-9,      # ~0.5 GB/s
@@ -241,7 +262,8 @@ def synthetic_profile(preset: str, axes: dict[str, int]) -> TopologyProfile:
     return TopologyProfile(
         version=PROFILE_VERSION, device_kind="synthetic",
         axes=dict(axes), links={a: link_of(a) for a in axes},
-        source=f"synthetic:{preset}")
+        source=f"synthetic:{preset}",
+        recompute_s_per_byte=_RECOMPUTE_SYNTH)
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +433,43 @@ def _time_quantize(payload_bytes: int = 4 << 20, *,
     return best / (2.0 * elems * 4.0)
 
 
+def _time_recompute(*, rows: int = 2048, width: int = 512,
+                    reps: int = 3) -> float:
+    """Seconds per activation byte RE-produced by a rematerialized
+    forward on the default device: time a jitted transformer-flavored
+    chain (matmul -> silu-gate -> matmul, the block's recompute shape)
+    and divide by the intermediate bytes it materializes.  The round-17
+    calibration of ``TopologyProfile.recompute_s_per_byte`` — the
+    ``_time_quantize`` precedent, aimed at memory instead of wire: the
+    memory chooser weighs activation bytes saved against THIS host's
+    cost of re-running the forward that re-creates them."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fwd(x, w1, w2):
+        g = x @ w1                  # rows x (4*width)
+        a = jax.nn.silu(g) * g      # two more rows x (4*width)
+        return a @ w2               # rows x width
+
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (rows, width), jnp.float32)
+    w1 = jax.random.normal(jax.random.fold_in(k, 1),
+                           (width, 4 * width), jnp.float32) * 0.02
+    w2 = jax.random.normal(jax.random.fold_in(k, 2),
+                           (4 * width, width), jnp.float32) * 0.02
+    produced = (3 * rows * 4 * width + rows * width) * 4  # f32 bytes
+    np.asarray(fwd(x, w1, w2))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fwd(x, w1, w2).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best / produced
+
+
 def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
               algos=("psum", "rs_ag", "ring"),
               inner: int = 4, reps: int = 2) -> TopologyProfile:
@@ -426,8 +485,10 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
     t0 = time.perf_counter()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     quant = _time_quantize()
+    recompute = _time_recompute()
     links: dict[str, LinkModel] = {}
-    measured: dict[str, dict] = {"quantize_s_per_byte": quant}
+    measured: dict[str, dict] = {"quantize_s_per_byte": quant,
+                                 "recompute_s_per_byte": recompute}
     for axis, n in sizes.items():
         if n < 2:
             links[axis] = LinkModel(alpha_s=0.0, beta_s_per_byte=0.0)
@@ -458,7 +519,8 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
     return TopologyProfile(
         version=PROFILE_VERSION,
         device_kind=getattr(jax.devices()[0], "device_kind", "cpu"),
-        axes=sizes, links=links, source="calibrated", measured=measured)
+        axes=sizes, links=links, source="calibrated", measured=measured,
+        recompute_s_per_byte=recompute)
 
 
 def get_profile(spec, axes: dict[str, int], *, cache_dir: str | None = None,
@@ -939,6 +1001,138 @@ def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
                 best = plan
     assert best is not None
     return best
+
+
+# ---------------------------------------------------------------------------
+# the memory chooser (round 17): activation bytes vs recompute seconds
+
+
+# Rung order = preference under exact price ties: no knob before either
+# knob, the streamed head before block remat (it spends one logits
+# recompute for a V-sized saving), selective before full (it keeps the
+# flash kernel's work).
+MEMORY_RUNGS = (
+    ("none", "dense"),
+    ("none", "chunked"),
+    ("selective", "dense"),
+    ("selective", "chunked"),
+    ("full", "dense"),
+    ("full", "chunked"),
+)
+
+
+@dataclass(frozen=True)
+class MemoryPlan:
+    """The memory chooser's output: which (remat, loss_impl) rung and
+    microbatch to run, with the prediction that justified it.
+    ``predicted_bytes`` is the accountant's per-microbatch activation
+    footprint (utils.memacct — census-verified); ``recompute_ms`` is the
+    per-step compute the rung spends re-producing activations, at the
+    profile's calibrated rate; ``considered`` carries every rung
+    evaluated at the chosen microbatch (auditability, printable via
+    ``table()``)."""
+
+    remat: str
+    loss_impl: str
+    microbatch: int
+    n_micro: int
+    predicted_bytes: int
+    budget_bytes: int
+    recompute_ms: float
+    profile_source: str
+    considered: tuple = ()
+
+    def summary(self) -> dict:
+        """Compact JSON-able form (the bench's lm_memory_plan shape)."""
+        return {"remat": self.remat, "loss_impl": self.loss_impl,
+                "microbatch": self.microbatch, "n_micro": self.n_micro,
+                "predicted_bytes": self.predicted_bytes,
+                "budget_bytes": self.budget_bytes,
+                "recompute_ms": round(self.recompute_ms, 4),
+                "profile": self.profile_source}
+
+    def table(self) -> str:
+        """Printable explanation: the decision line + one row per rung
+        evaluated at the chosen microbatch."""
+        lines = [f"MemoryPlan: remat={self.remat} "
+                 f"loss_impl={self.loss_impl} "
+                 f"microbatch={self.microbatch} (x{self.n_micro}) "
+                 f"predicted {self.predicted_bytes / 1e6:.2f} MB of "
+                 f"{self.budget_bytes / 1e6:.2f} MB budget, "
+                 f"recompute {self.recompute_ms:.3f} ms/step "
+                 f"(profile {self.profile_source})",
+                 "| remat | loss_impl | MB | recompute ms | fits |",
+                 "|---|---|---|---|---|"]
+        for remat, li, act, ms, fits in self.considered:
+            lines.append(f"| {remat} | {li} | {act / 1e6:.2f} | "
+                         f"{ms:.3f} | {'yes' if fits else 'no'} |")
+        return "\n".join(lines)
+
+
+def choose_lm_memory_plan(model, profile: TopologyProfile, *,
+                          batch: int, seq: int,
+                          memory_budget_bytes: int,
+                          dtype_bytes: int = 4,
+                          tp: int = 1, sp: int = 1) -> MemoryPlan:
+    """Pick the LM trainer's activation-memory knobs: the largest
+    microbatch (descending divisors of ``batch``) at which ANY
+    (remat, loss_impl) rung's predicted activation footprint
+    (``utils.memacct.predict_activation_bytes``) fits
+    ``memory_budget_bytes``, then the cheapest fitting rung by
+    recompute price — ``predict_recompute_bytes`` charged at the
+    profile's calibrated ``recompute_s_per_byte`` (the
+    ``quant_s_per_byte`` precedent: both sides of the trade in
+    seconds).  Microbatch outranks rung because splitting the batch
+    serializes accumulation steps — re-running a forward is cheaper
+    than running the whole step twice.  Pure function of its arguments
+    (deterministic given a profile; rung order breaks exact ties toward
+    the simpler knob).  Refuses loudly when even the smallest
+    microbatch at the thriftiest rung overflows the budget."""
+    if memory_budget_bytes <= 0:
+        raise ValueError(
+            f"memory_budget_bytes must be positive, got "
+            f"{memory_budget_bytes}")
+    from ..utils import memacct
+
+    rate = profile.recompute_s_per_byte
+    floor_bytes = None
+    for m in sorted((m for m in range(1, batch + 1) if batch % m == 0),
+                    reverse=True):
+        n_micro = batch // m
+        rows = []
+        for remat, li in MEMORY_RUNGS:
+            act = memacct.predict_activation_bytes(
+                model, batch=m, seq=seq, remat=remat, loss_impl=li,
+                dtype_bytes=dtype_bytes, tp=tp, sp=sp)
+            rec = memacct.predict_recompute_bytes(
+                model, batch=m, seq=seq, remat=remat, loss_impl=li,
+                dtype_bytes=dtype_bytes, tp=tp, sp=sp)
+            ms = rec * n_micro * rate * 1e3
+            rows.append((remat, li, act, ms, act <= memory_budget_bytes))
+        floor_bytes = min(r[2] for r in rows) if floor_bytes is None \
+            else min(floor_bytes, min(r[2] for r in rows))
+        fitting = [(r[3], i, r) for i, r in enumerate(rows) if r[4]]
+        if not fitting:
+            continue
+        _, _, (remat, li, act, ms, _) = min(fitting)
+        plan = MemoryPlan(
+            remat=remat, loss_impl=li, microbatch=m, n_micro=n_micro,
+            predicted_bytes=act, budget_bytes=memory_budget_bytes,
+            recompute_ms=ms, profile_source=profile.source,
+            considered=tuple(rows))
+        tel = telemetry.active()
+        if tel is not None:
+            tel.event("memory_plan", phase="autotune", side="lm",
+                      **plan.summary())
+        return plan
+    raise ValueError(
+        f"no (remat, loss_impl, microbatch) configuration fits "
+        f"memory_budget_bytes={memory_budget_bytes}: even microbatch=1 "
+        f"under remat='full' + loss_impl='chunked' needs "
+        f"{floor_bytes} predicted activation bytes "
+        f"(model d={model.d_model} L={model.n_layers} "
+        f"V={model.vocab_size}, seq={seq}) — raise the budget, shorten "
+        f"the sequence, or shard the model further")
 
 
 # ---------------------------------------------------------------------------

@@ -444,6 +444,8 @@ def pipeline_loss(
     pos: jax.Array | None = None,
     interleave: int = 1,
     remat_block_ticks: int | None = 0,
+    loss_impl: str = "dense",
+    loss_chunk: int | None = None,
 ) -> jax.Array:
     """Mean masked CE over all microbatches, computed through the pipeline.
 
@@ -461,8 +463,14 @@ def pipeline_loss(
     ``seq_axis``.  The ring's collectives run inside the tick, so pipeline
     (pipe-axis ppermute) and ring (seq-axis ppermute) traffic interleave
     tick by tick.  ``pos`` is this seq shard's absolute positions.
+
+    ``loss_impl``/``loss_chunk`` route the finishing tick's unembed
+    through the unified head-loss seam (ops/losses.py head_loss):
+    "dense" traces the historical logits matmul + masked_ce bit-for-bit,
+    "chunked" streams the head over vocab chunks (full vocab per rank —
+    the wave head does not vocab-shard over tp).
     """
-    from ..ops.nn import masked_ce
+    from ..ops.losses import head_loss
 
     me = lax.axis_index(axis)
     n = lax.axis_size(axis)
@@ -517,9 +525,9 @@ def pipeline_loss(
         # unembed + masked CE.
         finish = (me == n - 1) & (k == v - 1) & valid
         h = tfm.rms_norm(out, shared["final_norm"], cfg.norm_eps)
-        logits = h.astype(jnp.float32) @ shared["embed"].T.astype(jnp.float32)
         tgt = lax.dynamic_index_in_dim(targets, m_in, 0, keepdims=False)
-        ce, cnt = masked_ce(logits, tgt)
+        ce, cnt = head_loss(h, shared["embed"], tgt,
+                            loss_impl=loss_impl, loss_chunk=loss_chunk)
         ce_acc = ce_acc + jnp.where(finish, ce, 0.0)
         n_acc = n_acc + jnp.where(finish, cnt, 0)
         return (out, ce_acc, n_acc, aux_acc), None

@@ -671,9 +671,18 @@ class FleetBreachHook:
 def default_rules(*, step_ms_p95: float = 1000.0,
                   heartbeat_age_s: float = 300.0,
                   slot_utilization: float = 0.5,
-                  fleet_handoff_ms: float = 5000.0) -> list[SloRule]:
-    """The four ISSUE-12 example rules with overridable thresholds."""
-    return [
+                  fleet_handoff_ms: float = 5000.0,
+                  device_peak_bytes: float | None = None) -> list[SloRule]:
+    """The four ISSUE-12 example rules with overridable thresholds.
+
+    ``device_peak_bytes`` (round 17, opt-in: None adds no rule, keeping
+    the stock set at four) arms a device-memory watermark against the
+    ``record_memory`` gauge of the same name — the live third lane of
+    the activation accountant's contract (utils/memacct.py): feed it the
+    accountant's predicted peak plus headroom, and a step whose measured
+    watermark crosses the prediction pages the doctor instead of
+    becoming tomorrow's OOM."""
+    rules = [
         SloRule(name="step_time", metric="lm_train_step",
                 record="span", agg="p95", op="<=",
                 threshold=step_ms_p95, severity="critical"),
@@ -688,6 +697,13 @@ def default_rules(*, step_ms_p95: float = 1000.0,
                 threshold=fleet_handoff_ms, severity="warn",
                 phase="fleet"),
     ]
+    if device_peak_bytes is not None:
+        rules.append(
+            SloRule(name="device_memory_watermark",
+                    metric="device_peak_bytes", record="gauge",
+                    agg="max", op="<=", threshold=device_peak_bytes,
+                    severity="critical"))
+    return rules
 
 
 def rules_from_json(path: str) -> list[SloRule]:

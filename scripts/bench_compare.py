@@ -65,6 +65,11 @@ RULES: dict[str, tuple[str, float]] = {
     # median like the other speedups.
     "train_dcn_int4_bytes_per_step": ("lower", 0.02),
     "lm_q8_gather_speedup": ("higher", 0.10),
+    # round 17: the accountant's predicted footprints are deterministic
+    # shape arithmetic (census-verified), so the bands are tight — a
+    # move means the model/stack changed, not noise.
+    "lm_ce_peak_activation_bytes": ("lower", 0.02),
+    "lm_remat_saved_bytes": ("higher", 0.02),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,
@@ -76,6 +81,11 @@ ABS_CEILINGS: dict[str, float] = {
     # concentrated at |top1-top2| < 0.05 near-ties; the kernel-vs-XLA
     # int8 pair is bitwise equal, pinned at zero by tests/test_lowbit.py)
     "lm_int8_matmul_fliprate": 0.02,
+    # round-17 bound: the remat/chunked step may spend recompute for its
+    # memory saving, but a step more than 35% slower than dense/no-remat
+    # is spending more than full recomputation should cost (measured
+    # ~5-25% on the CPU mesh depending on the rung)
+    "lm_remat_step_overhead_pct": 35.0,
 }
 
 

@@ -248,6 +248,74 @@ def bench_lm_fsdp_q8gather() -> tuple[float, dict, bool]:
     return sum(times) / len(times), comm, False
 
 
+def bench_lm_remat_selective() -> tuple[float, dict, bool]:
+    """The activation-memory row (round 17): the same small LM as the
+    q8gather row with ``remat="selective"`` + ``loss_impl="chunked"`` on
+    the flat 8-way data mesh, same window discipline.  Its extra column
+    is the accountant cross-check the table exists for: the pure-shape
+    predicted activation footprint (utils/memacct) NEXT TO the exact
+    jaxpr saved-residual census of the same per-device loss — the two
+    must agree within 10% (test-pinned), and both should be far under
+    the no-remat footprint.  s/step is not comparable to the VGG rows
+    (different model/loss); the byte columns are the content."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.ops import losses
+    from distributed_pytorch_tpu.utils import memacct
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=4,
+                                  n_heads=2, head_dim=64, d_ff=256)
+    cfg = LMTrainConfig(model=model, dp=N_DEV, remat="selective",
+                        loss_impl="chunked", compute_dtype=None)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    batch, seq = 2 * N_DEV, 128
+    toks = rng.integers(0, 256, (batch, seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    tr.train_step(toks, tgts)  # compile + warm-up (excluded)
+    sched = dbg.op_schedule(tr.step_fn, tr.params, tr.opt_state, toks, tgts)
+    stats = dbg.collective_stats(sched)
+    per_axis = dbg.per_axis_collective_stats(sched)
+    # the predicted-vs-census pair, at the PER-DEVICE shapes the mesh
+    # actually runs (batch/dp rows of the global batch)
+    per_dev = batch // N_DEV
+    predicted = memacct.predict_activation_bytes(
+        model, batch=per_dev, seq=seq, remat="selective",
+        loss_impl="chunked")
+    toks1, tgts1 = toks[:per_dev], tgts[:per_dev]
+
+    def pure_loss(params):
+        head = lambda h, e: losses.head_loss(  # noqa: E731
+            h, e, tgts1, loss_impl="chunked")
+        ce, n = tfm.apply(params, toks1, cfg=model, attn_impl="flash",
+                          remat="selective", head_fn=head)
+        return ce / n
+
+    census = memacct.saved_residual_census(
+        pure_loss, tfm.init(jax.random.PRNGKey(0), model))["bytes"]
+    comm = {"comm_bytes_per_step": stats["bytes_executed"],
+            "collective_count": stats["executions"],
+            "comm_bytes_static": stats["bytes"],
+            "collective_count_static": stats["total"],
+            "collectives_interleaved": stats["interleaved"],
+            "comm_bytes_by_axis": {a: s["bytes_executed"]
+                                   for a, s in per_axis.items()},
+            "collective_count_by_axis": {a: s["executions"]
+                                         for a, s in per_axis.items()},
+            "hlo_collective_count": None, "hlo_collectives": None,
+            "predicted_ms": None,  # sync cost model: remat changes none
+            "activation_bytes_predicted": int(predicted),
+            "activation_bytes_census": int(census)}
+    times = []
+    for _ in range(WINDOW):
+        t0 = time.perf_counter()
+        loss = tr.train_step(toks, tgts)
+        float(loss)  # value fetch: the honest end-of-step barrier
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times), comm, False
+
+
 def bench_lm_pp(pp_size: int = 2,
                 microbatches: int = 4) -> tuple[float, dict, bool]:
     """The interleaved-1F1B pipeline row (round 10): a small LM on the
@@ -336,6 +404,16 @@ def main() -> None:
                       "sec_per_step": round(t, 4), "window": WINDOW,
                       "per_dev_batch": PER_DEV_BATCH, "overlap": False,
                       **comm}), flush=True)
+    # the activation-memory row (round 17): selective remat + chunked
+    # CE, with the accountant's predicted bytes next to the exact jaxpr
+    # census — the cross-check column, same LM caveat as above
+    t, comm, _ = bench_lm_remat_selective()
+    names.append("lm_remat_selective")
+    results["lm_remat_selective"], comms["lm_remat_selective"] = t, comm
+    print(json.dumps({"strategy": "lm_remat_selective",
+                      "sec_per_step": round(t, 4), "window": WINDOW,
+                      "per_dev_batch": PER_DEV_BATCH, "overlap": False,
+                      **comm}), flush=True)
 
     def axis_mb(c: dict) -> str:
         """dcn/ici MB column for the factored strategies, '-' otherwise."""
@@ -354,12 +432,19 @@ def main() -> None:
         return (f"{c['pp_bubble_fraction']:.3f}"
                 f" (<= {c['pp_bubble_bound']:.3f})")
 
+    def act_mb(c: dict) -> str:
+        """Predicted/census activation MB — the memory row only."""
+        if "activation_bytes_predicted" not in c:
+            return "-"
+        return (f"{c['activation_bytes_predicted'] / 1e6:.2f}/"
+                f"{c['activation_bytes_census'] / 1e6:.2f}")
+
     ddp = results["ddp"]
     print("\n| Strategy | s/step | vs ddp | predicted sync ms | "
-          "comm MB/step | dcn/ici MB | bubble | "
+          "comm MB/step | dcn/ici MB | bubble | act MB pred/census | "
           "collectives (interleaved) | HLO collectives |",
           file=sys.stderr)
-    print("|---|---|---|---|---|---|---|---|---|", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|---|---|---|", file=sys.stderr)
     for name in names:
         c = comms[name]
         hlo = c["hlo_collective_count"]
@@ -368,7 +453,7 @@ def main() -> None:
               f"{results[name] / ddp:.2f}x | "
               f"{f'{pred:.3f}' if pred is not None else '-'} | "
               f"{c['comm_bytes_per_step'] / 1e6:.2f} | "
-              f"{axis_mb(c)} | {bubble(c)} | "
+              f"{axis_mb(c)} | {bubble(c)} | {act_mb(c)} | "
               f"{c['collective_count']} ({c['collectives_interleaved']}) | "
               f"{hlo if hlo is not None else '-'} |", file=sys.stderr)
     if "auto" in comms and "resolved" in comms["auto"]:

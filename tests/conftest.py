@@ -148,3 +148,12 @@ def pytest_configure(config):
         "profiling lanes, zero-overhead compile pin).  All monitor tests "
         "are fast and ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic`/`fleet` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "memory: activation-memory lane (round 17) — `pytest -m memory` "
+        "runs the roofline machinery (tests/test_memory.py: chunked "
+        "vocab cross-entropy parity, selective-remat bitwise/trajectory "
+        "pins, the accountant's predict-vs-census contract, the "
+        "memory-priced autotuner rungs).  All memory tests are fast and "
+        "ride tier-1 via `-m 'not slow'` (wired like the "
+        "`faults`/`elastic`/`fleet`/`monitor` lanes).")

@@ -81,6 +81,36 @@ def test_parser_lowbit_flags():
             lm_cli.build_parser().parse_args(bad)
 
 
+def test_parser_memory_flags():
+    """Round-17 surface: the LM CLI gains --loss-impl / --loss-chunk /
+    --remat (defaults None so historical invocations are
+    byte-identical); typo'd values and incoherent combinations refuse
+    loudly at the parser, before any mesh or compile."""
+    import pytest
+
+    from distributed_pytorch_tpu import lm_cli
+
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.loss_impl is None
+    assert lm_args.loss_chunk is None
+    assert lm_args.remat is None
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--loss-impl", "chunked", "--loss-chunk", "64",
+         "--remat", "selective"])
+    assert lm_args.loss_impl == "chunked"
+    assert lm_args.loss_chunk == 64
+    assert lm_args.remat == "selective"
+    for bad in (["--loss-impl", "streamed"],
+                ["--remat", "partial"]):
+        with pytest.raises(SystemExit):
+            lm_cli.build_parser().parse_args(bad)
+    # incoherent combinations refuse in main(), pre-init
+    with pytest.raises(SystemExit):
+        lm_cli.main(["--loss-chunk", "64"])  # needs --loss-impl chunked
+    with pytest.raises(SystemExit):
+        lm_cli.main(["--remat", "full", "--pp-size", "2"])
+
+
 def test_init_single_host_is_noop():
     dist_init.init_distributed(None, num_nodes=1, rank=0)  # must not raise
 
