@@ -404,6 +404,115 @@ def bench_train_dcn(dcn_size: int, compress: str | None,
             "ici_bytes_per_step": ici_bytes}
 
 
+def canon_sync_every_env(value: str | None) -> int:
+    """Validate the BENCH_SYNC_EVERY knob (round 18): unset/''/'0'/'1'
+    skips the local-SGD window A/B (per-step sync IS the baseline, so
+    H=1 vs H=1 measures nothing); an integer >= 2 is the window length
+    H the A/B runs against per-step sync.  A typo must fail HERE,
+    before any measurement (the BENCH_KV_DTYPE contract): inside the
+    bench it would be swallowed by the catch-all while the JSON
+    silently omitted the A/B."""
+    if value is None or value in ("", "0", "1"):
+        return 1
+    try:
+        h = int(value)
+    except ValueError:
+        raise ValueError(
+            f"BENCH_SYNC_EVERY must be an integer >= 2 (or ''/0/1 to "
+            f"skip), got {value!r}") from None
+    if h < 2:
+        raise ValueError(
+            f"BENCH_SYNC_EVERY must be >= 2 (H=1 is the per-step "
+            f"baseline — there is no window to A/B); unset it or use "
+            f"0/1 to skip")
+    return h
+
+
+def bench_train_localsgd(sync_every: int, batch_per_replica: int = 64,
+                         iters: int = 32, reps: int = 5) -> dict | None:
+    """Local-SGD window A/B (round 18, BENCH_SYNC_EVERY=H): the
+    hierarchical two-level strategy on a dcn_size=2 factored mesh with
+    ``sync_every=H`` local steps per DCN exchange vs the per-step H=1
+    path, same hardened-window discipline as the round-9 DCN A/B
+    (>= ``reps`` alternating reps, median, value-fetch barrier).  Both
+    sides run the same model/batch/mesh; ``iters`` rounds up to a
+    multiple of H because windowed dispatches must end on a boundary
+    (train_steps refuses unaligned windows).  Also reports the
+    inspector's AMORTIZED cross-slice payload:
+    ``dcn_bytes_per_step_windowed`` is dcn bytes per step at interval H
+    (~1/H of the per-step payload, ici unchanged — the round-18
+    schedule claim, test-pinned in tests/test_localsgd.py).  Needs an
+    even device count >= 2; returns None (JSON nulls) otherwise.  On
+    CPU meshes expect ~1.0x speedup (no real slow hop to remove); the
+    byte accounting is the CPU content."""
+    import jax
+
+    from distributed_pytorch_tpu.train import (TrainConfig, Trainer,
+                                               make_multi_step)
+    from distributed_pytorch_tpu.utils import debug as dbg
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        _log(f"[bench] train-localsgd A/B needs an even device count "
+             f">= 2 (have {n_dev}); omitting")
+        return None
+    h = sync_every
+    iters = -(-iters // h) * h  # window-aligned dispatches
+
+    def build(sync: int) -> Trainer:
+        cfg = TrainConfig(strategy="hierarchical", dcn_size=2,
+                          batch_size=batch_per_replica,
+                          steps_per_loop=iters, compute_dtype="bfloat16",
+                          sync_every=sync, max_sync_every=sync)
+        return Trainer(cfg)  # builds the ('dcn', 'ici') mesh itself
+
+    trainers = {1: build(1), h: build(h)}
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        tr.precompile_steps(images, labels)
+        float(tr.train_steps(images, labels)[-1])
+
+    times: dict[int, list[float]] = {1: [], h: []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            losses = tr.train_steps(images, labels)
+            float(losses[-1])  # fetch forces the whole donated chain
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[1] / max(med[h], 1e-9)
+
+    # amortized per-axis wire accounting: one trace per side over the
+    # full window-multiple dispatch, divided by its step count — the
+    # windowed program holds H local steps + one exchange per window
+    def axis_bytes(tr: Trainer) -> dict[str, float]:
+        img, lbl = tr._stage(images, labels)
+        args = tr._args(img, lbl)
+        if tr._multi_fn is None:
+            tr._multi_fn = make_multi_step(tr.cfg, tr.strategy, tr.mesh,
+                                           fault_sig=tr._fault_sig)
+        return dbg.amortized_axis_bytes(
+            [(dbg.op_schedule(tr._multi_fn, *args), 1)], iters)
+
+    per_step, windowed = axis_bytes(trainers[1]), axis_bytes(trainers[h])
+    dcn_w = windowed.get("dcn", 0.0)
+    dcn_1 = per_step.get("dcn", 0.0)
+    _log(f"[bench] train-localsgd A/B (hierarchical, dcn_size=2, "
+         f"sync_every={h}, {n_dev} dev): {med[h]:.2f} ms/step windowed "
+         f"vs {med[1]:.2f} per-step-sync -> {speedup:.3f}x; dcn "
+         f"{dcn_w / 1e6:.2f} MB/step amortized vs {dcn_1 / 1e6:.2f} "
+         f"per-step ({reps} reps median)")
+    return {"speedup": speedup, "ms_windowed": med[h],
+            "ms_per_step_sync": med[1],
+            "dcn_bytes_per_step_windowed": dcn_w,
+            "dcn_bytes_per_step_h1": dcn_1, "sync_every": h}
+
+
 def canon_fsdp_gather_env(value: str | None) -> str | None:
     """Validate BENCH_FSDP_GATHER (round 16): unset/''/'none' skips the
     quantized ZeRO-3 gather A/B; 'int8' runs it (fsdp weight all-gathers
@@ -1373,6 +1482,10 @@ def main() -> None:
     dcn_size = canon_dcn_size_env(os.environ.get("BENCH_DCN_SIZE"))
     dcn_compress = canon_dcn_compress_env(
         os.environ.get("BENCH_DCN_COMPRESS"))
+    # Local-SGD window knob (round 18), validated loudly pre-bench:
+    # BENCH_SYNC_EVERY=H >= 2 A/Bs sync_every=H windows against
+    # per-step sync on the dcn_size=2 factored mesh.
+    sync_every = canon_sync_every_env(os.environ.get("BENCH_SYNC_EVERY"))
     # Low-bit knobs (round 16), validated loudly pre-bench:
     # BENCH_FSDP_GATHER=int8 A/Bs the quantized ZeRO-3 weight gathers;
     # BENCH_MATMUL_DTYPE=int8 measures the int8-projection flip rate.
@@ -1437,6 +1550,16 @@ def main() -> None:
             dcn_ab = bench_train_dcn(dcn_size, dcn_compress)
         except Exception as e:
             _log(f"[bench] train-dcn A/B failed ({e}); omitting")
+
+    # Local-SGD window A/B (round 18): H local steps per DCN exchange
+    # vs per-step sync on the factored mesh; optional like the other
+    # gates.
+    localsgd_ab = None
+    if sync_every > 1:
+        try:
+            localsgd_ab = bench_train_localsgd(sync_every)
+        except Exception as e:
+            _log(f"[bench] train-localsgd A/B failed ({e}); omitting")
 
     # Quantized ZeRO-3 gather A/B (round 16): fsdp weight all-gathers
     # at int8 vs f32; optional like the other gates.
@@ -1595,6 +1718,20 @@ def main() -> None:
         "train_dcn_int4_bytes_per_step": (
             dcn_ab["dcn_bytes_per_step"]
             if dcn_ab is not None and dcn_compress == "int4" else None),
+        # local-SGD window A/B (round 18, BENCH_SYNC_EVERY=H): median
+        # ms/step at sync_every=H vs the per-step path on the same
+        # factored mesh, plus the inspector's amortized cross-slice
+        # payload per step at interval H (~1/H of the per-step dcn
+        # bytes, ici unchanged) and which H ran.  All null when the
+        # A/B is skipped.
+        "train_localsgd_speedup": (round(localsgd_ab["speedup"], 3)
+                                   if localsgd_ab is not None else None),
+        "train_dcn_bytes_per_step_windowed": (
+            localsgd_ab["dcn_bytes_per_step_windowed"]
+            if localsgd_ab is not None else None),
+        "train_localsgd_sync_every": (localsgd_ab["sync_every"]
+                                      if localsgd_ab is not None
+                                      else None),
         "lm_q8_gather_speedup": (round(q8gather_ab["speedup"], 3)
                                  if q8gather_ab is not None else None),
         "lm_int8_matmul_fliprate": (round(int8mm["fliprate"], 5)

@@ -97,6 +97,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap-bucket-mb", type=float, default=None,
                    help="bucket size for overlap packing (default: torch "
                         "DDP's 25 MB)")
+    p.add_argument("--sync-every", type=int, default=1,
+                   help="local-SGD window (round 18): run H local "
+                        "optimizer steps between gradient exchanges — "
+                        "on --strategy hierarchical the ICI hop still "
+                        "syncs every step and the DCN hop only at "
+                        "window boundaries (~1/H dcn bytes/step; needs "
+                        "a mesh-backed strategy, no --overlap)")
+    p.add_argument("--max-sync-every", type=int, default=None,
+                   help="staleness-risk ceiling for --strategy auto's "
+                        "interval dimension and the monitor's "
+                        "sync-relax actuator (default: the --sync-every "
+                        "value — relaxation stays opt-in)")
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106
@@ -213,6 +225,23 @@ def main(argv: list[str] | None = None) -> int:
     elif args.min_nodes != 1 or args.max_nodes is not None:
         parser.error("--min-nodes/--max-nodes configure --elastic; pass "
                      "it (or drop the bounds)")
+    max_sync_every = (args.max_sync_every if args.max_sync_every is not None
+                      else max(args.sync_every, 1))
+    if args.sync_every != 1 or max_sync_every != 1:
+        # window coherence at the parser (the ONE require_* definition
+        # site the Trainer re-checks): meshless strategies have no
+        # collective to amortize, overlap streams the per-step sync a
+        # window removes
+        meshless = (args.strategy != "auto"
+                    and not _strat.get(args.strategy).needs_mesh)
+        try:
+            _strat.require_sync_window(
+                sync_every=args.sync_every,
+                max_sync_every=max_sync_every,
+                mesh=not meshless, overlap=args.overlap,
+                trainer="train")
+        except ValueError as e:
+            parser.error(str(e))
 
     # Rendezvous FIRST: jax.distributed.initialize must run before anything
     # touches a backend (even jax.process_index()), mirroring the reference's
@@ -243,6 +272,7 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed, dcn_size=args.dcn_size,
         dcn_compress=args.dcn_compress, overlap=args.overlap,
         overlap_bucket_mb=args.overlap_bucket_mb,
+        sync_every=args.sync_every, max_sync_every=max_sync_every,
         autotune_profile=args.autotune_profile,
     )
     mesh = None

@@ -169,7 +169,12 @@ class LMTrainConfig:
     # full-precision run plus a jaxpr pin that i8 is on the wire.
     # Requires fsdp=True (there is no gather to quantize otherwise);
     # does not compose with pp_size (the 1F1B stacked gather is a
-    # different code path, kept full-precision).  None = exact gathers.
+    # different code path, kept full-precision).  "int4" (round 18,
+    # lifting the round-16 refusal) packs two nibbles per wire byte on
+    # the same exchange (+/-7 levels against the identical per-row
+    # scales) — 8x fewer payload bytes; same full-precision gradient
+    # reduce-scatter, same curve-following pin at a looser rtol.
+    # None = exact gathers.
     fsdp_gather_dtype: str | None = None
     # Low-bit dense compute (round 16): "int8" routes the transformer's
     # dense projections (attention q/k/v/o and the MLP matmuls) through
@@ -236,6 +241,40 @@ class LMTrainConfig:
     # pp/pp_size: parallel/pipeline.py owns its own per-tick remat
     # (pp_remat_block).  "none" = historical graph.
     remat: str = "none"
+    # Communication-sparse windows (round 18, the BAGUA-style system
+    # relaxation the ROADMAP carried): run H local optimizer steps
+    # between cross-slice exchanges.  Requires the factored multislice
+    # mesh (dcn_size >= 2) — the window relaxes the SLOW hop
+    # specifically: within a window every step syncs gradients over the
+    # intra-slice axes only (data/expert/seq/model — ICI) and each
+    # slice advances its own params p = anchor + delta with PER-SLICE
+    # Adam state (delta and opt state carry a leading 'dcn' axis); at
+    # step kH the accumulated deltas average across 'dcn' through the
+    # same bucketed two-level exchange the per-step path uses —
+    # composing with dcn_compress (int8/int4 ring + EF residual, now
+    # charged once per window) and with overlap/fsdp (local steps
+    # stream ICI-only sync points and ZeRO-3 gathers; the boundary
+    # exchange is whole-tree).  DCN bytes/step scale ~1/H
+    # (schedule-inspector-pinned); sync_every=1 is the existing
+    # per-step path, bitwise (build-time branch).  Adam trajectories
+    # follow the per-step curve (curve pin), they do not equal it.
+    sync_every: int = 1
+    # Bounded staleness S (0 <= S < H): launch the window exchange at
+    # step kH but apply it at step kH+S, so the DCN round-trip can
+    # drain under S steps of local compute instead of stalling the
+    # boundary step.  The launch snapshots delta; the apply adds the
+    # averaged delta to the anchor and subtracts the snapshot from the
+    # live delta (local progress made during the S steps is kept).
+    # NOTE: on a single-stream runtime the launch/apply programs still
+    # execute in dispatch order — the structure bounds what a
+    # multi-stream runtime may overlap; it does not force overlap.
+    staleness: int = 0
+    # Relaxation ceiling for the interval-aware autotuner
+    # (sync_plan="auto" prices intervals H <= max_sync_every) and the
+    # RunDoctor straggler actuator (monitor.SyncRelaxHook widens
+    # sync_every up to this bound on a step-time SLO breach).  Default
+    # 1: relaxation is strictly opt-in.
+    max_sync_every: int = 1
     @property
     def dtype(self) -> jnp.dtype | None:
         """compute_dtype resolved to a jnp dtype (None = float32 params)."""
@@ -327,14 +366,28 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
                 "(pp/pp_size): the pipeline gradient paths are "
                 "hand-emitted without the stateful sync-state channel "
                 "(open item); drop the pipeline or the compression")
+    if (cfg.sync_every != 1 or cfg.staleness != 0
+            or cfg.max_sync_every != 1):
+        # the ONE window-coherence check site (round 18,
+        # parallel/strategies.py require_* consolidation): interval
+        # bounds, staleness-vs-window ordering, and the combos the LM
+        # windowed machinery does not cover (pipeline paths,
+        # grad_accum's already-amortized exchange, flat meshes)
+        from .parallel.strategies import require_sync_window
+        require_sync_window(
+            sync_every=cfg.sync_every, staleness=cfg.staleness,
+            max_sync_every=cfg.max_sync_every, mesh=True,
+            overlap=cfg.overlap, pp=cfg.pp > 1 or cfg.pp_size > 0,
+            grad_accum=cfg.grad_accum, dcn_size=cfg.dcn_size,
+            trainer="lm")
     if cfg.fsdp_gather_dtype is not None:
-        if cfg.fsdp_gather_dtype != "int8":
+        if cfg.fsdp_gather_dtype not in ("int8", "int4"):
             raise ValueError(
-                f"fsdp_gather_dtype must be None or 'int8', got "
+                f"fsdp_gather_dtype must be None, 'int8' or 'int4', got "
                 f"{cfg.fsdp_gather_dtype!r}")
         if not cfg.fsdp:
             raise ValueError(
-                "fsdp_gather_dtype='int8' quantizes the ZeRO-3 weight "
+                "fsdp_gather_dtype quantizes the ZeRO-3 weight "
                 "all-gather; with fsdp=False there is no gather to "
                 "quantize")
         if cfg.pp_size > 0:
@@ -563,6 +616,65 @@ def _q8_shard_gather(p: jax.Array, dim: int) -> jax.Array:
     return g(p)
 
 
+def _q4_shard_gather(p: jax.Array, dim: int) -> jax.Array:
+    """One fsdp leaf's all-gather, int4 on the wire (round 18,
+    ``fsdp_gather_dtype="int4"`` — lifting the round-16 refusal):
+    quantize the LOCAL shard to +/-7 levels against the same per-row
+    f32 scales as the int8 rung, then pack two nibbles per wire byte
+    along the gathered dim (odd shard lengths pad one element, sliced
+    off after the unpack) — 8x fewer gather payload bytes for f32
+    params.  The gather runs untiled (leading device axis) so the
+    unpack/slice happens per shard before the shards concatenate; the
+    BACKWARD is unchanged from the int8 rung — the full-precision ZeRO
+    reduce-scatter of cotangents (weights tolerate the 16x-coarser
+    forward rounding; the gradient stream is never quantized)."""
+    axes = tuple(i for i in range(p.ndim) if i != dim)
+    m = p.shape[dim]
+
+    def _quantized(x):
+        x32 = x.astype(jnp.float32)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(x32), axis=axes, keepdims=True) / 7.0,
+            1e-30)
+        q = jnp.clip(jnp.round(x32 / scale), -7, 7).astype(jnp.int8)
+        if m % 2:
+            q = jnp.pad(q, [(0, 1) if i == dim else (0, 0)
+                            for i in range(q.ndim)])
+        sel = lambda start: tuple(
+            slice(start, None, 2) if i == dim else slice(None)
+            for i in range(q.ndim))
+        packed = ((q[sel(0)] + 8).astype(jnp.uint8)
+                  | ((q[sel(1)] + 8).astype(jnp.uint8) << 4))
+        pg = jax.lax.all_gather(packed, DATA, axis=0)   # (n, ..packed..)
+        sg = jax.lax.all_gather(scale, DATA, axis=0)    # (n, ..1-at-dim..)
+        d = dim + 1  # the gather added a leading device axis
+        lo = (pg & 0xF).astype(jnp.int8) - 8
+        hi = ((pg >> 4) & 0xF).astype(jnp.int8) - 8
+        u = jnp.stack([lo, hi], axis=d + 1)
+        u = u.reshape(u.shape[:d] + (-1,) + u.shape[d + 2:])
+        u = jax.lax.slice_in_dim(u, 0, m, axis=d)
+        full = u.astype(jnp.float32) * sg
+        # collapse (device, dim) -> the concatenated gathered dim, in
+        # shard order — the tiled-gather layout the plain path produces
+        full = jnp.moveaxis(full, 0, dim)
+        return full.reshape(full.shape[:dim] + (-1,)
+                            + full.shape[dim + 2:]).astype(x.dtype)
+
+    @jax.custom_vjp
+    def g(x):
+        return _quantized(x)
+
+    def fwd(x):
+        return _quantized(x), None
+
+    def bwd(_, ct):
+        return (jax.lax.psum_scatter(ct, DATA, scatter_dimension=dim,
+                                     tiled=True),)
+
+    g.defvjp(fwd, bwd)
+    return g(p)
+
+
 def _fsdp_gather(params: PyTree, specs: PyTree,
                  dtype: str | None = None) -> PyTree:
     """all_gather fsdp-sharded leaves back to full (tp shards stay local).
@@ -570,7 +682,8 @@ def _fsdp_gather(params: PyTree, specs: PyTree,
     Inside shard_map; the transpose of these gathers is the reduce-scatter
     that delivers each device only its shard's gradient — ZeRO's comm
     pattern, synthesized by autodiff.  ``dtype="int8"`` swaps each leaf's
-    gather for the quantized exchange (``_q8_shard_gather``); the
+    gather for the quantized exchange (``_q8_shard_gather``;
+    ``dtype="int4"`` the nibble-packed ``_q4_shard_gather``); the
     gradient reduce-scatter stays full-precision either way.
     """
     def gather(p, spec):
@@ -578,6 +691,8 @@ def _fsdp_gather(params: PyTree, specs: PyTree,
             if ax == DATA:
                 if dtype == "int8":
                     return _q8_shard_gather(p, dim)
+                if dtype == "int4":
+                    return _q4_shard_gather(p, dim)
                 return jax.lax.all_gather(p, DATA, axis=dim, tiled=True)
         return p
 
@@ -687,6 +802,41 @@ def _dcn_sync_point(params: PyTree, specs: PyTree) -> PyTree:
 
     def bwd(_, g):
         return (_two_level_sync(g, specs),)
+
+    point.defvjp(fwd, bwd)
+    return point(params)
+
+
+def _local_sync_point(params: PyTree, specs: PyTree, n_dcn: int) -> PyTree:
+    """``_dcn_sync_point``'s window-local sibling (round 18): identity
+    whose backward syncs cotangents over every mesh axis EXCEPT 'dcn' —
+    per-leaf psums over the leaf's invariant intra-slice axes (the
+    ``_fsdp_gather`` transpose already reduce-scattered fsdp leaves over
+    'data'), scaled by ``n_dcn`` so each slice's local step sees its
+    slice-mean gradient at the full-batch rate (equal per-slice token
+    counts make the scaled slice mean an unbiased estimate of the
+    global mean).  The cotangent returns dcn-VARYING by construction:
+    inside a sync window no gradient byte crosses DCN — the property
+    the schedule inspector pins."""
+    scale = jnp.float32(n_dcn)
+
+    @jax.custom_vjp
+    def point(p):
+        return p
+
+    def fwd(p):
+        return p, None
+
+    def bwd(_, g):
+        leaves, td = jax.tree.flatten(g)
+        out = []
+        for gl, sp in zip(leaves, jax.tree.leaves(specs)):
+            axes = _spec_axes(sp)
+            rest = tuple(a for a in (DATA, EXPERT, SEQ, MODEL)
+                         if a not in axes)
+            gl = jax.lax.psum(gl, rest) if rest else gl
+            out.append(gl * scale.astype(gl.dtype))
+        return (jax.tree.unflatten(td, out),)
 
     point.defvjp(fwd, bwd)
     return point(params)
@@ -920,14 +1070,19 @@ def lm_sync_state_len(cfg: LMTrainConfig, mesh: Mesh) -> int:
     the step's consumption order: the whole-tree partition for the
     post-backward and grad-accumulation paths, or the per-layer-group
     partitions in forward (group-index) order under streaming
-    ``overlap`` (exactly the walk ``_stream_group_boundary`` makes)."""
+    ``overlap`` (exactly the walk ``_stream_group_boundary`` makes).
+    Under sync windows (``sync_every > 1``) the quantized exchange
+    happens ONLY at the whole-tree window boundary — local steps stream
+    ICI-only points with no residual — so the layout is the whole-tree
+    partition even when ``overlap`` is on."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_dcn, n_ici = sizes[DCN], sizes[DATA]
     bucket_bytes = _sync_bucket_bytes(cfg)
     specs = param_specs(cfg)
     shapes = jax.eval_shape(lambda k: tfm.init(k, cfg.model),
                             jax.random.key(0))
-    streamed = cfg.overlap and cfg.grad_accum == 1
+    streamed = (cfg.overlap and cfg.grad_accum == 1
+                and cfg.sync_every == 1)
     if not streamed:
         return _residual_total_len(
             _local_sized_leaves(shapes, specs, sizes),
@@ -942,7 +1097,8 @@ def lm_sync_state_len(cfg: LMTrainConfig, mesh: Mesh) -> int:
 
 
 def _stream_group_boundary(cfg: LMTrainConfig, specs, *, dcn_sync: bool,
-                           residual: jax.Array | None = None):
+                           residual: jax.Array | None = None,
+                           local_n_dcn: int | None = None):
     """The streaming (``cfg.overlap``) layer-group hook: at each group's
     boundary in ``transformer.apply``, wrap the group's params in the
     two-level DCN sync point (``dcn_sync``, round 9) and/or gather its
@@ -977,7 +1133,12 @@ def _stream_group_boundary(cfg: LMTrainConfig, specs, *, dcn_sync: bool,
         # forward order: sync point THEN gather, so the backward runs the
         # gather's reduce-scatter first and the point's psum('dcn') on
         # the already-scattered shard — the whole-tree op sequence
-        if dcn_sync:
+        if local_n_dcn is not None:
+            # window-local streaming (round 18): the group's sync point
+            # stays at its boundary but reduces intra-slice only — the
+            # latency-hiding interleave without the DCN hop
+            sub = _local_sync_point(sub, specs[k], local_n_dcn)
+        elif dcn_sync:
             if residual is not None:
                 n_dcn = jax.lax.axis_size(DCN)
                 n_ici = jax.lax.axis_size(DATA)
@@ -999,11 +1160,17 @@ def _stream_group_boundary(cfg: LMTrainConfig, specs, *, dcn_sync: bool,
     return boundary
 
 
-def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
+def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool,
+                      local_window: bool = False):
     """The per-shard loss shared by every grad path.  ``dcn_sync``
     injects the custom-VJP two-level sync point on params (the a=1
     factored-mesh path); the accumulation path passes False and syncs
-    ONCE after its local scan instead.
+    ONCE after its local scan instead.  ``local_window`` (round 18, the
+    sync_every > 1 local steps) injects the ICI-only sync point
+    (``_local_sync_point``) instead — same streaming positions under
+    ``overlap``, no DCN traffic, cotangents dcn-varying; the window
+    boundary exchange handles the cross-slice hop (and the EF residual,
+    when compressed) in its own program.
 
     With ``cfg.dcn_compress`` AND ``dcn_sync`` the returned loss is the
     STATEFUL variant ``(params, residual, tokens, targets, n_total,
@@ -1017,19 +1184,23 @@ def _build_local_loss(cfg: LMTrainConfig, specs, *, dcn_sync: bool):
     tp_axis = MODEL
     seq_axis = SEQ if cfg.sp > 1 else None
     reduce_axes = _batch_axes(cfg) + (SEQ,)
-    stateful = cfg.dcn_compress is not None and dcn_sync
+    stateful = (cfg.dcn_compress is not None and dcn_sync
+                and not local_window)
     bucket_bytes = _sync_bucket_bytes(cfg)
 
     def local_loss(params, tokens, targets, n_total, aux_w, residual=None):
         boundary = None
-        if cfg.overlap and (dcn_sync or cfg.fsdp):
+        if cfg.overlap and (dcn_sync or cfg.fsdp or local_window):
             # streaming (rounds 8-9): per-layer-group sync points and/or
             # ZeRO-3 gathers at the boundaries instead of whole-tree
-            boundary = _stream_group_boundary(cfg, specs,
-                                              dcn_sync=dcn_sync,
-                                              residual=residual)
+            boundary = _stream_group_boundary(
+                cfg, specs, dcn_sync=dcn_sync and not local_window,
+                residual=residual,
+                local_n_dcn=cfg.dcn_size if local_window else None)
         else:
-            if dcn_sync:
+            if local_window:
+                params = _local_sync_point(params, specs, cfg.dcn_size)
+            elif dcn_sync:
                 if residual is not None:
                     # stateful whole-tree point: the quantized-ring
                     # exchange with the EF residual channel (round 11;
@@ -1204,6 +1375,191 @@ def _make_accum_grad_step(cfg: LMTrainConfig, mesh: Mesh):
 # telemetry vector lives next to the loss primitives (ops/nn.py
 # step_metrics) — train.py's in-scan body uses the same function
 _step_metrics = step_metrics
+
+
+def _make_window_grad_step(cfg: LMTrainConfig, mesh: Mesh):
+    """The window-LOCAL loss-and-grad program (round 18,
+    ``sync_every > 1``): each 'dcn' slice forwards at its own params
+    ``p = anchor + delta[slice]`` and its gradient syncs over the
+    intra-slice axes only (``_local_sync_point`` — ICI traffic, scaled
+    x n_dcn), so the returned grads are dcn-VARYING and come back
+    STACKED over a leading 'dcn' axis (one slice's slice-mean estimate
+    per row).  ``(anchor, delta, tokens, targets, n_total, aux_w) ->
+    (loss, grads)`` with loss still the global scalar (each slice's
+    tokens scored under its own slice params — scalar psums only)."""
+    specs = param_specs(cfg)
+    local_loss = _build_local_loss(cfg, specs, dcn_sync=False,
+                                   local_window=True)
+    bspec = _lm_batch_spec(cfg)
+    dspec = jax.tree.map(lambda s: P(DCN, *s), specs)
+
+    def _vary_dcn(a):
+        if DCN in compat.vma_of(a):
+            return a
+        return compat.pcast(a, (DCN,), to="varying")
+
+    def local(anchor, delta, tokens, targets, n_total, aux_w):
+        # anchor is dcn-invariant, the delta block dcn-varying: cast the
+        # anchor varying so the sum is well-typed under check_vma
+        p = jax.tree.map(lambda a, d: _vary_dcn(a) + d[0], anchor, delta)
+        loss, g = jax.value_and_grad(local_loss)(
+            p, tokens, targets, n_total, aux_w)
+        return loss, jax.tree.map(lambda x: x[None], g)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(specs, dspec, bspec, bspec, P(), P()),
+        out_specs=(P(), dspec))
+
+
+def _lm_window_wire_bytes(cfg: LMTrainConfig, mesh: Mesh) -> int:
+    """Predicted per-device DCN payload bytes of ONE window-boundary
+    delta exchange (f32, pre-quantization) — the whole-tree
+    ``_sync_partition`` walk the boundary program makes: fsdp buckets
+    are already data-shard-sized, two-level buckets cross DCN as their
+    ICI shard.  Feeds the per-window ``window_wire_bytes`` telemetry
+    gauge (utils/telemetry.emit_sync_windows)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_data = sizes[DATA]
+    specs = param_specs(cfg)
+    shapes = jax.eval_shape(lambda k: tfm.init(k, cfg.model),
+                            jax.random.key(0))
+    leaves = _local_sized_leaves(shapes, specs, sizes)
+    total = 0
+    for kind, idxs in _sync_partition(leaves, jax.tree.leaves(specs),
+                                      _sync_bucket_bytes(cfg)):
+        elems = sum(int(leaves[i].size) for i in idxs)
+        total += 4 * (elems if kind == "fsdp" else -(-elems // n_data))
+    return total
+
+
+def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
+    """The communication-sparse program family (round 18,
+    ``sync_every = H > 1`` on the factored multislice mesh):
+
+    - ``local``: one optimizer step with NO cross-slice traffic —
+      ``(anchor, delta, opt_state, tokens, targets[, step_no,
+      fault_arm]) -> (delta, opt_state, loss, ok, met)``.  ``delta``
+      (the accumulated optax updates since the last exchange) and the
+      optimizer state carry a leading 'dcn' axis: each slice advances
+      its own Adam trajectory at ``p = anchor + delta[slice]``
+      (``jax.vmap`` over the slice axis; the anchor — the live
+      ``LMTrainer.params`` — is read-only here).  ``ok``/``met`` cover
+      ALL slices (gsq sums the stacked grads; the param-norm runs over
+      the stacked tree, ~sqrt(n_dcn) x the per-slice figure).
+    - ``exchange`` (staleness 0): average the deltas across 'dcn'
+      through the SAME bucketed two-level reduction the per-step path
+      uses (``_two_level_sync`` — dcn_compress rides it with the EF
+      residual, now charged once per window), fold the mean into the
+      anchor, zero the delta.  Each leaf prescales by
+      1/(n_dcn * n_rest [* n_data]) so the redundant intra-slice psums
+      cancel exactly and what lands is the plain mean over slices.
+    - ``launch``/``apply`` (staleness S > 0): ``launch`` runs the same
+      exchange but leaves anchor and delta untouched, returning the
+      averaged delta and a SNAPSHOT of the launched delta; ``apply``
+      (dispatched S steps later) folds the average into the anchor and
+      subtracts the snapshot from the live delta — local progress made
+      during the S steps is kept, and the DCN round-trip has S local
+      steps to drain under."""
+    tx = make_optimizer(cfg)
+    grad_step = _make_window_grad_step(cfg, mesh)
+    specs = param_specs(cfg)
+    dspec = jax.tree.map(lambda s: P(DCN, *s), specs)
+    bucket_bytes = _sync_bucket_bytes(cfg)
+    n_dcn = cfg.dcn_size
+    n_data = cfg.dp // cfg.dcn_size
+    coef = jnp.float32(cfg.aux_coef)
+    compress = cfg.dcn_compress is not None
+    rspec = P(tuple(mesh.axis_names))
+    rest_sizes = {EXPERT: cfg.ep, SEQ: cfg.sp, MODEL: cfg.tp}
+
+    def _prescale(dl, sp):
+        axes = _spec_axes(sp)
+        n_rest = int(np.prod([rest_sizes[a]
+                              for a in (EXPERT, SEQ, MODEL)
+                              if a not in axes], dtype=np.int64))
+        denom = n_dcn * n_rest * (1 if DATA in axes else n_data)
+        return dl * jnp.asarray(1.0 / denom, dl.dtype)
+
+    def _vary_all(x):
+        missing = tuple(a for a in mesh.axis_names
+                        if a not in compat.vma_of(x))
+        return compat.pcast(x, missing, to="varying") if missing else x
+
+    def _ex_core(delta, residual):
+        d = jax.tree.map(lambda x: x[0], delta)
+        d = jax.tree.map(_prescale, d, specs)
+        d = jax.tree.map(_vary_all, d)
+        if compress:
+            d_avg, new_r = _two_level_sync(
+                d, specs, bucket_bytes=bucket_bytes,
+                dcn_compress=cfg.dcn_compress, residual=residual[0])
+            return d_avg, new_r[None]
+        return _two_level_sync(d, specs, bucket_bytes=bucket_bytes)
+
+    if compress:
+        ex_core = shard_map(
+            _ex_core, mesh=mesh, in_specs=(dspec, rspec),
+            out_specs=(specs, rspec),
+            # the ring's ppermute-assembled result (see _make_grad_step)
+            check_vma=False)
+    else:
+        ex_core = shard_map(
+            lambda delta: _ex_core(delta, None), mesh=mesh,
+            in_specs=(dspec,), out_specs=specs)
+
+    @partial(jax.jit, donate_argnums=compat.donate(1, 2))
+    def local_step(anchor, delta, opt_state, tokens, targets, step_no=0,
+                   fault_arm=0.0):
+        tokens = _zigzag_global(cfg, tokens)
+        targets = _zigzag_global(cfg, targets)
+        n_total = jnp.sum(targets != IGNORE).astype(jnp.float32)
+        loss, grads = grad_step(anchor, delta, tokens, targets, n_total,
+                                coef)
+        grads = faults.tap_grads(grads, step_no, fault_arm)
+        loss = faults.tap_loss(loss, step_no, fault_arm)
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree.leaves(grads))
+        ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(jnp.float32)
+        p = jax.tree.map(lambda a, d: a[None] + d, anchor, delta)
+        updates, opt_state = jax.vmap(tx.update)(grads, opt_state, p)
+        delta = jax.tree.map(jnp.add, delta, updates)
+        met = _step_metrics(
+            gsq, jax.tree.map(lambda a, d: a[None] + d, anchor, delta))
+        return delta, opt_state, loss, ok, met
+
+    if compress:
+        @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
+        def exchange(anchor, delta, sync_state):
+            d_avg, sync_state = ex_core(delta, sync_state)
+            anchor = jax.tree.map(jnp.add, anchor, d_avg)
+            return anchor, jax.tree.map(jnp.zeros_like, delta), sync_state
+
+        @partial(jax.jit, donate_argnums=compat.donate(1))
+        def launch(delta, sync_state):
+            d_avg, sync_state = ex_core(delta, sync_state)
+            # delta passes through UNDONATED: the output is the
+            # snapshot copy `apply` subtracts S steps later (the live
+            # delta keeps evolving — and gets donated — in between)
+            return d_avg, delta, sync_state
+    else:
+        @partial(jax.jit, donate_argnums=compat.donate(0, 1))
+        def exchange(anchor, delta):
+            d_avg = ex_core(delta)
+            anchor = jax.tree.map(jnp.add, anchor, d_avg)
+            return anchor, jax.tree.map(jnp.zeros_like, delta)
+
+        @jax.jit
+        def launch(delta):
+            return ex_core(delta), delta
+
+    @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+    def apply_pending(anchor, delta, d_avg, snap):
+        anchor = jax.tree.map(jnp.add, anchor, d_avg)
+        delta = jax.tree.map(jnp.subtract, delta, snap)
+        return anchor, delta
+
+    return local_step, exchange, launch, apply_pending
 
 
 def make_lm_train_step(cfg: LMTrainConfig, mesh: Mesh):
@@ -2020,7 +2376,7 @@ class LMTrainer:
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)
-            self.step_fn = self._build_step_fn(cfg, self.mesh)
+            self._install_step_fns(self._build_step_fn(cfg, self.mesh))
         elif cfg.pp > 1:
             from .parallel import pipeline as pp
             stages, shared = pp.split_layer_params(
@@ -2034,13 +2390,13 @@ class LMTrainer:
                 "shared": jax.device_put(
                     shared, NamedSharding(self.mesh, P())),
             }
-            self.step_fn = self._build_step_fn(cfg, self.mesh)
+            self._install_step_fns(self._build_step_fn(cfg, self.mesh))
         else:
             specs = param_specs(cfg)
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 params, specs)
-            self.step_fn = self._build_step_fn(cfg, self.mesh)
+            self._install_step_fns(self._build_step_fn(cfg, self.mesh))
         # zeros_like/elementwise init inherits each param's sharding; leaves
         # with no param ancestry (Adam's step count) come out single-device —
         # normalize them to replicated-on-mesh so every training-state leaf
@@ -2065,6 +2421,15 @@ class LMTrainer:
                 jnp.zeros((n_dev, lm_sync_state_len(cfg, self.mesh)),
                           jnp.float32),
                 NamedSharding(self.mesh, P(tuple(self.mesh.axis_names))))
+        # communication-sparse windows (round 18): the per-slice window
+        # delta + per-slice optimizer state (leading 'dcn' axis) and the
+        # staleness bookkeeping; params stay the replicated ANCHOR
+        self._delta = None
+        self._pending = None
+        self._window_t0 = None
+        self._window_wire_bytes = None
+        if cfg.sync_every > 1:
+            self._init_window_state()
         self._eval_fn = None
         self._multi_fn = None
         self._step = 0
@@ -2102,6 +2467,11 @@ class LMTrainer:
             kind, builder = "1f1b", make_lm_1f1b_train_step
         elif cfg.pp > 1:
             kind, builder = "pp", make_lm_pp_train_step
+        elif cfg.sync_every > 1:
+            # round 18: the communication-sparse program family (local
+            # step + boundary exchange + staleness launch/apply) — the
+            # build returns a 4-tuple, unpacked by _install_step_fns
+            kind, builder = "localsgd", make_lm_window_steps
         else:
             kind, builder = "spmd", make_lm_train_step
         with monitor.compile_span(
@@ -2109,6 +2479,55 @@ class LMTrainer:
                 key=(kind, cfg.grad_clip, tuple(mesh.shape.items())),
                 kind=kind):
             return builder(cfg, mesh)
+
+    def _install_step_fns(self, built) -> None:
+        """Install a step-builder result: the windowed family arrives as
+        a (local, exchange, launch, apply) tuple — ``step_fn`` is the
+        window-LOCAL step (the hot path, what the cache-size gauge and
+        the schedule inspector see); the boundary programs live beside
+        it."""
+        if isinstance(built, tuple):
+            (self.step_fn, self._exchange_fn, self._launch_fn,
+             self._apply_fn) = built
+        else:
+            self.step_fn = built
+            self._exchange_fn = self._launch_fn = self._apply_fn = None
+
+    def _stack_dcn(self, tree_: PyTree) -> PyTree:
+        """Broadcast every array leaf one copy per 'dcn' slice (leading
+        axis dcn_size, sharded over 'dcn' ahead of the leaf's own
+        spec) — the per-slice optimizer-state layout of the windowed
+        local steps."""
+        mesh, n = self.mesh, self.cfg.dcn_size
+
+        def f(x):
+            if not isinstance(x, jax.Array):
+                return x
+            spec = (x.sharding.spec
+                    if isinstance(x.sharding, NamedSharding) else P())
+            return jax.device_put(
+                jnp.broadcast_to(x[None], (n,) + x.shape),
+                NamedSharding(mesh, P(DCN, *spec)))
+
+        return jax.tree.map(f, tree_)
+
+    def _init_window_state(self) -> None:
+        """Round 18 (``sync_every > 1``): stack the optimizer state one
+        copy per 'dcn' slice and zero the per-slice window delta.  The
+        live ``params`` stay the replicated anchor — the last exchanged
+        point, what checkpoints save and ``evaluate`` reads (mid-window
+        local progress lives in the delta until the next boundary)."""
+        cfg, mesh = self.cfg, self.mesh
+        self.opt_state = self._stack_dcn(self.opt_state)
+        specs = param_specs(cfg)
+        self._delta = jax.tree.map(
+            lambda p, s: jax.device_put(
+                jnp.zeros((cfg.dcn_size,) + p.shape, p.dtype),
+                NamedSharding(mesh, P(DCN, *s))),
+            self.params, specs)
+        self._pending = None
+        self._window_t0 = None
+        self._window_wire_bytes = _lm_window_wire_bytes(cfg, mesh)
 
     def tighten_grad_clip(self, factor: float = 0.5) -> float:
         """Multiply the gradient-clip norm by ``factor`` and rebuild the
@@ -2118,7 +2537,7 @@ class LMTrainer:
         opt_state carries over unchanged; the recompile is a fault-path
         cost, not a hot-path one.  Returns the new clip norm."""
         self.cfg.grad_clip *= factor
-        self.step_fn = self._build_step_fn(self.cfg, self.mesh)
+        self._install_step_fns(self._build_step_fn(self.cfg, self.mesh))
         self._multi_fn = None
         return self.cfg.grad_clip
 
@@ -2168,6 +2587,16 @@ class LMTrainer:
         opt_host = jax.tree.map(
             lambda x: _fetch(x) if isinstance(x, jax.Array) else x,
             self.opt_state)
+        if self.cfg.sync_every > 1:
+            # windowed -> any: the per-slice optimizer state collapses
+            # to slice 0 (the rebuild drops un-exchanged window deltas
+            # and per-slice Adam divergence — up to H-1 local steps of
+            # progress, the same carry-drop contract as sync_state; the
+            # SLO actuator widens/narrows at window boundaries where
+            # the delta is zero anyway)
+            opt_host = jax.tree.map(
+                lambda x: x[0] if hasattr(x, "ndim") and x.ndim else x,
+                opt_host)
         self.cfg = cfg
         self.mesh = new_mesh
         self._batch_spec = _lm_batch_spec(cfg)
@@ -2191,7 +2620,7 @@ class LMTrainer:
             lambda old, tgt: (jax.device_put(np.asarray(old), tgt.sharding)
                               if isinstance(tgt, jax.Array) else old),
             opt_host, target)
-        self.step_fn = self._build_step_fn(cfg, new_mesh)
+        self._install_step_fns(self._build_step_fn(cfg, new_mesh))
         self.sync_state = None
         if cfg.dcn_compress is not None:
             n_dev = new_mesh.devices.size
@@ -2199,6 +2628,12 @@ class LMTrainer:
                 jnp.zeros((n_dev, lm_sync_state_len(cfg, new_mesh)),
                           jnp.float32),
                 NamedSharding(new_mesh, P(tuple(new_mesh.axis_names))))
+        self._delta = None
+        self._pending = None
+        self._window_t0 = None
+        self._window_wire_bytes = None
+        if cfg.sync_every > 1:
+            self._init_window_state()
         self._eval_fn = None
         self._multi_fn = None
         self.last_ok = None
@@ -2304,6 +2739,8 @@ class LMTrainer:
         return self._step
 
     def train_step(self, tokens: np.ndarray, targets: np.ndarray):
+        if self.cfg.sync_every > 1:
+            return self._train_step_windowed(tokens, targets)
         faults.maybe_delay(self._step)  # chaos: straggler (no-op unplanned)
         shd = NamedSharding(self.mesh, self._batch_spec)
         if jax.process_count() > 1:
@@ -2342,6 +2779,71 @@ class LMTrainer:
             self._emit_cache_size(tel, self.step_fn)
         return loss
 
+    def _train_step_windowed(self, tokens, targets):
+        """One local step of the sync_every > 1 schedule, plus whatever
+        window bookkeeping the step count makes due: the boundary
+        exchange at multiples of H (or its launch when staleness > 0)
+        and the deferred apply at kH + S.  Params hold the ANCHOR (last
+        exchanged, replica-identical); ``self._delta`` carries the
+        dcn-stacked local drift the optimizer accumulates between
+        exchanges."""
+        faults.maybe_delay(self._step)
+        shd = NamedSharding(self.mesh, self._batch_spec)
+        if jax.process_count() > 1:
+            tokens = jax.make_array_from_process_local_data(shd, tokens)
+            targets = jax.make_array_from_process_local_data(shd, targets)
+        else:
+            tokens = jax.device_put(tokens, shd)
+            targets = jax.device_put(targets, shd)
+        extra = ((jnp.int32(self._step),
+                  jnp.float32(faults.arm_window(self._step)))
+                 if faults.step_plan() is not None else ())
+        h, s = self.cfg.sync_every, self.cfg.staleness
+        t0 = time.perf_counter()
+        if self._step % h == 0:
+            self._window_t0 = t0
+        (self._delta, self.opt_state, loss, self.last_ok,
+         self.last_metrics) = self.step_fn(
+            self.params, self._delta, self.opt_state, tokens, targets,
+            *extra)
+        self._step += 1
+        boundary = self._step % h == 0
+        if boundary:
+            if s == 0:
+                if self.sync_state is not None:
+                    self.params, self._delta, self.sync_state = \
+                        self._exchange_fn(self.params, self._delta,
+                                          self.sync_state)
+                else:
+                    self.params, self._delta = self._exchange_fn(
+                        self.params, self._delta)
+            else:
+                # staleness-hidden: enqueue the exchange now; the mean
+                # delta lands at step kH + S while local compute runs
+                if self.sync_state is not None:
+                    d_avg, snap, self.sync_state = self._launch_fn(
+                        self._delta, self.sync_state)
+                else:
+                    d_avg, snap = self._launch_fn(self._delta)
+                self._pending = (d_avg, snap)
+        elif self._pending is not None and self._step % h == s:
+            d_avg, snap = self._pending
+            self._pending = None
+            self.params, self._delta = self._apply_fn(
+                self.params, self._delta, d_avg, snap)
+        faults.maybe_crash(self._step)
+        tel = telemetry.active()
+        if tel is not None:
+            telemetry.emit_train_steps(
+                tel, t0, self._step - 1, 1, loss, self.last_ok,
+                self.last_metrics, span_name="lm_train_step")
+            if boundary and self._window_t0 is not None:
+                telemetry.emit_sync_windows(
+                    tel, self._window_t0, self._step - h, h, h,
+                    wire_bytes=self._window_wire_bytes, phase="train")
+            self._emit_cache_size(tel, self.step_fn)
+        return loss
+
     def train_steps(self, tokens: np.ndarray, targets: np.ndarray):
         """Run ``K = tokens.shape[0]`` steps over stacked (K, B, S) batches
         as one compiled ``lax.scan`` dispatch; returns the K per-step
@@ -2368,6 +2870,10 @@ class LMTrainer:
             raise ValueError("train_steps does not thread the stateful "
                              "sync-state (EF residual) carry; with "
                              "dcn_compress use train_step")
+        if self.cfg.sync_every > 1:
+            raise ValueError("train_steps does not thread the window "
+                             "delta / per-slice optimizer carries; with "
+                             "sync_every > 1 use train_step")
         if self._multi_fn is None:
             with monitor.compile_span(
                     "lm_multi_build",

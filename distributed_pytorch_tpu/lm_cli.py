@@ -100,12 +100,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "with per-row scales and error-feedback "
                         "residuals threaded through the train step's "
                         "sync-state carry (requires --dcn-size >= 2)")
-    p.add_argument("--fsdp-gather-dtype", default=None, choices=["int8"],
-                   help="quantize the ZeRO-3 weight all-gathers (round "
-                        "16): parameters travel the wire as int8 + "
-                        "per-row f32 scales and dequantize at the "
-                        "consumer; gradient reduce-scatters stay "
-                        "full-precision (requires --fsdp)")
+    p.add_argument("--fsdp-gather-dtype", default=None,
+                   choices=["int8", "int4"],
+                   help="quantize the ZeRO-3 weight all-gathers: int8 "
+                        "(round 16) sends parameters as int8 + per-row "
+                        "f32 scales; int4 (round 18) packs two nibbles "
+                        "per wire byte against the same scales; either "
+                        "way they dequantize at the consumer and the "
+                        "gradient reduce-scatters stay full-precision "
+                        "(requires --fsdp)")
     p.add_argument("--matmul-dtype", default=None, choices=["int8"],
                    help="run the transformer's dense projections "
                         "(q/k/v/o and the non-MoE MLP) through the int8 "
@@ -149,6 +152,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="profile source for --sync-plan auto: a "
                         "synthetic preset name or a profile-JSON path "
                         "(default: cached/calibrated for this topology)")
+    p.add_argument("--sync-every", type=int, default=1,
+                   help="local-SGD window (round 18): run H local "
+                        "optimizer steps between cross-slice exchanges "
+                        "— the ICI hop still syncs every step, the DCN "
+                        "hop only at window boundaries (~1/H dcn "
+                        "bytes/step; requires --dcn-size >= 2, no "
+                        "--pp/--pp-size, --grad-accum 1)")
+    p.add_argument("--staleness", type=int, default=0,
+                   help="bounded staleness for --sync-every: launch the "
+                        "window exchange at step kH and apply it at "
+                        "kH+S, hiding DCN latency under S local steps "
+                        "(0 <= S < H)")
+    p.add_argument("--max-sync-every", type=int, default=None,
+                   help="staleness-risk ceiling for the interval-aware "
+                        "autotuner and the monitor's sync-relax "
+                        "actuator (default: the --sync-every value — "
+                        "relaxation stays opt-in)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
     # elastic gang membership (round 12; launch.py --elastic is the agent
@@ -272,6 +292,24 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--remat does not compose with --pp/--pp-size: the "
                      "pipeline schedulers own their own rematerialization "
                      "(each tick block is already checkpointed); drop one")
+    max_sync_every = (args.max_sync_every if args.max_sync_every is not None
+                      else max(args.sync_every, 1))
+    if (args.sync_every != 1 or args.staleness != 0
+            or max_sync_every != 1):
+        # the ONE definition site for window coherence — the same check
+        # validate_lm_cfg runs, surfaced at the parser so incoherent
+        # combos die with a usage error instead of a traceback
+        from .parallel.strategies import require_sync_window
+        try:
+            require_sync_window(
+                sync_every=args.sync_every, staleness=args.staleness,
+                max_sync_every=max_sync_every, mesh=True,
+                overlap=args.overlap,
+                pp=args.pp > 1 or args.pp_size > 0,
+                grad_accum=args.grad_accum, dcn_size=args.dcn_size,
+                trainer="lm")
+        except ValueError as e:
+            parser.error(str(e))
     if args.elastic:
         # refuse loudly anything that CANNOT resize: a pipeline's stage
         # placement is baked into the hand-emitted step, so a resized
@@ -320,6 +358,8 @@ def main(argv: list[str] | None = None) -> int:
         matmul_dtype=args.matmul_dtype,
         loss_impl=args.loss_impl or "dense", loss_chunk=args.loss_chunk,
         remat=args.remat or "none",
+        sync_every=args.sync_every, staleness=args.staleness,
+        max_sync_every=max_sync_every,
         sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
     trainer = LMTrainer(cfg)
     heartbeat = drain_guard = None

@@ -642,7 +642,13 @@ class SyncPlan:
     """The chooser's output: a resolved named strategy + knobs, with the
     prediction that justified it.  ``predicted_ms`` is the EXPOSED
     per-step sync time (wire hidden under backward compute is
-    discounted when ``overlap``); ``per_axis`` carries the raw totals."""
+    discounted when ``overlap``); ``per_axis`` carries the raw totals.
+
+    ``sync_every`` (round 18) is the chosen local-SGD window: the slow
+    hop runs once per ``sync_every`` steps, so ``predicted_ms`` is the
+    AMORTIZED per-step figure (dcn term divided by the window) when the
+    interval is > 1; ``per_axis`` stays per-EXCHANGE so the dcn row
+    remains comparable to the inspector's boundary-step bytes."""
 
     strategy: str
     bucket_mb: float
@@ -653,6 +659,7 @@ class SyncPlan:
     per_axis: tuple[AxisPlan, ...]
     profile_source: str
     census_bytes: int
+    sync_every: int = 1
 
     def axis(self, name: str) -> AxisPlan | None:
         for ap in self.per_axis:
@@ -665,6 +672,7 @@ class SyncPlan:
         return {"strategy": self.strategy, "bucket_mb": self.bucket_mb,
                 "dcn_compress": self.dcn_compress,
                 "dcn_size": self.dcn_size, "overlap": self.overlap,
+                "sync_every": self.sync_every,
                 "predicted_ms": round(self.predicted_ms, 4),
                 "profile": self.profile_source,
                 "bytes_by_axis": {ap.axis: ap.predicted_bytes
@@ -676,6 +684,7 @@ class SyncPlan:
                  f"bucket={self.bucket_mb:g}MB "
                  f"dcn_compress={self.dcn_compress or 'none'} "
                  f"overlap={self.overlap} "
+                 f"sync_every={self.sync_every} "
                  f"predicted {self.predicted_ms:.3f} ms/step "
                  f"(grads {self.census_bytes / 1e6:.2f} MB, "
                  f"profile {self.profile_source})",
@@ -915,8 +924,46 @@ def _mk_plan(name, pred, *, bucket_mb, dcn_compress, dcn_size, overlap,
         profile_source=profile.source, census_bytes=census.total_bytes)
 
 
+def _interval_for(plan: SyncPlan, max_sync_every: int,
+                  *, align: int | None = None) -> SyncPlan:
+    """Attach the local-SGD interval dimension (round 18) to a candidate
+    plan: widen the window H (powers of 2, up to ``max_sync_every``)
+    while the slow hop's AMORTIZED cost still dominates the per-step
+    fast-hop cost — once dcn/H drops at or below the ici term, further
+    widening shrinks an already-subdominant term while the staleness
+    risk keeps growing, so the admission rule stops there.  Plans
+    without a dcn row (flat strategies, single-slice meshes) never
+    widen: local-SGD windows only attach to the two-level family
+    (``strategies.require_sync_window``).  ``align`` (the VGG trainer's
+    ``steps_per_loop``) constrains H to divide it, so every compiled
+    dispatch ends on a window boundary.  ``predicted_ms`` becomes the
+    amortized per-step figure; the per-axis rows stay per-exchange."""
+    if max_sync_every <= 1:
+        return plan
+    dcn = plan.axis("dcn")
+    if dcn is None or dcn.predicted_ms <= 0.0:
+        return plan
+    ici_ms = sum(ap.predicted_ms for ap in plan.per_axis
+                 if ap.axis != "dcn")
+    h = 1
+    while (2 * h <= max_sync_every
+           and (align is None or align % (2 * h) == 0)
+           and dcn.predicted_ms / h > ici_ms):
+        h *= 2
+    if h == 1:
+        return plan
+    # the raw dcn row now bills once per H steps; the exposed figure
+    # keeps whatever overlap discount the base prediction already took,
+    # minus the amortized share of the slow hop
+    amortized = max(plan.predicted_ms
+                    - dcn.predicted_ms * (1.0 - 1.0 / h), 0.0)
+    return dataclasses.replace(plan, sync_every=h, predicted_ms=amortized)
+
+
 def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
                       dcn_size: int = 1, overlap: bool = False,
+                      max_sync_every: int = 1,
+                      steps_per_loop: int | None = None,
                       ladder: tuple = BUCKET_LADDER_MB) -> SyncPlan:
     """Pick the VGG trainer's sync plan: flat fused psum (``ddp``) vs
     bucketed psum vs the int8+EF ring on flat topologies; flat psum vs
@@ -926,7 +973,13 @@ def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
     (deterministic given a profile; candidate order breaks exact ties
     toward the simpler plan).  A caller with a pinned bucket size
     passes a one-rung ladder so the recorded prediction describes the
-    config that will actually run."""
+    config that will actually run.
+
+    ``max_sync_every`` (round 18, default 1 so relaxation stays opt-in)
+    lets the two-level candidates amortize their slow hop over a
+    local-SGD window (``_interval_for``): candidates compete on the
+    AMORTIZED per-step figure, so a windowed hierarchical plan can beat
+    the flat psum a per-step comparison would have picked."""
     factored = dcn_size > 1 and "dcn" in profile.axes
     default_mb = float(ladder[0])
     candidates: list[tuple[str, str | None, float]] = []
@@ -953,6 +1006,9 @@ def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
         plan = _mk_plan(name, pred, bucket_mb=mb, dcn_compress=compress,
                         dcn_size=dcn_size if name == "hierarchical" else 1,
                         overlap=overlap, profile=profile, census=census)
+        if name == "hierarchical":
+            plan = _interval_for(plan, max_sync_every,
+                                 align=steps_per_loop)
         if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
             best = plan
     assert best is not None
@@ -962,6 +1018,7 @@ def choose_train_plan(census: GradCensus, profile: TopologyProfile, *,
 def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
                    dcn_size: int = 1, overlap: bool = False,
                    grad_accum: int = 1, allow_compress: bool = True,
+                   max_sync_every: int = 1,
                    ladder: tuple = BUCKET_LADDER_MB) -> SyncPlan:
     """Pick the LM trainer's sync knobs.  The LM data-axis algorithm is
     structurally fixed (autodiff cotangent psums on flat meshes, the
@@ -977,7 +1034,12 @@ def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
     ici reduce-scatter/gather and ring the shard directly over dcn —
     same dcn magnitude, slightly overstated ici bytes (the per-axis
     BYTE cross-check in debug.assert_plan_bytes_match is scoped to the
-    VGG programs, where the prediction is exact)."""
+    VGG programs, where the prediction is exact).
+
+    ``max_sync_every`` (round 18) admits local-SGD windows on the
+    two-level candidates (``_interval_for`` — default 1, opt-in), so a
+    WAN-grade dcn hop can amortize over H local steps instead of being
+    paid per step."""
     if dcn_size <= 1 or "dcn" not in profile.axes:
         pred = predict_named("ddp", census, profile, overlap=overlap)
         plan = _mk_plan("flat_autodiff_psum", pred,
@@ -997,6 +1059,7 @@ def choose_lm_plan(census: GradCensus, profile: TopologyProfile, *,
                 pred, bucket_mb=mb, dcn_compress=compress,
                 dcn_size=dcn_size, overlap=overlap,
                 profile=profile, census=census)
+            plan = _interval_for(plan, max_sync_every)
             if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
                 best = plan
     assert best is not None
@@ -1163,6 +1226,11 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
             "strategy='auto' resolves dcn_compress itself; an explicit "
             "dcn_compress alongside auto is ambiguous — set one, not "
             "both (a named strategy honors the explicit knob)")
+    if cfg.sync_every != 1:
+        raise ValueError(
+            "strategy='auto' resolves sync_every itself (within "
+            "max_sync_every); an explicit sync_every alongside auto is "
+            "ambiguous — pin the strategy to pin the window")
     n = num_devices if num_devices is not None else len(jax.devices())
     if n < 2:
         plan = SyncPlan(strategy="none", bucket_mb=float(strat.BUCKET_CAP_MB),
@@ -1180,14 +1248,21 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
     # recorded prediction describes the config that actually runs
     ladder = (BUCKET_LADDER_MB if cfg.overlap_bucket_mb is None
               else (float(cfg.overlap_bucket_mb),))
+    # local-SGD windows only run on the non-overlapped window builder
+    # (require_sync_window): with overlap on, the interval stays 1
     plan = choose_train_plan(census, profile,
                              dcn_size=axes.get("dcn", 1),
-                             overlap=cfg.overlap, ladder=ladder)
+                             overlap=cfg.overlap,
+                             max_sync_every=(1 if cfg.overlap
+                                             else cfg.max_sync_every),
+                             steps_per_loop=cfg.steps_per_loop,
+                             ladder=ladder)
     resolved = dataclasses.replace(
         cfg, strategy=plan.strategy,
         dcn_size=plan.dcn_size if plan.strategy == "hierarchical"
         else cfg.dcn_size,
         dcn_compress=plan.dcn_compress,
+        sync_every=plan.sync_every,
         overlap_bucket_mb=(cfg.overlap_bucket_mb
                            if cfg.overlap_bucket_mb is not None
                            else plan.bucket_mb))
@@ -1228,10 +1303,20 @@ def resolve_lm_auto(cfg):
             "sync_plan='auto' resolves dcn_compress itself; an explicit "
             "dcn_compress alongside auto is ambiguous — set one, not "
             "both (drop sync_plan to pin the knob by hand)")
+    if cfg.sync_every != 1:
+        raise ValueError(
+            "sync_plan='auto' resolves sync_every itself (within "
+            "max_sync_every); an explicit sync_every alongside auto is "
+            "ambiguous — drop sync_plan to pin the window by hand")
     census = grad_census(jax.eval_shape(
         lambda k: tfm.init(k, cfg.model), jax.random.key(0)))
     axes = lm_topology_axes(cfg)
     profile = get_profile(cfg.autotune_profile, axes)
+    # windows require the windowed step family: no pipeline, no grad
+    # accumulation (require_sync_window) — gate the interval dimension
+    # rather than choose a plan the trainer would then refuse
+    windowable = (cfg.pp_size == 0 and cfg.pp == 1
+                  and cfg.grad_accum == 1 and cfg.dcn_size > 1)
     plan = choose_lm_plan(
         census, profile, dcn_size=cfg.dcn_size, overlap=cfg.overlap,
         grad_accum=cfg.grad_accum,
@@ -1239,10 +1324,12 @@ def resolve_lm_auto(cfg):
         # rejects dcn_compress there): keep int8 out of the candidates
         # instead of choosing a plan the trainer would then refuse
         allow_compress=cfg.pp_size == 0 and cfg.pp == 1,
+        max_sync_every=cfg.max_sync_every if windowable else 1,
         ladder=(BUCKET_LADDER_MB if cfg.bucket_mb is None
                 else (float(cfg.bucket_mb),)))
     resolved = dataclasses.replace(
         cfg, sync_plan=None, dcn_compress=plan.dcn_compress,
+        sync_every=plan.sync_every,
         bucket_mb=cfg.bucket_mb if cfg.bucket_mb is not None
         else plan.bucket_mb)
     _emit_plan(plan, side="lm")

@@ -815,6 +815,91 @@ class Hierarchical:
             dcn_reduce=self._int8_dcn_reduce(dcn, n_dcn, residual, out))
         return synced, out["res"]
 
+    # -- communication-sparse windows (round 18) ----------------------------
+    # Local-SGD on the factored mesh splits the per-step sync in two:
+    # ``local_sync`` runs EVERY step (the fast within-slice mean — exactly
+    # the per-step path's ICI ops, zero DCN ops) and ``window_exchange``
+    # runs only at window boundaries (the slow cross-slice hop over the
+    # accumulated update delta, shard-sized like the per-step DCN payload).
+    # DCN bytes per step therefore scale ~1/H while ICI bytes are
+    # unchanged — the claim tests/test_localsgd.py measures per axis from
+    # the schedule inspector.
+
+    def local_sync(self, grads: PyTree, axis) -> PyTree:
+        """Within-slice (ICI-only) gradient mean for a LOCAL step of a
+        ``sync_every > 1`` window: the per-step reduce-scatter/all-gather
+        over ``ici`` with NO cross-slice hop — each slice steps on its own
+        slice-mean gradient.  Compression never applies here (it is the
+        DCN hop's knob), so this path is stateless and vma-provable
+        regardless of ``dcn_compress``."""
+        _, ici = self._factor(axis)
+        return two_level_psum(grads, None, ici,
+                              scale=1.0 / lax.axis_size(ici))
+
+    def window_exchange(self, delta: PyTree, axis,
+                        sync_state: jax.Array | None = None):
+        """Cross-slice mean of the window's accumulated update ``delta``
+        (slice-uniform after H ``local_sync`` steps): each chip takes its
+        own static ICI-indexed chunk of the flat delta (free — the value
+        is already replicated within the slice, so slicing replaces the
+        per-step reduce-scatter), exchanges ONLY that shard over ``dcn``
+        (plain psum, or the int8/int4+EF ring under ``dcn_compress`` —
+        same chunk length as the per-step exchange, so the EF residual
+        layout and ``init_state`` are unchanged), gathers back over
+        ``ici``, and divides by the slice count.  Stateful form returns
+        ``(mean_delta, new_residual)``."""
+        dcn, ici = self._factor(axis)
+        n_dcn = lax.axis_size(dcn) if dcn else 1
+        n_ici = lax.axis_size(ici)
+        me = lax.axis_index(ici)
+        leaves, treedef = jax.tree.flatten(delta)
+        out: list[jax.Array | None] = [None] * len(leaves)
+        segs = self._segments(leaves, n_dcn, n_ici)
+        new_parts, offset = [], 0
+        for bucket, seg in zip(make_bucket_plan(leaves, self.bucket_bytes),
+                               segs):
+            sub = [leaves[i] for i in bucket]
+            flat = jnp.concatenate([g.ravel().astype(jnp.float32)
+                                    for g in sub])
+            total = flat.size
+            padded = jnp.pad(flat, (0, (-total) % n_ici))
+            chunk = padded.size // n_ici
+            shard = lax.dynamic_slice(padded, (me * chunk,), (chunk,))
+            if self.dcn_compress is None:
+                if dcn is not None:
+                    shard = lax.psum(shard, dcn)
+            else:
+                residual = sync_state[offset:offset + seg]
+                if n_dcn == 1:
+                    new_parts.append(jnp.zeros_like(residual))
+                else:
+                    shard, err_rows = self._ring._ring_sum(
+                        shard, dcn, n_dcn, residual=residual)
+                    new_parts.append(err_rows.ravel())
+                offset += seg
+            if _all_gather_inv is not None:
+                full = _all_gather_inv(shard, ici, axis=0, tiled=True)
+            else:
+                buf = jnp.zeros_like(padded)
+                buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
+                full = lax.psum(buf, ici)
+            mean = full[:total] * (1.0 / n_dcn)
+            synced = self._split(mean, sub)
+            for i, s in zip(bucket, synced):
+                out[i] = s
+        tree = jax.tree.unflatten(treedef, out)
+        if self.dcn_compress is None:
+            return tree
+        return tree, jnp.concatenate(new_parts)
+
+    def _split(self, mean: jax.Array, leaves: list) -> list:
+        out, offset = [], 0
+        for g in leaves:
+            out.append(mean[offset:offset + g.size]
+                       .reshape(g.shape).astype(g.dtype))
+            offset += g.size
+        return out
+
     def __call__(self, grads: PyTree, axis,
                  sync_state: jax.Array | None = None):
         dcn, ici = self._factor(axis)
@@ -1193,3 +1278,81 @@ def require_pp_schedulable(*, n_stages: int, n_micro: int, n_layers: int,
             f"{(n_stages - 1) / (n_stages - 1 + max(n_micro, 1)):.2f}; "
             f"use microbatches >= pp_size (>= 2*pp_size to reach the "
             f"<=1/3 bubble regime)")
+
+
+def require_sync_window(*, sync_every: int, staleness: int = 0,
+                        max_sync_every: int = 1, mesh: bool = True,
+                        overlap: bool = False, pp: bool = False,
+                        grad_accum: int = 1, dcn_size: int | None = None,
+                        steps_per_loop: int | None = None,
+                        trainer: str = "train") -> None:
+    """The communication-sparse window coherence check
+    (``TrainConfig(sync_every=H)`` / ``LMTrainConfig(sync_every=H)``,
+    round 18): ONE definition site — the round-9 ``require_*``
+    consolidation — shared by both trainers' config validation, both
+    CLIs, and bench's pre-bench knob validation, so the refusal
+    conditions cannot drift from what the windowed step builders
+    actually compile.
+
+    Rejects the incoherent combos loudly: windows need a mesh (the
+    meshless single-jit path has no collective to amortize and no
+    per-device local state); pipeline stages own their own schedule
+    (the 1F1B step has no per-step data exchange a window could skip);
+    grad_accum already IS a window over the exchange (composing the two
+    double-counts the amortization); the VGG in-backward overlap
+    machinery streams the very per-step collective a window removes;
+    LM windows relax the DCN hop specifically, so they need a factored
+    mesh (dcn_size >= 2) to have a slow axis to relax; and bounded
+    staleness must leave the window room to hide under (0 <= S < H,
+    S = 0 meaning apply-at-boundary)."""
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    if max_sync_every < 1:
+        raise ValueError(
+            f"max_sync_every must be >= 1, got {max_sync_every}")
+    if staleness < 0:
+        raise ValueError(f"staleness must be >= 0, got {staleness}")
+    if sync_every == 1 and staleness > 0:
+        raise ValueError(
+            f"staleness={staleness} needs sync_every > 1: with per-step "
+            f"sync there are no local steps to hide the exchange under")
+    if staleness >= sync_every and staleness > 0:
+        raise ValueError(
+            f"staleness={staleness} >= sync_every={sync_every}: the "
+            f"delayed window exchange must land before the next one "
+            f"launches (0 <= S < H; S=0 applies at the boundary step)")
+    if sync_every == 1:
+        return
+    if not mesh:
+        raise ValueError(
+            f"sync_every={sync_every} needs a device mesh: the meshless "
+            f"single-jit path has no collective exchange to amortize "
+            f"(and no per-device window state); use a mesh-backed "
+            f"strategy or sync_every=1")
+    if pp:
+        raise ValueError(
+            f"sync_every={sync_every} is incompatible with pipeline "
+            f"parallelism (pp_size > 0): the 1F1B schedule has no "
+            f"per-step data exchange a window could skip")
+    if grad_accum > 1:
+        raise ValueError(
+            f"sync_every={sync_every} with grad_accum={grad_accum}: "
+            f"grad accumulation already amortizes the exchange over its "
+            f"micro-steps — composing the two would double-count the "
+            f"window; pick one")
+    if trainer == "train" and overlap:
+        raise ValueError(
+            f"sync_every={sync_every} with overlap=True: the in-backward "
+            f"markers stream the per-step collective a window removes; "
+            f"run windows post-backward (overlap=False)")
+    if trainer == "lm" and dcn_size is not None and dcn_size < 2:
+        raise ValueError(
+            f"sync_every={sync_every} needs dcn_size >= 2 on the LM "
+            f"trainer: windows relax the slow DCN hop specifically — "
+            f"with a single slice there is no scarce axis to relax")
+    if (trainer == "train" and steps_per_loop is not None
+            and steps_per_loop % sync_every):
+        raise ValueError(
+            f"steps_per_loop={steps_per_loop} is not a multiple of "
+            f"sync_every={sync_every}: each compiled dispatch must end "
+            f"on a window boundary so params leave the step replicated")

@@ -21,7 +21,7 @@ momentum buffer, then the step).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any
 
@@ -108,6 +108,27 @@ class TrainConfig:
     compute_dtype: str | None = None  # e.g. "bfloat16" for MXU-friendly compute
     augment: bool = True
     seed: int = 1                 # torch.manual_seed(1), main.py:70
+    # Communication-sparse sync (round 18, the BAGUA/local-SGD system
+    # relaxation): run H local optimizer steps between cross-replica
+    # exchanges — each replica (each SLICE under 'hierarchical', which
+    # keeps its fast ICI mean every step and skips only the DCN hop)
+    # steps on its own gradients while the window's accumulated update
+    # delta is averaged once per H steps, so exchange wire bytes per
+    # step scale ~1/H.  1 (default) is the existing per-step path,
+    # UNTOUCHED at build time (bitwise + compile-count identical).
+    # Requires a mesh, steps_per_loop % H == 0 (every dispatch ends on
+    # a window boundary), and overlap=False — strategies.
+    # require_sync_window is the one refusal site.  Momentum buffers
+    # stay LOCAL per device across windows (they ride a leading device
+    # axis like BN state), the standard local-momentum variant.
+    sync_every: int = 1
+    # Relaxation ceiling for the interval-aware autotuner
+    # (strategy="auto" prices exposed sync time at H in powers of 2 up
+    # to this) and the monitor's straggler actuator
+    # (monitor.SyncRelaxHook widens sync_every within it on step-time
+    # SLO breach).  Default 1: relaxation is OPT-IN — staleness is a
+    # convergence trade the user must accept explicitly.
+    max_sync_every: int = 1
 
     @property
     def dtype(self):
@@ -262,6 +283,16 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     _apply_dcn(cfg, strategy)
     _apply_bucket_mb(cfg, strategy)
     _validate_overlap(cfg, strategy, mesh)
+    # Communication-sparse windows (round 18): coherence check at the ONE
+    # definition site (strategies.require_sync_window).  sync_every == 1
+    # never enters the windowed builder below, so the per-step path —
+    # jaxpr, specs, compile count — is byte-identical to round 17 by
+    # construction, not by test luck.
+    windowed = cfg.sync_every > 1
+    if windowed:
+        strat.require_sync_window(
+            sync_every=cfg.sync_every, max_sync_every=cfg.max_sync_every,
+            mesh=mesh is not None, overlap=cfg.overlap, trainer="train")
     # The data axis may be factored: hierarchical runs over ('dcn', 'ici').
     data_axes = getattr(strategy, "axes", None) or DATA_AXIS
     bn_axis = data_axes if (cfg.sync_bn and mesh is not None) else None
@@ -387,6 +418,107 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                 (images, labels)))
         return params, state, opt_state, sync_state, losses, oks, mets
 
+    if windowed:
+        # Local-SGD window loop: a nested scan — outer over K/H window
+        # boundaries, inner over H local steps — so the schedule
+        # inspector's trip accounting (utils/debug.py multiplies nested
+        # scan lengths) can PROVE the boundary collectives run once per
+        # window, which a lax.cond-gated flat loop cannot (cond bodies
+        # are counted every trip).  The carry tracks the window's params
+        # as anchor + delta: ``anchor`` is the last exchanged (replica-
+        # identical) point, ``delta`` the locally accumulated optimizer
+        # updates since — the boundary then exchanges ONLY delta, and
+        # plain-SGD windows are bitwise an accumulated-gradient-averaging
+        # oracle by pure reassociation (tests/test_localsgd.py).
+        hier = hasattr(strategy, "window_exchange")
+
+        def scan_steps_windowed(params, state, opt_state, sync_state, key,
+                                step0, images, labels, fault_arm=0.0, *,
+                                axis):
+            h = cfg.sync_every
+            k_total = images.shape[0]
+            if k_total % h:
+                raise ValueError(
+                    f"dispatch of {k_total} steps is not a multiple of "
+                    f"sync_every={h}: every compiled dispatch must end "
+                    f"on a window boundary so params leave replicated")
+
+            def local_body(anchor, carry, batch):
+                delta, state, opt_state, step = carry
+                imgs, lbls = batch
+                k = jax.random.fold_in(key, step)
+                k = jax.random.fold_in(k, jax.lax.axis_index(axis))
+                local_params = _as_varying(
+                    jax.tree.map(jnp.add, anchor, delta), axis)
+                (loss, state), grads = grad_fn(local_params, state, k,
+                                               imgs, lbls)
+                grads = faults.tap_grads(grads, step, fault_arm)
+                loss = faults.tap_loss(loss, step, fault_arm)
+                if bcast_buffers:
+                    idx = jax.lax.axis_index(axis)
+                    state = jax.tree.map(
+                        lambda s: _as_varying(
+                            jax.lax.psum(
+                                jnp.where(idx == 0, s, jnp.zeros_like(s)),
+                                axis), axis),
+                        state)
+                if hier:
+                    # within-slice mean every step: the per-step path's
+                    # ICI ops, zero DCN ops (Hierarchical.local_sync);
+                    # flat strategies step fully locally instead
+                    grads = strategy.local_sync(grads, axis)
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in jax.tree.leaves(grads))
+                ok = (jnp.isfinite(loss) & jnp.isfinite(gsq)).astype(
+                    jnp.float32)
+                updates, opt_state = tx.update(grads, opt_state,
+                                               local_params)
+                delta = jax.tree.map(jnp.add, delta, updates)
+                met = ops.step_metrics(
+                    gsq, jax.tree.map(jnp.add, anchor, delta))
+                return (delta, state, opt_state, step + 1), (loss, ok,
+                                                             met)
+
+            def window_body(carry, batch):
+                anchor, delta, state, opt_state, sync_state, step = carry
+                (delta, state, opt_state, step), outs = jax.lax.scan(
+                    partial(local_body, anchor),
+                    (delta, state, opt_state, step), batch)
+                # boundary: cross-replica mean of the accumulated update
+                # — the window's ONE slow exchange (shard-sized over dcn
+                # for hierarchical, incl. the int8/int4+EF ring; the
+                # full strategy collective for flat strategies)
+                if hier:
+                    ex = (strategy.window_exchange(delta, axis,
+                                                   sync_state)
+                          if stateful
+                          else strategy.window_exchange(delta, axis))
+                else:
+                    ex = (strategy(delta, axis, sync_state) if stateful
+                          else strategy(delta, axis))
+                if stateful:
+                    d_avg, sync_state = ex
+                else:
+                    d_avg = ex
+                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                delta = jax.tree.map(jnp.zeros_like, delta)
+                return (anchor, delta, state, opt_state, sync_state,
+                        step), outs
+
+            w = k_total // h
+            imgs = images.reshape((w, h) + images.shape[1:])
+            lbls = labels.reshape((w, h) + labels.shape[1:])
+            delta = jax.tree.map(jnp.zeros_like, params)
+            (params, _, state, opt_state, sync_state, _), (losses, oks,
+                                                           mets) = (
+                jax.lax.scan(
+                    window_body,
+                    (params, delta, state, opt_state, sync_state, step0),
+                    (imgs, lbls)))
+            return (params, state, opt_state, sync_state,
+                    losses.reshape(k_total), oks.reshape(k_total),
+                    mets.reshape((k_total,) + mets.shape[2:]))
+
     if mesh is None:
         if strategy.needs_mesh:
             raise ValueError(f"strategy {strategy.name!r} requires a mesh")
@@ -407,29 +539,58 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
 
         return multi_step
 
-    def run_shard(params, state, opt_state, sync_state, key, step0,
-                  images, labels, fault_arm):
-        local_state = jax.tree.map(lambda s: s[0], state)
-        local_sync = jax.tree.map(lambda s: s[0], sync_state)
-        (params, new_state, opt_state, new_sync, losses, oks,
-         mets) = scan_steps(
-            params, local_state, opt_state, local_sync, key, step0,
-            images, labels, fault_arm, axis=data_axes)
-        new_state = jax.tree.map(lambda s: s[None], new_state)
-        new_sync = jax.tree.map(lambda s: s[None], new_sync)
-        # oks pmean: 1.0 iff EVERY replica's step was healthy (a poisoned
-        # shard pulls the mean below 1 even before its sync spreads it);
-        # mets pmean: synced grads/params are replica-identical, so the
-        # mean is the value — it just also PROVES invariance to the vma
-        # checker (a few scalar psums, excluded from the schedule pins
-        # by their min_bytes filter).  mets may arrive vma-INVARIANT
-        # (derived from post-psum grads and updated params), and modern
-        # runtimes reject reducing an invariant value — cast varying
-        # first (pass-through where already varying, no-op on legacy).
-        return (params, new_state, opt_state, new_sync,
-                jax.lax.pmean(losses, data_axes),
-                jax.lax.pmean(oks, data_axes),
-                jax.lax.pmean(_as_varying(mets, data_axes), data_axes))
+    if windowed:
+        # Per-device momentum (local-momentum local SGD): the optimizer
+        # state rides a leading device axis like BN state — it never
+        # crosses the wire, so the boundary exchange stays delta-only
+        # (the 1/H dcn-byte claim) at the cost of replica-local buffers.
+        opt_spec = P(data_axes)
+
+        def run_shard(params, state, opt_state, sync_state, key, step0,
+                      images, labels, fault_arm):
+            local_state = jax.tree.map(lambda s: s[0], state)
+            local_opt = jax.tree.map(lambda s: s[0], opt_state)
+            local_sync = jax.tree.map(lambda s: s[0], sync_state)
+            (params, new_state, new_opt, new_sync, losses, oks,
+             mets) = scan_steps_windowed(
+                params, local_state, local_opt, local_sync, key, step0,
+                images, labels, fault_arm, axis=data_axes)
+            new_state = jax.tree.map(lambda s: s[None], new_state)
+            new_opt = jax.tree.map(lambda s: s[None], new_opt)
+            new_sync = jax.tree.map(lambda s: s[None], new_sync)
+            return (params, new_state, new_opt, new_sync,
+                    jax.lax.pmean(losses, data_axes),
+                    jax.lax.pmean(oks, data_axes),
+                    jax.lax.pmean(_as_varying(mets, data_axes),
+                                  data_axes))
+    else:
+        opt_spec = P()
+
+        def run_shard(params, state, opt_state, sync_state, key, step0,
+                      images, labels, fault_arm):
+            local_state = jax.tree.map(lambda s: s[0], state)
+            local_sync = jax.tree.map(lambda s: s[0], sync_state)
+            (params, new_state, opt_state, new_sync, losses, oks,
+             mets) = scan_steps(
+                params, local_state, opt_state, local_sync, key, step0,
+                images, labels, fault_arm, axis=data_axes)
+            new_state = jax.tree.map(lambda s: s[None], new_state)
+            new_sync = jax.tree.map(lambda s: s[None], new_sync)
+            # oks pmean: 1.0 iff EVERY replica's step was healthy (a
+            # poisoned shard pulls the mean below 1 even before its sync
+            # spreads it); mets pmean: synced grads/params are
+            # replica-identical, so the mean is the value — it just also
+            # PROVES invariance to the vma checker (a few scalar psums,
+            # excluded from the schedule pins by their min_bytes
+            # filter).  mets may arrive vma-INVARIANT (derived from
+            # post-psum grads and updated params), and modern runtimes
+            # reject reducing an invariant value — cast varying first
+            # (pass-through where already varying, no-op on legacy).
+            return (params, new_state, opt_state, new_sync,
+                    jax.lax.pmean(losses, data_axes),
+                    jax.lax.pmean(oks, data_axes),
+                    jax.lax.pmean(_as_varying(mets, data_axes),
+                                  data_axes))
 
     if fault_sig:
         def shard_multi_step(params, state, opt_state, sync_state, key,
@@ -447,9 +608,10 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     return jax.jit(shard_map(
         shard_multi_step,
         mesh=mesh,
-        in_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(),
+        in_specs=(P(), P(data_axes), opt_spec, P(data_axes), P(), P(),
                   P(None, data_axes), P(None, data_axes)) + extra_specs,
-        out_specs=(P(), P(data_axes), P(), P(data_axes), P(), P(), P()),
+        out_specs=(P(), P(data_axes), opt_spec, P(data_axes), P(), P(),
+                   P()),
         # Ring-collective strategies assemble their result from ppermute
         # hops: bitwise replicated by construction, but not provably so to
         # the vma checker (no sanctioned varying->invariant downcast).
@@ -556,6 +718,13 @@ class Trainer:
         _apply_dcn(cfg, self.strategy)
         _apply_bucket_mb(cfg, self.strategy)
         _validate_overlap(cfg, self.strategy, self.mesh)
+        # round-18 window coherence at the ONE definition site — includes
+        # the dispatch-alignment refusal (steps_per_loop % sync_every)
+        # so every compiled dispatch ends on a window boundary
+        strat.require_sync_window(
+            sync_every=cfg.sync_every, max_sync_every=cfg.max_sync_every,
+            mesh=self.mesh is not None, overlap=cfg.overlap,
+            steps_per_loop=cfg.steps_per_loop, trainer="train")
 
         key = jax.random.key(cfg.seed)
         self.init_key, self.data_key = jax.random.split(key)
@@ -577,7 +746,15 @@ class Trainer:
             rep = replicated(self.mesh)
             shd = NamedSharding(self.mesh, P(self.data_axes))
             params = jax.device_put(params, rep)
-            opt_state = jax.device_put(opt_state, rep)
+            if cfg.sync_every > 1:
+                # windowed mode: per-device momentum rides a leading
+                # device axis like BN state (local-momentum local SGD —
+                # it never crosses the wire, keeping the boundary
+                # exchange delta-only)
+                opt_state = jax.device_put(
+                    replicate_state(opt_state, self.n_replicas), shd)
+            else:
+                opt_state = jax.device_put(opt_state, rep)
             state = jax.device_put(
                 replicate_state(state, self.n_replicas), shd)
             sync_state = jax.device_put(sync_state, shd)
@@ -609,6 +786,24 @@ class Trainer:
             getattr(self.strategy, "vma_opaque", False)
             and self.mesh is not None)
         self._unverified_exes: set = set()
+        self._window_wire_bytes = self._compute_window_wire_bytes()
+
+    def _compute_window_wire_bytes(self):
+        """Static f32 payload of ONE window-boundary exchange (the round-18
+        per-window wire gauge): the shard-sized dcn hop for hierarchical
+        (per bucket, ceil(bucket/n_ici) elements), the full tree for flat
+        strategies.  Compression rides below this estimate (int8 ~1/4,
+        int4 ~1/8 of it); None when not windowed."""
+        if self.cfg.sync_every <= 1:
+            return None
+        leaves = jax.tree.leaves(self.params)
+        if hasattr(self.strategy, "window_exchange"):
+            n_ici = max(self.n_replicas // self.cfg.dcn_size, 1)
+            return sum(
+                4 * -(-sum(leaves[i].size for i in b) // n_ici)
+                for b in strat.make_bucket_plan(
+                    leaves, self.strategy.bucket_bytes))
+        return sum(4 * leaf.size for leaf in leaves)
 
     # -- one optimizer step over a *global* batch -------------------------
     def train_step(self, images: np.ndarray, labels: np.ndarray) -> jax.Array:
@@ -699,6 +894,13 @@ class Trainer:
         per-step losses.  Produces the identical parameter/RNG trajectory as
         K ``train_step`` calls — just one dispatch instead of K."""
         k = images.shape[0]
+        if self.cfg.sync_every > 1 and k % self.cfg.sync_every:
+            raise ValueError(
+                f"train_steps got {k} steps with sync_every="
+                f"{self.cfg.sync_every}: dispatches must be window-"
+                f"aligned (k % H == 0) so params leave the step "
+                f"replicated; stack window-multiple batches (train_step's "
+                f"K=1 path is likewise unavailable under windows)")
         faults.maybe_delay(self._step, k)  # chaos: straggler (no-op unplanned)
         images, labels = self._stage(images, labels)
         # one-shot host arming of step-keyed grad/loss faults (consumes a
@@ -724,6 +926,10 @@ class Trainer:
         if tel is not None:
             telemetry.emit_train_steps(tel, t0, self._step - k, k, losses,
                                        oks, mets)
+            if self.cfg.sync_every > 1:
+                telemetry.emit_sync_windows(
+                    tel, t0, self._step - k, k, self.cfg.sync_every,
+                    wire_bytes=self._window_wire_bytes)
         if key in self._unverified_exes:
             self._unverified_exes.discard(key)
             self.check_consistency()
@@ -813,7 +1019,7 @@ class Trainer:
 
     # -- elastic resize (round 12) ----------------------------------------
     def rebuild(self, mesh: Mesh | None = None,
-                num_devices: int | None = None) -> None:
+                num_devices: int | None = None, **overrides) -> None:
         """Re-create the compiled step on a NEW mesh, carrying the live
         training state across — the in-process half of the elastic gang
         (parallel/elastic.py): when the fleet shrinks or grows, the step
@@ -836,6 +1042,24 @@ class Trainer:
                 "in-process rebuild is single-controller; multi-process "
                 "gangs resize via the elastic agent's drain + "
                 "re-rendezvous (launch.py --elastic)")
+        was_windowed = self.cfg.sync_every > 1
+        if overrides:
+            # config overrides (round 18): the monitor's straggler
+            # actuator widens/narrows sync_every through here — re-tune
+            # step knobs on the LIVE strategy; a strategy change needs a
+            # fresh Trainer (mesh recipe and sync-state layout differ)
+            cfg = replace(self.cfg, **overrides)
+            if cfg.strategy != self.cfg.strategy:
+                raise ValueError(
+                    "rebuild(**overrides) re-tunes step knobs on the "
+                    "live strategy; changing the strategy itself needs "
+                    "a fresh Trainer")
+            strat.require_sync_window(
+                sync_every=cfg.sync_every,
+                max_sync_every=cfg.max_sync_every, mesh=True,
+                overlap=cfg.overlap, steps_per_loop=cfg.steps_per_loop,
+                trainer="train")
+            self.cfg = cfg
         if not self.strategy.needs_mesh:
             raise ValueError(
                 f"strategy {self.strategy.name!r} runs without a mesh; "
@@ -873,6 +1097,10 @@ class Trainer:
 
         params_host = jax.tree.map(_fetch, self.params)
         opt_host = jax.tree.map(_fetch, self.opt_state)
+        if was_windowed:
+            # per-device momentum rode a leading device axis; carry rank
+            # 0's buffers across the resize (the BN rank-0 convention)
+            opt_host = jax.tree.map(lambda s: s[0], opt_host)
         state0 = rank0_state(self.state, self.mesh)  # rank-0 authoritative
 
         self.mesh = mesh
@@ -880,7 +1108,12 @@ class Trainer:
         rep = replicated(mesh)
         shd = NamedSharding(mesh, P(self.data_axes))
         self.params = jax.device_put(params_host, rep)
-        self.opt_state = jax.device_put(opt_host, rep)
+        if self.cfg.sync_every > 1:
+            self.opt_state = jax.device_put(
+                replicate_state(jax.tree.map(jnp.asarray, opt_host),
+                                self.n_replicas), shd)
+        else:
+            self.opt_state = jax.device_put(opt_host, rep)
         self.state = jax.device_put(
             replicate_state(jax.tree.map(jnp.asarray, state0),
                             self.n_replicas), shd)
@@ -897,14 +1130,19 @@ class Trainer:
         self._unverified_exes = set()
         self.last_ok = None
         self.last_metrics = None
+        self._window_wire_bytes = self._compute_window_wire_bytes()
 
     def check_consistency(self) -> None:
         """Verify the DP invariants (utils/debug.py): params and optimizer
         state bitwise-identical on every replica, and finite.  The check the
         reference never does — torch DDP enforces it once by broadcast; the
-        manual variants just trust same-seed init + sync (SURVEY.md 2.3)."""
-        dbg.assert_replicas_in_sync(
-            {"params": self.params, "opt_state": self.opt_state},
-            what="params/opt_state")
+        manual variants just trust same-seed init + sync (SURVEY.md 2.3).
+        Under sync_every > 1 the optimizer state is per-device BY DESIGN
+        (local momentum, a leading device axis) — only params, which every
+        window boundary re-replicates, are checked there."""
+        tree = {"params": self.params}
+        if self.cfg.sync_every == 1:
+            tree["opt_state"] = self.opt_state
+        dbg.assert_replicas_in_sync(tree, what="params/opt_state")
         dbg.assert_finite(jax.tree.map(np.asarray, self.params),
                           what="params")

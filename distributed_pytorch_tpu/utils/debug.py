@@ -199,6 +199,30 @@ def per_axis_collective_stats(sched: list[dict],
             for a in axes}
 
 
+def amortized_axis_bytes(entries, steps: int,
+                         min_bytes: int = 0) -> dict[str, float]:
+    """Per-axis wire bytes PER STEP of a multi-program step family:
+    ``entries`` is an iterable of ``(sched, multiplicity)`` pairs — each
+    jaxpr schedule weighted by how many times it runs over a ``steps``-
+    step window — and the result sums each axis's scan-trip-weighted
+    ``bytes_executed`` across them, divided by ``steps``.
+
+    This is the round-18 measurement behind the local-SGD claim: a
+    ``sync_every=H`` trainer runs the LOCAL schedule H times and the
+    boundary-EXCHANGE schedule once per window, so
+    ``amortized_axis_bytes([(local, H), (exchange, 1)], H)`` gives the
+    honest dcn-axis bytes/step to compare against the per-step path's
+    ``amortized_axis_bytes([(step, 1)], 1)`` — the ~1/H scaling pin
+    (tests/test_localsgd.py, the __graft_entry__ dryrun leg)."""
+    totals: dict[str, float] = {}
+    for sched, mult in entries:
+        for axis, stats in per_axis_collective_stats(
+                sched, min_bytes=min_bytes).items():
+            totals[axis] = (totals.get(axis, 0.0)
+                            + float(stats["bytes_executed"]) * mult)
+    return {a: b / float(steps) for a, b in totals.items()}
+
+
 def assert_overlap_schedule(sched: list[dict], axes=("data",),
                             min_interleaved: int = 2,
                             min_bytes: int = 0) -> dict:

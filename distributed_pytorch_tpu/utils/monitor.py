@@ -665,6 +665,76 @@ class FleetBreachHook:
         return self
 
 
+class SyncRelaxHook:
+    """The straggler ACTUATOR (round 18): a step-time SLO breach widens
+    the trainer's local-SGD window — ``rebuild(sync_every=2*current)``
+    within ``cfg.max_sync_every`` — so a congested DCN hop amortizes
+    over more local steps instead of stalling every boundary; the clear
+    narrows back to the config's base interval.  Rule-table-not-new-
+    plumbing (the round-15 monitor's promise): any ``SloRule`` name can
+    drive it — the stock pairing is ``default_rules``'s ``step_time``
+    p95 — and the transition rides the existing breach/clear hook bus.
+    With ``max_sync_every`` at its default 1 every widen request clamps
+    to a no-op: relaxation stays opt-in, exactly like passing
+    ``sync_every`` by hand.
+
+    The rebuild drops per-device optimizer divergence and any
+    un-exchanged window delta (both trainers' documented carry-drop
+    contract) — acceptable for an actuator that fires on the SLO
+    cadence, not per step."""
+
+    def __init__(self, trainer, *, rule: str = "step_time", log=None):
+        self.trainer = trainer
+        self.rule = rule
+        self.log = log
+        self.base = trainer.cfg.sync_every
+
+    def _retarget(self, target: int, direction: str,
+                  st: SloState) -> None:
+        cur = self.trainer.cfg.sync_every
+        if target == cur:
+            return
+        try:
+            self.trainer.rebuild(sync_every=target)
+        except ValueError as e:
+            # a config that cannot window (overlap, meshless, ...)
+            # must not kill the doctor — log the refusal and stand down
+            log_line(f"[monitor] sync relax refused: {e}")
+            return
+        msg = (f"[monitor] request_sync_relax: sync_every {cur} -> "
+               f"{target} ({direction}, rule {st.rule.name})")
+        log_line(msg)
+        if self.log is not None:
+            try:
+                self.log(msg)
+            except Exception:
+                pass
+        tel = telemetry.active()
+        if tel is not None:
+            tel.event("request_sync_relax", phase="slo",
+                      rule=st.rule.name, direction=direction,
+                      sync_every=target, previous=cur,
+                      max_sync_every=self.trainer.cfg.max_sync_every)
+
+    def breach(self, st: SloState) -> None:
+        if st.rule.name != self.rule:
+            return
+        cur = self.trainer.cfg.sync_every
+        ceiling = self.trainer.cfg.max_sync_every
+        self._retarget(min(max(2 * cur, 2), max(ceiling, 1)),
+                       "widen", st)
+
+    def clear(self, st: SloState) -> None:
+        if st.rule.name != self.rule:
+            return
+        self._retarget(self.base, "narrow", st)
+
+    def register(self, doctor: RunDoctor) -> "SyncRelaxHook":
+        doctor.on_breach(self.breach)
+        doctor.on_clear(self.clear)
+        return self
+
+
 # ---------------------------------------------------------------------------
 # rule presets / serialization
 

@@ -409,6 +409,33 @@ def emit_train_steps(tel: Telemetry, t0: float, step0: int, k: int,
     tel.counter("steps", k, phase=phase)
 
 
+def emit_sync_windows(tel: Telemetry, t0: float, step0: int, k: int,
+                      sync_every: int, *, wire_bytes: int | None = None,
+                      span_name: str = "sync_window",
+                      phase: str = "train") -> None:
+    """Window-boundary spans + per-window wire gauges for a
+    communication-sparse dispatch (round 18, ``sync_every > 1``): one
+    ``sync_window`` span per completed H-step window inside the
+    dispatch, stamped with its step range, plus a ``window_wire_bytes``
+    gauge (the trainer's static f32 estimate of ONE boundary exchange's
+    payload — compression rides below it).  The dispatch is one host
+    measurement, so the window spans split its duration evenly: the
+    timeline shows boundary CADENCE, not per-window jitter (per-window
+    device timing would need device instrumentation the zero-overhead
+    pin forbids)."""
+    windows = k // sync_every
+    if windows <= 0:
+        return
+    dur = (time.perf_counter() - t0) / windows
+    for w in range(windows):
+        tel.span_at(span_name, t0 + w * dur, dur, phase=phase,
+                    step0=int(step0) + w * sync_every, k=sync_every)
+        if wire_bytes is not None:
+            tel.gauge("window_wire_bytes", float(wire_bytes), phase=phase,
+                      step=int(step0) + (w + 1) * sync_every - 1)
+    tel.counter("sync_windows", windows, phase=phase)
+
+
 # ---------------------------------------------------------------------------
 # exporter: merge every rank's files -> Chrome trace + run summary
 

@@ -70,6 +70,11 @@ RULES: dict[str, tuple[str, float]] = {
     # move means the model/stack changed, not noise.
     "lm_ce_peak_activation_bytes": ("lower", 0.02),
     "lm_remat_saved_bytes": ("higher", 0.02),
+    # round 18: the windowed dcn payload is deterministic inspector
+    # accounting like the int4 bytes (tight band); the local-SGD A/B
+    # is a wall-clock median like the other speedups.
+    "train_localsgd_speedup": ("higher", 0.10),
+    "train_dcn_bytes_per_step_windowed": ("lower", 0.02),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,

@@ -157,3 +157,14 @@ def pytest_configure(config):
         "memory-priced autotuner rungs).  All memory tests are fast and "
         "ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic`/`fleet`/`monitor` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "localsgd: communication-sparse lane (round 18) — `pytest -m "
+        "localsgd` runs the sync-window machinery (tests/"
+        "test_localsgd.py: the sync_every=1 bitwise/compile-count pins, "
+        "the plain-SGD window == accumulated-gradient oracle identity, "
+        "Adam curve-following, the inspector's ~1/H dcn byte claim, "
+        "the interval-aware chooser matrix, CLI/config refusals, the "
+        "SLO widen->narrow actuator).  All localsgd tests are fast and "
+        "ride tier-1 via `-m 'not slow'` (wired like the "
+        "`faults`/`elastic`/`fleet`/`monitor`/`memory` lanes).")

@@ -74,11 +74,60 @@ def test_parser_lowbit_flags():
     assert lm_args.dcn_compress == "int4"
     assert lm_args.fsdp_gather_dtype == "int8"
     assert lm_args.matmul_dtype == "int8"
-    for bad in (["--fsdp-gather-dtype", "int4"],
-                ["--matmul-dtype", "int4"],
+    # round 18 lifts the round-16 int4-gather refusal (nibble-packed
+    # u8 wire, tests/test_lowbit.py); the matmul kernel still has no
+    # int4 analogue
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--fsdp", "--fsdp-gather-dtype", "int4"])
+    assert lm_args.fsdp_gather_dtype == "int4"
+    for bad in (["--matmul-dtype", "int4"],
                 ["--dcn-compress", "fp8"]):
         with pytest.raises(SystemExit):
             lm_cli.build_parser().parse_args(bad)
+
+
+def test_parser_localsgd_flags():
+    """Round-18 surface: --sync-every reaches both CLIs (plus
+    --staleness / --max-sync-every on the LM side) with per-step
+    defaults so historical invocations are byte-identical; incoherent
+    combos refuse loudly through the SAME require_sync_window check the
+    trainers run, at the parser, before any mesh or compile."""
+    import pytest
+
+    from distributed_pytorch_tpu import lm_cli
+
+    args = cli.build_parser().parse_args([])
+    assert args.sync_every == 1 and args.max_sync_every is None
+    args = cli.build_parser().parse_args(
+        ["--strategy", "hierarchical", "--dcn-size", "2",
+         "--sync-every", "4", "--max-sync-every", "8"])
+    assert args.sync_every == 4 and args.max_sync_every == 8
+
+    lm_args = lm_cli.build_parser().parse_args([])
+    assert lm_args.sync_every == 1 and lm_args.staleness == 0
+    assert lm_args.max_sync_every is None
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--dp", "4", "--dcn-size", "2", "--sync-every", "4",
+         "--staleness", "1", "--max-sync-every", "8"])
+    assert lm_args.sync_every == 4 and lm_args.staleness == 1
+    assert lm_args.max_sync_every == 8
+
+    # refusals (argparse SystemExit, pre-init — the one definition site)
+    with pytest.raises(SystemExit):  # LM windows need a factored mesh
+        lm_cli.main(["--dp", "4", "--sync-every", "4"])
+    with pytest.raises(SystemExit):  # staleness must leave window room
+        lm_cli.main(["--dp", "4", "--dcn-size", "2",
+                     "--sync-every", "4", "--staleness", "4"])
+    with pytest.raises(SystemExit):  # staleness without a window
+        lm_cli.main(["--staleness", "1"])
+    with pytest.raises(SystemExit):  # pipeline owns its own schedule
+        lm_cli.main(["--dp", "2", "--dcn-size", "2", "--sync-every", "4",
+                     "--pp-size", "2", "--microbatches", "4"])
+    with pytest.raises(SystemExit):  # VGG: overlap streams the sync
+        cli.main(["--strategy", "hierarchical", "--dcn-size", "2",
+                  "--sync-every", "2", "--overlap"])
+    with pytest.raises(SystemExit):  # VGG: meshless has no collective
+        cli.main(["--strategy", "none", "--sync-every", "2"])
 
 
 def test_parser_memory_flags():

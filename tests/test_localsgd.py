@@ -1,0 +1,522 @@
+"""Communication-sparse training lane (round 18).
+
+The local-SGD window contract, pinned end to end:
+
+- ``sync_every=1`` is the existing per-step path BITWISE (params +
+  optimizer state) and at the SAME compile count on both trainers —
+  the windowed builder never touches the H=1 programs;
+- plain SGD (momentum=0, wd=0) under a window equals the sequential
+  accumulated-update oracle: per device, H local ``tx.update`` steps at
+  the drifting local params; deltas averaged at the boundary;
+- momentum/Adam windows FOLLOW the per-step curve (local-momentum
+  variant: loose tolerance, not identity);
+- the inspector's byte claim: at H the dcn-axis wire bytes per step are
+  ~1/H of the per-step path (<= 0.27x at H=4) while the fast-axis
+  bytes stay in a narrow band — ici is NOT bit-identical because the
+  boundary exchange's ici share itself amortizes at 1/H;
+- the interval-aware autotuner: H > 1 on dcn-dominated profiles
+  (``wan_dcn``), H == 1 on ``uniform``, ceiling- and alignment-
+  constrained, and ``auto`` alongside an explicit ``sync_every``
+  refuses as ambiguous;
+- ``require_sync_window``: every incoherent-combo refusal, pinned by
+  message (the ONE definition site both trainers and both CLIs share);
+- the monitor actuator: a step-time SLO breach widens ``sync_every``
+  within ``max_sync_every`` via rebuild, the clear narrows back, and
+  the transition is an event on the run's own stream.
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu import train as train_mod
+from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+from distributed_pytorch_tpu.models import transformer as tfm
+from distributed_pytorch_tpu.parallel import autotune as at
+from distributed_pytorch_tpu.parallel import strategies as strat
+from distributed_pytorch_tpu.parallel.mesh import make_mesh
+from distributed_pytorch_tpu.train import TrainConfig, Trainer
+from distributed_pytorch_tpu.utils import debug as dbg
+from distributed_pytorch_tpu.utils import monitor, telemetry
+
+pytestmark = pytest.mark.localsgd
+
+IGNORE = -100
+
+
+def _vgg_batch(steps, global_batch, seed=7):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(
+        0, 256, (steps, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (steps, global_batch)).astype(np.int32)
+    return images, labels
+
+
+def _lm_data(b=8, s=32, vocab=256):
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, (b, s)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    targets[:, -1] = IGNORE
+    return tokens, targets
+
+
+def _tiny_lm():
+    return tfm.TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                 n_heads=2, head_dim=32, d_ff=128)
+
+
+def _assert_trees_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)), a, b)
+
+
+# -- sync_every=1 is the per-step path, bitwise -----------------------------
+
+
+def test_vgg_h1_bitwise_and_compile_parity():
+    """A sync_every=1 config (even with a relaxation ceiling armed) is
+    the existing per-step path: identical losses, params, optimizer
+    state, and compile count — the windowed builder is never entered."""
+    images, labels = _vgg_batch(3, 16)
+    mesh = make_mesh(4)
+
+    def run(**kw):
+        cfg = TrainConfig(batch_size=4, strategy="ddp", model="TINY",
+                          augment=False, **kw)
+        tr = Trainer(cfg, mesh)
+        losses = [float(tr.train_step(images[t], labels[t]))
+                  for t in range(3)]
+        return tr, losses
+
+    tr_a, losses_a = run()
+    tr_b, losses_b = run(sync_every=1, max_sync_every=4)
+    assert losses_a == losses_b
+    _assert_trees_equal(tr_a.params, tr_b.params)
+    _assert_trees_equal(tr_a.opt_state, tr_b.opt_state)
+    assert len(tr_a._compiled) == len(tr_b._compiled)
+
+
+def test_lm_h1_bitwise_and_cache_parity():
+    tokens, targets = _lm_data()
+
+    def run(**kw):
+        tr = LMTrainer(LMTrainConfig(model=_tiny_lm(), compute_dtype=None,
+                                     **kw))
+        losses = [float(tr.train_step(tokens, targets)) for _ in range(3)]
+        return tr, losses
+
+    tr_a, losses_a = run()
+    tr_b, losses_b = run(sync_every=1, max_sync_every=8)
+    assert losses_a == losses_b
+    _assert_trees_equal(tr_a.params, tr_b.params)
+    _assert_trees_equal(tr_a.opt_state, tr_b.opt_state)
+    size_a = getattr(tr_a.step_fn, "_cache_size", None)
+    size_b = getattr(tr_b.step_fn, "_cache_size", None)
+    if size_a is not None and size_b is not None:
+        assert size_a() == size_b()
+
+
+# -- the window semantics ---------------------------------------------------
+
+
+def test_plain_sgd_window_matches_accumulated_oracle():
+    """With plain SGD (momentum=0, wd=0) a sync_every=4 window equals
+    the sequential oracle: each device runs 4 ``tx.update`` steps at its
+    own drifting local params (anchor + delta), the deltas average at
+    the boundary, and the anchor advances by the mean — recomputed here
+    on the host, leaf by leaf."""
+    H, n_dev, per_dev = 4, 2, 4
+    images, labels = _vgg_batch(H, n_dev * per_dev)
+    cfg = TrainConfig(batch_size=per_dev, strategy="ddp", model="TINY",
+                      augment=False, momentum=0.0, weight_decay=0.0,
+                      sync_every=H, max_sync_every=H, steps_per_loop=H)
+    tr = Trainer(cfg, make_mesh(n_dev))
+    losses = tr.train_steps(images, labels)
+    assert np.isfinite(np.asarray(losses)).all()
+
+    from distributed_pytorch_tpu.models import vgg
+    params, state = vgg.init(tr.init_key, cfg.model)
+    tx = train_mod.make_optimizer(cfg)
+    loss_fn = partial(train_mod._loss_fn, cfg=cfg, bn_axis=None)
+    deltas = []
+    for d in range(n_dev):
+        delta = jax.tree.map(jnp.zeros_like, params)
+        opt_state = tx.init(params)
+        for t in range(H):
+            # the windowed body's RNG: fold_in(step) then fold_in(device)
+            key = jax.random.fold_in(
+                jax.random.fold_in(tr.data_key, t), d)
+            local = jax.tree.map(jnp.add, params, delta)
+            sl = slice(d * per_dev, (d + 1) * per_dev)
+            (_, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                local, state, key, jnp.asarray(images[t, sl]),
+                jnp.asarray(labels[t, sl]))
+            updates, opt_state = tx.update(g, opt_state, local)
+            delta = jax.tree.map(jnp.add, delta, updates)
+        deltas.append(delta)
+    expect = jax.tree.map(
+        lambda p, a, b: p + (a + b) / n_dev, params, *deltas)
+    # psum-of-2 + exact /2 keeps the boundary mean order-free; the only
+    # slack is compiled-vs-host grad fusion, same as the per-step oracle
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4),
+        tr.params, expect)
+
+
+def test_vgg_momentum_window_follows_per_step_curve():
+    """sync_every=4 with the default momentum SGD on the two-level
+    strategy: not an identity (momentum buffers stay local), but the
+    4-step loss curve tracks the per-step hierarchical path closely,
+    and step 0 — taken at the shared anchor before any drift — matches
+    tightly."""
+    H = 4
+    images, labels = _vgg_batch(H, 16)  # 8 replicas x 2 per device
+
+    def build(sync, spl):
+        # lr an order below the CIFAR default: at lr=0.1 four steps of
+        # TINY on random labels are chaotic enough that even the
+        # PER-STEP curve is not self-consistent run to run — the window
+        # claim is "tracks the synced path while drift is small"
+        return Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                                   model="TINY", augment=False, lr=0.01,
+                                   batch_size=2, steps_per_loop=spl,
+                                   sync_every=sync, max_sync_every=sync))
+
+    tr1 = build(1, 1)
+    losses_1 = [float(tr1.train_step(images[t], labels[t]))
+                for t in range(H)]
+    tr4 = build(H, H)
+    losses_4 = np.asarray(tr4.train_steps(images, labels))
+    np.testing.assert_allclose(losses_4[0], losses_1[0], rtol=1e-5)
+    # the local-momentum variant drifts a few percent inside a window
+    # (measured ~5% at step 3); the round-16 curve-following band
+    np.testing.assert_allclose(losses_4, losses_1, rtol=1e-1)
+
+
+def test_lm_adam_window_follows_per_step_curve():
+    tokens, targets = _lm_data()
+
+    def run(**kw):
+        tr = LMTrainer(LMTrainConfig(model=_tiny_lm(), compute_dtype=None,
+                                     dp=4, dcn_size=2, **kw))
+        return tr, [float(tr.train_step(tokens, targets))
+                    for _ in range(4)]
+
+    _, losses_1 = run()
+    _, losses_4 = run(sync_every=4, max_sync_every=4)
+    np.testing.assert_allclose(losses_4[0], losses_1[0], rtol=1e-5)
+    np.testing.assert_allclose(losses_4, losses_1, rtol=1e-2, atol=1e-2)
+
+
+def test_lm_staleness_hidden_exchange_trains():
+    """Bounded staleness (launch at kH, apply at kH+S): the delayed
+    exchange still trains — finite losses, loss goes down over two
+    full windows — and step 0 matches the S=0 window path (no exchange
+    has landed yet either way)."""
+    tokens, targets = _lm_data()
+
+    def run(**kw):
+        tr = LMTrainer(LMTrainConfig(model=_tiny_lm(), compute_dtype=None,
+                                     dp=4, dcn_size=2, sync_every=4,
+                                     max_sync_every=4, **kw))
+        return [float(tr.train_step(tokens, targets)) for _ in range(8)]
+
+    losses_s0 = run()
+    losses_s1 = run(staleness=1)
+    assert np.isfinite(losses_s1).all()
+    np.testing.assert_allclose(losses_s1[0], losses_s0[0], rtol=1e-5)
+    assert losses_s1[-1] < losses_s1[0]
+
+
+def test_vgg_train_step_refuses_unaligned_dispatch():
+    images, labels = _vgg_batch(1, 16)
+    tr = Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                             model="TINY", augment=False, batch_size=2,
+                             steps_per_loop=4, sync_every=4,
+                             max_sync_every=4))
+    with pytest.raises(ValueError, match="window-aligned"):
+        tr.train_step(images[0], labels[0])
+
+
+# -- the inspector's ~1/H dcn byte claim ------------------------------------
+
+
+def test_vgg_windowed_dcn_bytes_scale_inverse_h():
+    """The schedule claim behind the whole round: at sync_every=4 the
+    dcn-axis wire bytes per step drop to ~1/4 of the per-step path
+    (boundary-only exchange) while the per-step ici sync stays — its
+    band is loose because the exchange's own ici share amortizes."""
+    H = 4
+    images, labels = _vgg_batch(H, 16)
+
+    def axis_bytes(sync):
+        cfg = TrainConfig(strategy="hierarchical", dcn_size=2,
+                          model="TINY", augment=False, batch_size=2,
+                          steps_per_loop=H, sync_every=sync,
+                          max_sync_every=sync)
+        tr = Trainer(cfg)
+        img, lbl = tr._stage(images, labels)
+        args = tr._args(img, lbl)
+        if tr._multi_fn is None:
+            tr._multi_fn = train_mod.make_multi_step(
+                tr.cfg, tr.strategy, tr.mesh, fault_sig=tr._fault_sig)
+        return dbg.amortized_axis_bytes(
+            [(dbg.op_schedule(tr._multi_fn, *args), 1)], H)
+
+    per_step, windowed = axis_bytes(1), axis_bytes(H)
+    assert per_step["dcn"] > 0 and per_step["ici"] > 0
+    dcn_ratio = windowed["dcn"] / per_step["dcn"]
+    ici_ratio = windowed["ici"] / per_step["ici"]
+    assert 0.2 < dcn_ratio <= 0.27, (windowed, per_step)
+    assert 0.7 < ici_ratio < 1.3, (windowed, per_step)
+
+
+def test_lm_windowed_dcn_bytes_scale_inverse_h():
+    """LM side of the same claim, via the window's own program family:
+    H local-step schedules + one boundary exchange per window vs the
+    per-step two-level program."""
+    H = 4
+    tokens, targets = _lm_data()
+
+    def build(sync):
+        return LMTrainer(LMTrainConfig(model=_tiny_lm(),
+                                       compute_dtype=None, dp=8,
+                                       dcn_size=2, sync_every=sync,
+                                       max_sync_every=sync))
+
+    tr1 = build(1)
+    per_step = dbg.amortized_axis_bytes(
+        [(dbg.op_schedule(tr1.step_fn, tr1.params, tr1.opt_state,
+                          tokens, targets), 1)], 1)
+    tr4 = build(H)
+    local = dbg.op_schedule(tr4.step_fn, tr4.params, tr4._delta,
+                            tr4.opt_state, tokens, targets)
+    exchange = dbg.op_schedule(tr4._exchange_fn, tr4.params, tr4._delta)
+    windowed = dbg.amortized_axis_bytes([(local, H), (exchange, 1)], H)
+    assert per_step["dcn"] > 0
+    dcn_ratio = windowed["dcn"] / per_step["dcn"]
+    assert 0.2 < dcn_ratio <= 0.27, (windowed, per_step)
+    # every fast axis stays the same order of magnitude: the local step
+    # keeps its per-step ici reductions, the boundary exchange's share
+    # amortizes at 1/H
+    for axis, bytes_1 in per_step.items():
+        if axis == "dcn" or bytes_1 == 0:
+            continue
+        assert 0.5 < windowed.get(axis, 0.0) / bytes_1 < 1.3, (
+            axis, windowed, per_step)
+
+
+# -- the interval-aware autotuner -------------------------------------------
+
+
+def _census(total_mb: float = 37.0) -> at.GradCensus:
+    per = int(total_mb * 1024 * 1024 / 4 / 8)
+    sizes = [per, 64, per, 128, per, 256, per, 512,
+             per, 512, per, 512, per, 512, per, 10]
+    return at.GradCensus(tuple(
+        at._SizedLeaf(s, np.dtype("float32")) for s in sizes))
+
+
+@pytest.mark.quick
+def test_chooser_interval_matrix_train():
+    """The acceptance matrix: H > 1 only where the dcn hop dominates
+    AND the caller armed a ceiling; alignment divides steps_per_loop."""
+    axes = {"dcn": 2, "ici": 4}
+    census = _census()
+    wan = at.synthetic_profile("wan_dcn", axes)
+    uniform = at.synthetic_profile("uniform", axes)
+
+    plan = at.choose_train_plan(census, wan, dcn_size=2, max_sync_every=8)
+    assert plan.strategy == "hierarchical" and plan.sync_every == 8
+
+    # default ceiling (1): relaxation stays opt-in, even on a WAN hop
+    assert at.choose_train_plan(census, wan, dcn_size=2).sync_every == 1
+    # uniform links: nothing to amortize, the window stays 1
+    assert at.choose_train_plan(census, uniform, dcn_size=2,
+                                max_sync_every=8).sync_every == 1
+    # alignment: H must divide the compiled dispatch length
+    assert at.choose_train_plan(census, wan, dcn_size=2, max_sync_every=8,
+                                steps_per_loop=2).sync_every == 2
+    # the amortized figure is what competes: windowed exposed time is
+    # cheaper than the same plan's per-step figure
+    flat = at.choose_train_plan(census, wan, dcn_size=2)
+    assert plan.predicted_ms < flat.predicted_ms
+
+
+@pytest.mark.quick
+def test_chooser_interval_matrix_lm():
+    axes = {"dcn": 2, "data": 4}
+    census = _census()
+    plan = at.choose_lm_plan(census, at.synthetic_profile("wan_dcn", axes),
+                             dcn_size=2, max_sync_every=8)
+    assert plan.sync_every == 8
+    assert at.choose_lm_plan(census, at.synthetic_profile("uniform", axes),
+                             dcn_size=2, max_sync_every=8).sync_every == 1
+    assert at.choose_lm_plan(census, at.synthetic_profile("wan_dcn", axes),
+                             dcn_size=2).sync_every == 1
+
+
+@pytest.mark.quick
+def test_resolve_auto_refuses_explicit_sync_every():
+    """auto resolves the window itself: pinning sync_every alongside it
+    is ambiguous and refuses loudly on both trainers."""
+    with pytest.raises(ValueError, match="ambiguous"):
+        at.resolve_train_auto(
+            TrainConfig(strategy="auto", sync_every=2, max_sync_every=2),
+            num_devices=8)
+    with pytest.raises(ValueError, match="ambiguous"):
+        at.resolve_lm_auto(
+            LMTrainConfig(model=_tiny_lm(), sync_plan="auto",
+                          dp=4, dcn_size=2, sync_every=2,
+                          max_sync_every=2))
+
+
+def test_resolve_train_auto_carries_interval():
+    """strategy='auto' + a ceiling on a dcn-dominated profile resolves
+    to a windowed hierarchical config the Trainer can build as-is."""
+    cfg = TrainConfig(strategy="auto", autotune_profile="wan_dcn",
+                      max_sync_every=8, steps_per_loop=8)
+    resolved, plan = at.resolve_train_auto(cfg, num_devices=8)
+    assert plan.sync_every > 1
+    assert resolved.sync_every == plan.sync_every
+    assert resolved.strategy == "hierarchical"
+    assert resolved.steps_per_loop % resolved.sync_every == 0
+
+
+# -- require_sync_window: the ONE refusal site ------------------------------
+
+
+@pytest.mark.quick
+def test_require_sync_window_refusals():
+    ok = dict(sync_every=4, max_sync_every=4, mesh=True)
+    strat.require_sync_window(**ok)  # coherent window: no refusal
+    strat.require_sync_window(sync_every=1, mesh=False)  # H=1: early out
+
+    with pytest.raises(ValueError, match="sync_every must be >= 1"):
+        strat.require_sync_window(sync_every=0)
+    with pytest.raises(ValueError, match="max_sync_every must be >= 1"):
+        strat.require_sync_window(sync_every=1, max_sync_every=0)
+    with pytest.raises(ValueError, match="staleness must be >= 0"):
+        strat.require_sync_window(sync_every=4, staleness=-1)
+    with pytest.raises(ValueError, match="staleness=4 >= sync_every=4"):
+        strat.require_sync_window(sync_every=4, max_sync_every=4,
+                                  staleness=4)
+    with pytest.raises(ValueError, match="needs sync_every > 1"):
+        strat.require_sync_window(sync_every=1, max_sync_every=4,
+                                  staleness=1)
+    with pytest.raises(ValueError, match="needs a device mesh"):
+        strat.require_sync_window(**{**ok, "mesh": False})
+    with pytest.raises(ValueError, match="incompatible with pipeline"):
+        strat.require_sync_window(**ok, pp=True)
+    with pytest.raises(ValueError, match="pick one"):
+        strat.require_sync_window(**ok, grad_accum=2)
+    with pytest.raises(ValueError, match="overlap"):
+        strat.require_sync_window(**ok, overlap=True, trainer="train")
+    # the LM trainer needs a slow axis to relax; overlap is fine there
+    strat.require_sync_window(**ok, overlap=True, dcn_size=2,
+                              trainer="lm")
+    with pytest.raises(ValueError, match="dcn_size >= 2"):
+        strat.require_sync_window(**ok, dcn_size=1, trainer="lm")
+    with pytest.raises(ValueError, match="not a multiple of"):
+        strat.require_sync_window(**ok, steps_per_loop=3, trainer="train")
+
+
+def test_config_refusals_route_through_window_check():
+    """Both trainers' config validation reaches the same site: the
+    incoherent combos die at build time, not mid-compile."""
+    with pytest.raises(ValueError, match="overlap"):
+        Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                            model="TINY", overlap=True, sync_every=2,
+                            max_sync_every=2, steps_per_loop=2))
+    with pytest.raises(ValueError, match="dcn_size >= 2"):
+        LMTrainer(LMTrainConfig(model=_tiny_lm(), dp=4, sync_every=2,
+                                max_sync_every=2))
+    with pytest.raises(ValueError, match="not a multiple of"):
+        Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                            model="TINY", sync_every=4, max_sync_every=4,
+                            steps_per_loop=6))
+
+
+# -- rebuild transitions + the SLO actuator ---------------------------------
+
+
+def test_vgg_rebuild_crosses_window_modes():
+    """rebuild(sync_every=...) moves a live trainer between the per-step
+    and windowed step families in both directions; the strategy itself
+    stays pinned."""
+    H = 4
+    images, labels = _vgg_batch(H, 16)
+    tr = Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                             model="TINY", augment=False, batch_size=2,
+                             steps_per_loop=H, sync_every=1,
+                             max_sync_every=H))
+    l0 = np.asarray(tr.train_steps(images, labels))
+    tr.rebuild(sync_every=H)
+    assert tr.cfg.sync_every == H
+    l1 = np.asarray(tr.train_steps(images, labels))
+    tr.rebuild(sync_every=1)
+    l2 = np.asarray(tr.train_steps(images, labels))
+    assert np.isfinite(np.concatenate([l0, l1, l2])).all()
+    with pytest.raises(ValueError, match="not a multiple of"):
+        tr.rebuild(sync_every=3)
+    with pytest.raises(ValueError, match="fresh Trainer"):
+        tr.rebuild(strategy="ddp")
+
+
+def test_lm_rebuild_crosses_window_modes():
+    tokens, targets = _lm_data()
+    tr = LMTrainer(LMTrainConfig(model=_tiny_lm(), compute_dtype=None,
+                                 dp=4, dcn_size=2, sync_every=1,
+                                 max_sync_every=4))
+    losses = [float(tr.train_step(tokens, targets)) for _ in range(2)]
+    tr.rebuild(sync_every=4)
+    assert tr.cfg.sync_every == 4
+    losses += [float(tr.train_step(tokens, targets)) for _ in range(4)]
+    tr.rebuild(sync_every=1)
+    losses.append(float(tr.train_step(tokens, targets)))
+    assert np.isfinite(losses).all()
+
+
+def test_sync_relax_hook_widens_and_narrows(tmp_path):
+    """The straggler actuator end to end: a step-time SLO breach widens
+    sync_every (2 -> 4) through the trainer's own rebuild, training
+    continues windowed, the clear narrows back to the config base, and
+    both transitions land as request_sync_relax events on the run's
+    stream."""
+    H = 8
+    images, labels = _vgg_batch(H, 16)
+    telemetry.disable()
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="step_time", metric="step_ms", threshold=100.0, op="<=",
+        window=4, agg="mean", record="gauge", min_samples=2)])
+    try:
+        tr = Trainer(TrainConfig(strategy="hierarchical", dcn_size=2,
+                                 model="TINY", augment=False,
+                                 batch_size=2, steps_per_loop=H,
+                                 sync_every=2, max_sync_every=H))
+        monitor.SyncRelaxHook(tr).register(doctor)
+        assert doctor.attach(tel)
+        for _ in range(3):  # breach: mean over window >> threshold
+            tel.gauge("step_ms", 500.0, phase="train")
+        assert doctor.states["step_time"].breached
+        assert tr.cfg.sync_every == 4  # widened within the ceiling
+        losses = np.asarray(tr.train_steps(images, labels))
+        assert np.isfinite(losses).all()  # the widened trainer trains
+        for _ in range(6):  # flush the window back under threshold
+            tel.gauge("step_ms", 1.0, phase="train")
+        assert not doctor.states["step_time"].breached
+        assert tr.cfg.sync_every == 2  # narrowed back to the base
+    finally:
+        doctor.detach()
+        telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    relax = summary["events"]["rank0/slo/request_sync_relax"]
+    assert relax["count"] == 2

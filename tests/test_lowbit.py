@@ -292,9 +292,10 @@ class TestLMInt4Dcn:
 
 
 class TestQ8Gather:
-    """``fsdp_gather_dtype="int8"``: parameters cross the data axis as
-    int8 + per-row f32 scales and dequantize at the consumer; gradient
-    reduce-scatters stay full-precision."""
+    """``fsdp_gather_dtype="int8"`` / ``"int4"``: parameters cross the
+    data axis as int8 (or nibble-packed u8, round 18) + per-row f32
+    scales and dequantize at the consumer; gradient reduce-scatters
+    stay full-precision."""
 
     def test_moves_int8_on_the_gather_wire(self):
         """jaxpr pin: with the knob on, every WIDE all_gather carries
@@ -316,8 +317,8 @@ class TestQ8Gather:
             opt = make_optimizer(cfg).init(params)
             jaxpr = str(jax.make_jaxpr(step)(params, opt, toks, toks))
             outs = re.findall(
-                r"(?:i8|f32|bf16)\[[\d,]*\](?= = all_gather\[)", jaxpr)
-            elems = {"i8": [0], "f32": [0], "bf16": [0]}
+                r"(?:i8|u8|f32|bf16)\[[\d,]*\](?= = all_gather\[)", jaxpr)
+            elems = {"i8": [0], "u8": [0], "f32": [0], "bf16": [0]}
             for t in outs:
                 kind, inside = t.split("[")
                 n = 1
@@ -333,6 +334,14 @@ class TestQ8Gather:
         # plain path: no i8 anywhere, full-width f32
         assert f32["i8"] == 0, f32
         assert f32["f32"] == q8["i8"], (f32, q8)
+        # int4 path (round 18): the wide gathers are nibble-packed u8 —
+        # HALF the element count of the plain f32 gather (odd rows pad
+        # one nibble), a quarter of the int8 wire bytes per element pair
+        q4 = gather_elems("int4")
+        assert q4["i8"] == 0, q4
+        assert q4["f32"] <= 128, q4
+        assert f32["f32"] // 2 <= q4["u8"] <= f32["f32"] // 2 + 64, (
+            q4, f32)
 
     def test_trains_and_follows_f32_gather_curve(self):
         """The quantized-gather trajectory follows the exact-gather one
@@ -344,6 +353,9 @@ class TestQ8Gather:
                 ("exact", dict()),
                 ("q8", dict(fsdp_gather_dtype="int8")),
                 ("q8_streamed", dict(fsdp_gather_dtype="int8",
+                                     overlap=True)),
+                ("q4", dict(fsdp_gather_dtype="int4")),
+                ("q4_streamed", dict(fsdp_gather_dtype="int4",
                                      overlap=True))):
             tr = LMTrainer(LMTrainConfig(model=_lm_model(), dp=8,
                                          fsdp=True, compute_dtype=None,
@@ -354,18 +366,27 @@ class TestQ8Gather:
                                    rtol=1e-2, atol=1e-2)
         np.testing.assert_allclose(losses["q8_streamed"],
                                    losses["exact"], rtol=1e-2, atol=1e-2)
+        # 16 levels per row vs 256: int4 weight-quantization error is an
+        # order above int8's (round 18 lifts the round-16 refusal)
+        np.testing.assert_allclose(losses["q4"], losses["exact"],
+                                   rtol=2e-1, atol=2e-1)
+        np.testing.assert_allclose(losses["q4_streamed"],
+                                   losses["exact"], rtol=2e-1, atol=2e-1)
 
     def test_refusals(self):
         """The knob needs fsdp (there is no gather to quantize without
-        it) and rejects dtypes the wire format doesn't speak."""
+        it) and rejects dtypes the wire format doesn't speak; int4 is
+        a valid format since round 18."""
         from distributed_pytorch_tpu.lm import validate_lm_cfg
         with pytest.raises(ValueError, match="fsdp"):
             validate_lm_cfg(LMTrainConfig(model=_lm_model(), dp=8,
                                           fsdp_gather_dtype="int8"))
-        with pytest.raises(ValueError, match="int8"):
+        with pytest.raises(ValueError, match="fp8"):
             validate_lm_cfg(LMTrainConfig(model=_lm_model(), dp=8,
                                           fsdp=True,
-                                          fsdp_gather_dtype="int4"))
+                                          fsdp_gather_dtype="fp8"))
+        validate_lm_cfg(LMTrainConfig(model=_lm_model(), dp=8, fsdp=True,
+                                      fsdp_gather_dtype="int4"))
 
 
 # -- int8 matmul compute path -----------------------------------------------
