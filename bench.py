@@ -1401,6 +1401,112 @@ def bench_serve_fleet(reps: int = 3, kv_dtype: str | None = None) -> dict:
             "handoffs": hand["handoffs"]}
 
 
+def canon_fleet_transport_env(value: str | None) -> bool:
+    """Validate the BENCH_FLEET_TRANSPORT knob: '1' runs the round-19
+    multi-process transport gate (2 unix-socket daemons probed for RPC
+    overhead + an in-process autoscaler pressure->spawn / idle->drain
+    cycle), unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_FLEET_TRANSPORT", value, default=False,
+        guess="whether to run the multi-process transport gate")
+
+
+def bench_fleet_transport(probes: int = 50) -> dict:
+    """Multi-process transport gate (round 19, BENCH_FLEET_TRANSPORT=1).
+
+    1. **RPC overhead** — spawn a 2-daemon unix-socket fleet (small
+       model; the daemons are forced to CPU since two processes cannot
+       share one TPU) and serve a short workload through the crc-framed
+       RPC, then probe ``heartbeat`` round-trips ->
+       ``fleet_rpc_overhead_ms`` (median of ``probes``): the per-call
+       socket+framing tax scripts/bench_compare.py gates.
+    2. **autoscale reaction** — an in-process single-replica fleet under
+       queue pressure: the ``FleetAutoscaler`` must spawn a second
+       replica, then drain it back once idle ->
+       ``fleet_autoscale_events`` (event count; the spawn->drain pair
+       proves both directions) plus the measured reaction ticks for
+       BASELINE.md."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+    import bench_serving as bs
+    import jax
+
+    from distributed_pytorch_tpu.fleet import (BatcherReplica,
+                                               FleetAutoscaler, FleetRouter,
+                                               make_socket_fleet)
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.serve import ContinuousBatcher
+
+    cfg_kw = dict(vocab_size=256, d_model=128, n_layers=2, n_heads=4,
+                  head_dim=32, n_kv_heads=2, d_ff=256)
+    batcher = dict(slots=2, max_len=512, temperature=0.0,
+                   prompt_buckets=[32], steps_per_sync=4, paged=True)
+    spec = {"cfg": cfg_kw, "seed": 0, "batcher": batcher}
+    # fresh processes see neither the parent's backend pin nor its
+    # code-set compile cache — hand both over via env
+    env = {"JAX_PLATFORMS": "cpu",
+           "JAX_COMPILATION_CACHE_DIR": os.path.join(
+               os.path.dirname(__file__), "tests", ".jax_cache"),
+           "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5"}
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 255, size=int(s)).astype(np.int32)
+               for s in rng.integers(5, 17, size=6)]
+    budgets = [8] * len(prompts)
+
+    fleet = make_socket_fleet(spec, 2, transport="unix", env=env)
+    try:
+        served = bs.run_fleet(fleet, prompts, budgets)
+        overhead = bs.rpc_overhead_ms(fleet, probes=probes)
+        reps = list(fleet.replicas.values())
+        calls = sum(r.client.stats["calls"] for r in reps)
+        retries = sum(r.client.stats["retries"] for r in reps)
+    finally:
+        fleet.close()
+
+    # autoscale leg: in-process (reaction logic is transport-agnostic
+    # and the socket leg above already priced the RPC edge)
+    cfg = tfm.TransformerConfig(**cfg_kw)
+    params = tfm.init(jax.random.key(0), cfg)
+
+    def make():
+        return ContinuousBatcher(params, cfg,
+                                 **{**batcher, "prompt_buckets": (32,)})
+
+    router = FleetRouter([BatcherReplica(0, make)])
+    sc = FleetAutoscaler(router, lambda: BatcherReplica(1, make),
+                         min_replicas=1, max_replicas=2, grow_after=2,
+                         shrink_after=3, queue_high=1)
+    try:
+        for p in prompts + prompts:
+            router.submit(p, 8)
+        for _ in range(600):
+            router.step()
+            sc.tick()
+            if not router.pending() and sc.stats["drained"]:
+                break
+        while router.pending():
+            router.step()
+    finally:
+        router.close()
+    actions = [e["action"] for e in sc.events]
+    if actions[:1] != ["spawn"] or "drain" not in actions:
+        raise RuntimeError(
+            f"autoscaler failed to complete a spawn->drain cycle under "
+            f"queue pressure (events: {actions})")
+    _log(f"[bench] fleet transport: rpc overhead {overhead:.3f} ms "
+         f"median over {probes} probes ({calls} calls, {retries} "
+         f"retries, {served['tok_per_s']:.1f} tok/s served over unix "
+         f"sockets); autoscaler {actions} in "
+         f"{sc.stats['reaction_ticks']} reaction ticks")
+    return {"rpc_overhead_ms": overhead, "rpc_calls": calls,
+            "rpc_retries": retries, "tok_per_s": served["tok_per_s"],
+            "autoscale_events": len(sc.events),
+            "autoscale_actions": actions,
+            "autoscale_reaction_ticks": sc.stats["reaction_ticks"]}
+
+
 # Reference-semantics torch-CPU throughput: fallback constant for when torch
 # is unavailable, measured with the windowed metric below (BASELINE.md
 # records the methodology and the live-host measurement).
@@ -1518,6 +1624,11 @@ def main() -> None:
     # BENCH_FLEET=1 runs the routed-throughput + disaggregated-handoff
     # passes over a 2-replica fleet.
     run_fleet = canon_fleet_env(os.environ.get("BENCH_FLEET"))
+    # Multi-process transport knob (round 19), validated loudly
+    # pre-bench: BENCH_FLEET_TRANSPORT=1 prices the socket RPC edge and
+    # proves an autoscaler spawn->drain cycle.
+    run_fleet_transport = canon_fleet_transport_env(
+        os.environ.get("BENCH_FLEET_TRANSPORT"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # iters=300 keeps the single end-of-window fetch RTT (60-130 ms through
     # the tunnel) under ~15% of the window even before the min-of-2;
@@ -1635,6 +1746,15 @@ def main() -> None:
             fleet_ab = bench_serve_fleet(kv_dtype=kv_dtype)
         except Exception as e:
             _log(f"[bench] serving-fleet gate failed ({e}); omitting")
+
+    # Multi-process transport gate (round 19): socket-fleet RPC
+    # overhead + autoscaler reaction; optional like the other gates.
+    transport_ab = None
+    if run_fleet_transport:
+        try:
+            transport_ab = bench_fleet_transport()
+        except Exception as e:
+            _log(f"[bench] fleet-transport gate failed ({e}); omitting")
 
     # Transformer-stack gates (VERDICT round-3 #3): the LM train step,
     # warm decode, and continuous-batching serving were previously only
@@ -1848,6 +1968,15 @@ def main() -> None:
                                   if fleet_ab is not None else None),
         "fleet_handoff_ms": (round(fleet_ab["handoff_ms"], 3)
                              if fleet_ab is not None else None),
+        # multi-process transport gate (round 19,
+        # BENCH_FLEET_TRANSPORT=1): median heartbeat round-trip over
+        # the crc-framed unix-socket RPC (the per-call tax
+        # bench_compare gates) and the autoscaler's completed event
+        # count (a spawn->drain cycle = 2).  Null when skipped.
+        "fleet_rpc_overhead_ms": (round(transport_ab["rpc_overhead_ms"], 4)
+                                  if transport_ab is not None else None),
+        "fleet_autoscale_events": (transport_ab["autoscale_events"]
+                                   if transport_ab is not None else None),
     }), flush=True)
 
 

@@ -14,11 +14,26 @@ disaggregation, graceful drain, loss rescue) without recompute.
         for gid, tok in fleet.step():
             ...
     out = fleet.result(gid)
+
+Round 19 moves replicas OUT of the process: ``make_socket_fleet``
+spawns each as its own daemon (fleet/daemon.py) speaking the crc-framed
+fault-injected RPC of fleet/transport.py, and ``FleetAutoscaler``
+grows/shrinks the fleet with traffic.  The router surface is
+identical — ``RemoteReplica`` duck-types ``BatcherReplica``.
 """
 
+from .daemon import (FleetAutoscaler, RemoteReplica, ReplicaProcess,
+                     make_socket_fleet, spawn_replica)
 from .handoff import KVHandoff
 from .replica import ROLES, BatcherReplica
 from .router import FleetRouter, make_fleet
+from .transport import (BOUNDARIES, FrameCorrupt, PeerQuarantined,
+                        RpcClient, RpcDeadline, RpcRemoteError,
+                        RpcServer, TornFrame, TransportError)
 
 __all__ = ["KVHandoff", "BatcherReplica", "FleetRouter", "make_fleet",
-           "ROLES"]
+           "ROLES", "make_socket_fleet", "spawn_replica",
+           "FleetAutoscaler", "RemoteReplica", "ReplicaProcess",
+           "RpcClient", "RpcServer", "TransportError", "TornFrame",
+           "FrameCorrupt", "RpcDeadline", "PeerQuarantined",
+           "RpcRemoteError", "BOUNDARIES"]

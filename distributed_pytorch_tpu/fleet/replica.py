@@ -114,6 +114,11 @@ class BatcherReplica:
             return frozenset()
         return frozenset(self.cb.registry)
 
+    def queue_depth(self) -> int:
+        """Backlog (queued + mid-admission) — the autoscaler's queue-
+        growth signal, served over the socket in every poll reply."""
+        return self.cb.queue_depth()
+
     def pending(self) -> bool:
         return self.alive and self.cb.pending()
 

@@ -21,18 +21,23 @@ with the remaining budget on a surviving replica — either way the
 reassembled stream is token-exact, with zero lost or duplicated tokens.
 
 The router is single-threaded by design: ``step()`` polls every live
-replica once.  It is a scheduling layer, not a transport — replicas
-share the process here; ``KVHandoff.to_bytes`` is the wire format for
-when they stop doing so.
+replica once.  It is a scheduling layer, not a transport: replicas may
+share the process (``BatcherReplica``) or live behind a socket
+(fleet/daemon.py ``RemoteReplica`` duck-types the same surface, with
+``KVHandoff.to_bytes`` as the wire payload) — the router cannot tell.
+Liveness is judged by the shared launch.py heartbeat helpers: a
+replica that never beat is "cold" (still warming) unless its PID is
+provably dead, so cross-process cold starts and in-process warmups get
+the same grace.
 """
 
 from __future__ import annotations
 
-import json
 import time
 
 import numpy as np
 
+from ..launch import heartbeat_verdict, read_heartbeat
 from ..serve import prefix_page_hashes
 from ..utils import monitor, telemetry
 from .handoff import KVHandoff
@@ -229,16 +234,52 @@ class FleetRouter:
                 f"pool cannot be re-admitted")
         rep.accepting = True
 
+    # -- membership (the autoscaler's levers) ------------------------------
+    def add_replica(self, rep: BatcherReplica) -> None:
+        """Scale up: wire a new replica into rotation.  Ids must be
+        fresh — a dead replica's id stays tombstoned so the newcomer's
+        streams can never be confused with the casualty's."""
+        if rep.replica_id in self.replicas:
+            raise ValueError(
+                f"replica id {rep.replica_id} already exists")
+        self.replicas[rep.replica_id] = rep
+        if self.tel is not None:
+            self.tel.event("replica_added", phase="fleet",
+                           replica=rep.replica_id, role=rep.role)
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Forget a replica entirely (autoscaler shrink).  A live one
+        must be drained first; a dead one is rescued first so removal
+        can never strand orphans."""
+        rep = self.replicas[replica_id]
+        if rep.alive:
+            bound = [g for g, s in self._streams.items()
+                     if not s["done"] and s["replica"] == replica_id]
+            if rep.accepting or bound:
+                raise RuntimeError(
+                    f"replica {replica_id} still accepts or holds "
+                    f"{len(bound)} live request(s) — drain it first")
+        else:
+            self._rescue(rep)  # no-op if already rescued
+        del self.replicas[replica_id]
+        rep.close()
+        if self.tel is not None:
+            self.tel.event("replica_removed", phase="fleet",
+                           replica=replica_id)
+
     def _hb_stale(self, rep: BatcherReplica) -> bool:
-        if (self.hb_stale_s is None or rep.heartbeat is None
-                or rep._tick == 0):
-            return False  # silence before the first beat = still warming
-        try:
-            with open(rep.heartbeat.path) as f:
-                beat = json.load(f)
-            return time.time() - beat["time"] > self.hb_stale_s
-        except (OSError, ValueError, KeyError):
-            return False  # a missed beat is late detection, not a death
+        """Heartbeat verdict via the SAME helper the elastic agent uses
+        (launch.heartbeat_verdict): "cold" (never beat, process — if
+        there is one — still up) is warming, not death; "lost" (never
+        beat AND the PID is gone) and "stale" (beat, then went silent)
+        both kill.  In-process replicas have no pid, so they can only
+        ever be cold or stale — the old ``_tick == 0`` grace, kept."""
+        if self.hb_stale_s is None or rep.heartbeat is None:
+            return False
+        verdict = heartbeat_verdict(
+            read_heartbeat(rep.heartbeat.path),
+            stale_s=self.hb_stale_s, pid=getattr(rep, "pid", None))
+        return verdict in ("stale", "lost")
 
     def _rescue(self, rep: BatcherReplica) -> None:
         """A replica died with its pool: re-prefill every orphaned

@@ -1,0 +1,460 @@
+"""Socket RPC for the multi-process fleet: crc-framed, deadline-bound,
+idempotent under retry.
+
+The fleet (round 14) proved token-exact handoff and rescue with every
+replica in ONE process; this module is the wire that lets them stop
+sharing it.  The design target is not speed but *production failure
+semantics on every RPC edge*:
+
+- **Framing.**  Every message rides one frame::
+
+      magic  2B   b"KF"
+      length 4B   big-endian payload byte count
+      payload     JSON head line + concatenated binary blobs
+      crc    4B   big-endian zlib.crc32(payload)
+
+  A stream cut mid-frame is detected as a ``TornFrame`` naming the
+  boundary class it died at (``header`` / ``payload`` / ``crc``); a
+  frame whose crc disagrees is ``FrameCorrupt``.  Either one means the
+  peer's write path can no longer be trusted — the client QUARANTINES
+  it immediately (no retry: a half-written frame is a crashed or
+  corrupting peer, and replaying against it risks split-brain), and the
+  router rescues through the same replica-loss path a dead process
+  takes.  A clean close BETWEEN frames is an ordinary connection error
+  and retries.
+
+- **Payload.**  The head is one JSON dict; binary blobs (``KVHandoff
+  .to_bytes`` archives — the wire format fleet/handoff.py promised,
+  reused verbatim) follow it, with lengths declared in the head's
+  ``blob_lens`` so int8 pages never round-trip through JSON.
+
+- **Deadlines + backoff.**  Every call has a per-call deadline; on
+  timeout / refused / reset the client re-dials with exponential
+  backoff and seeded per-(replica, attempt) jitter — THE rendezvous
+  backoff (parallel/init.py ``_backoff_delay``, imported, not copied),
+  at a socket-local base/cap.  The retry budget exhausted is
+  ``RpcDeadline`` and the peer is quarantined.
+
+- **Idempotent retry.**  Every call carries a globally-unique request
+  key; the server keeps a bounded key -> reply cache and answers a
+  replayed key from it WITHOUT re-executing the handler.  That makes
+  every op — including ``poll``, which drains tokens — exactly-once
+  under the ambiguity a timeout leaves ("did it execute?"): the retry
+  returns the original reply, no token lost or duplicated.
+
+- **Chaos.**  The server consults ``utils/faults.maybe_rpc_fault`` once
+  per served call: ``rpc_slow`` sleeps before replying (the deadline
+  path), ``rpc_drop`` kills the endpoint mid-call (``on_drop="exit"``
+  hard-exits the daemon process — a real death; ``"close"`` kills the
+  listener only, for in-thread test servers), ``rpc_torn`` sends the
+  reply truncated at the planned boundary class and cuts the
+  connection.  Deterministic plans (``FAULT_PLAN`` crosses the daemon's
+  process boundary) drive every degradation path in tests.
+
+fleet/daemon.py builds the replica-facing endpoint on top; this module
+knows nothing about batchers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+
+from ..parallel.init import _backoff_delay
+from ..utils import faults
+
+MAGIC = b"KF"
+_HEADER = struct.Struct(">2sI")   # magic + payload length
+_CRC = struct.Struct(">I")
+MAX_FRAME = 1 << 31               # sanity bound on a declared length
+
+# frame boundary classes a truncation can land in (rpc_torn's ``mode``)
+BOUNDARIES = ("header", "payload", "crc")
+
+# client retry budget: small base, tight cap — fleet RPCs are local
+# sockets, not a WAN rendezvous; the jitter formula matches
+# parallel/init.py (seeded, decorrelated per (replica, attempt))
+RPC_ATTEMPTS = 4
+RPC_BACKOFF_BASE_S = 0.05
+RPC_BACKOFF_CAP_S = 1.0
+RPC_DEADLINE_S = 10.0
+DEDUP_CACHE = 128                 # replayed-key replies the server holds
+
+
+class TransportError(RuntimeError):
+    """Base of every fleet-transport failure."""
+
+
+class TornFrame(TransportError):
+    """The stream ended mid-frame.  ``boundary`` names the class the
+    cut landed in: ``header`` (< 6 bytes of magic+length), ``payload``
+    (fewer bytes than the header declared), ``crc`` (< 4 trailer
+    bytes)."""
+
+    def __init__(self, boundary: str, got: int, want: int):
+        super().__init__(f"torn frame at {boundary} boundary "
+                         f"({got}/{want} bytes)")
+        self.boundary = boundary
+
+
+class FrameCorrupt(TransportError):
+    """A whole frame whose bytes cannot be trusted: bad magic, an
+    absurd declared length, or a crc mismatch."""
+
+
+class RpcDeadline(TransportError):
+    """The per-call deadline survived every retry attempt."""
+
+
+class PeerQuarantined(TransportError):
+    """The client has written this peer off (torn/corrupt frame, or
+    deadline exhaustion); no further calls will be attempted."""
+
+
+class RpcRemoteError(TransportError):
+    """The handler raised on the peer; the error text traveled back in
+    a well-formed frame (the peer itself is healthy)."""
+
+
+# ---------------------------------------------------------------------------
+# framing
+
+def encode_frame(payload: bytes) -> bytes:
+    return (_HEADER.pack(MAGIC, len(payload)) + payload
+            + _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF))
+
+
+def read_frame(rfile) -> bytes:
+    """Read one frame off a blocking binary stream; returns the payload.
+
+    Raises ``ConnectionError`` on a clean close BETWEEN frames (zero
+    bytes where a header should start — an ordinary drop, retryable),
+    ``TornFrame`` when the stream dies INSIDE a frame, ``FrameCorrupt``
+    when the frame arrived whole but wrong."""
+    head = rfile.read(_HEADER.size)
+    if not head:
+        raise ConnectionError("peer closed between frames")
+    if len(head) < _HEADER.size:
+        raise TornFrame("header", len(head), _HEADER.size)
+    magic, length = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise FrameCorrupt(f"bad magic {magic!r}")
+    if length > MAX_FRAME:
+        raise FrameCorrupt(f"absurd frame length {length}")
+    payload = rfile.read(length)
+    if len(payload) < length:
+        raise TornFrame("payload", len(payload), length)
+    crc = rfile.read(_CRC.size)
+    if len(crc) < _CRC.size:
+        raise TornFrame("crc", len(crc), _CRC.size)
+    if _CRC.unpack(crc)[0] != (zlib.crc32(payload) & 0xFFFFFFFF):
+        raise FrameCorrupt("crc mismatch")
+    return payload
+
+
+def truncate_frame(frame: bytes, boundary: str) -> bytes:
+    """Cut a whole frame at a boundary class — the torn-write simulator
+    (``rpc_torn`` chaos, and the framing tests' partial-write matrix).
+    The cut point is chosen so ``read_frame`` classifies the tear at
+    exactly ``boundary``."""
+    if boundary not in BOUNDARIES:
+        raise ValueError(f"boundary {boundary!r} not in {BOUNDARIES}")
+    _, length = _HEADER.unpack(frame[:_HEADER.size])
+    if boundary == "header":
+        return frame[:_HEADER.size - 3]
+    if boundary == "payload":
+        return frame[:_HEADER.size + length // 2]
+    return frame[:-2]  # half the crc trailer
+
+
+# ---------------------------------------------------------------------------
+# messages: JSON head + binary blobs
+
+def encode_msg(head: dict, blobs: list[bytes] = ()) -> bytes:
+    head = dict(head)
+    head["blob_lens"] = [len(b) for b in blobs]
+    return (json.dumps(head).encode() + b"\n" + b"".join(blobs))
+
+
+def decode_msg(payload: bytes) -> tuple[dict, list[bytes]]:
+    nl = payload.index(b"\n")
+    head = json.loads(payload[:nl])
+    rest = payload[nl + 1:]
+    blobs, off = [], 0
+    for n in head.pop("blob_lens", []):
+        blobs.append(rest[off:off + n])
+        off += n
+    return head, blobs
+
+
+# ---------------------------------------------------------------------------
+# addresses: ("unix", path) | ("tcp", (host, port))
+
+def parse_address(spec: str) -> tuple:
+    """``unix:/path/to.sock`` or ``tcp:host:port`` -> address tuple."""
+    kind, _, rest = spec.partition(":")
+    if kind == "unix" and rest:
+        return ("unix", rest)
+    if kind == "tcp":
+        host, _, port = rest.rpartition(":")
+        if host and port:
+            return ("tcp", (host, int(port)))
+    raise ValueError(f"bad address {spec!r} (unix:/path | tcp:host:port)")
+
+
+def format_address(address: tuple) -> str:
+    if address[0] == "unix":
+        return f"unix:{address[1]}"
+    host, port = address[1]
+    return f"tcp:{host}:{port}"
+
+
+def _dial(address: tuple, timeout: float) -> socket.socket:
+    if address[0] == "unix":
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(address[1])
+        return s
+    return socket.create_connection(address[1], timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# server
+
+class RpcServer:
+    """Serve ``handler(head, blobs) -> (head, blobs)`` over one
+    listening socket, one thread per connection, frames as above.
+
+    ``replica_id`` scopes chaos plans (``faults.maybe_rpc_fault``);
+    ``on_drop`` picks what an ``rpc_drop`` plan does — ``"exit"``
+    hard-exits the process (the daemon: a real death, connections die
+    with it) or ``"close"`` kills the listener and connection only
+    (in-thread test servers must not take pytest down with them).
+
+    Replayed request keys (the client's idempotent retry) answer from a
+    bounded reply cache without re-executing the handler."""
+
+    def __init__(self, address: tuple, handler, *, replica_id: int = 0,
+                 on_drop: str = "close"):
+        if on_drop not in ("exit", "close"):
+            raise ValueError(f"on_drop {on_drop!r}: 'exit' | 'close'")
+        self.handler = handler
+        self.replica_id = replica_id
+        self.on_drop = on_drop
+        self._lock = threading.Lock()
+        self._calls = 0
+        self._dedup: OrderedDict[str, bytes] = OrderedDict()
+        self.closed = False
+        if address[0] == "unix":
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(address[1])
+            self._sock.listen()
+            self.address = address
+        else:
+            host, port = address[1]
+            self._sock = socket.create_server((host, port))
+            self.address = ("tcp", self._sock.getsockname()[:2])
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:  # closed
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        try:
+            while not self.closed:
+                try:
+                    payload = read_frame(rfile)
+                except (ConnectionError, TransportError, OSError):
+                    return  # client went away / stream unusable
+                with self._lock:
+                    self._calls += 1
+                    call = self._calls
+                head, blobs = decode_msg(payload)
+                plan = faults.maybe_rpc_fault(self.replica_id, call,
+                                              head.get("op"))
+                if plan is not None and plan.kind == "rpc_slow":
+                    time.sleep(plan.delay_s)
+                if plan is not None and plan.kind == "rpc_drop":
+                    # a real death: the op NEVER executes, the client's
+                    # retries find a dead endpoint, quarantine follows
+                    if self.on_drop == "exit":
+                        os._exit(faults.FAULT_EXIT_CODE)
+                    self.close()
+                    return
+                reply = self._reply_bytes(head, blobs)
+                if plan is not None and plan.kind == "rpc_torn":
+                    # a partial write cut by a crash: ship the planned
+                    # prefix, then cut the stream mid-frame
+                    try:
+                        conn.sendall(truncate_frame(
+                            encode_frame(reply), plan.mode))
+                    except OSError:
+                        pass
+                    return
+                try:
+                    conn.sendall(encode_frame(reply))
+                except OSError:
+                    return
+        finally:
+            try:
+                rfile.close()
+                conn.close()
+            except OSError:
+                pass
+
+    def _reply_bytes(self, head: dict, blobs: list[bytes]) -> bytes:
+        key = head.get("key")
+        # dedup check + handler + cache store are ONE critical section:
+        # the handler wraps a single-threaded batcher (never safe to
+        # enter concurrently), and a retry racing its own slow original
+        # must block here and then answer from the cache — otherwise
+        # the op runs twice and poll's drained tokens are lost
+        with self._lock:
+            if key is not None and key in self._dedup:
+                return self._dedup[key]  # replayed key: don't re-execute
+            try:
+                rhead, rblobs = self.handler(head, blobs)
+            except Exception as e:  # handler bugs travel back as errors
+                rhead, rblobs = {"err": f"{type(e).__name__}: {e}"}, []
+            reply = encode_msg(rhead, rblobs)
+            if key is not None and "err" not in rhead:
+                self._dedup[key] = reply
+                while len(self._dedup) > DEDUP_CACHE:
+                    self._dedup.popitem(last=False)
+            return reply
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.address[0] == "unix":
+            try:
+                os.unlink(self.address[1])
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# client
+
+class RpcClient:
+    """One peer's calling side: persistent connection, per-call
+    deadline, exponential-backoff retry under a stable request key,
+    quarantine on framing damage or budget exhaustion.
+
+    After quarantine every call raises ``PeerQuarantined`` without
+    touching the socket; ``reason`` records why (the transport
+    postmortem's detail)."""
+
+    def __init__(self, address: tuple, *, replica_id: int = 0,
+                 deadline_s: float = RPC_DEADLINE_S,
+                 attempts: int = RPC_ATTEMPTS,
+                 backoff_base_s: float = RPC_BACKOFF_BASE_S,
+                 backoff_cap_s: float = RPC_BACKOFF_CAP_S):
+        self.address = address
+        self.replica_id = replica_id
+        self.deadline_s = deadline_s
+        self.attempts = attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.quarantined = False
+        self.reason: str | None = None
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._next_key = 0
+        # accounting for the bench's rpc-overhead figure
+        self.stats = {"calls": 0, "retries": 0, "rpc_ms": 0.0}
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self) -> None:
+        if self._sock is None:
+            self._sock = _dial(self.address, self.deadline_s)
+            self._sock.settimeout(self.deadline_s)
+            self._rfile = self._sock.makefile("rb")
+
+    def _drop(self) -> None:
+        for obj in (self._rfile, self._sock):
+            try:
+                if obj is not None:
+                    obj.close()
+            except OSError:
+                pass
+        self._sock = self._rfile = None
+
+    def _quarantine(self, reason: str) -> None:
+        self.quarantined = True
+        self.reason = reason
+        self._drop()
+        raise PeerQuarantined(
+            f"replica {self.replica_id} quarantined: {reason}")
+
+    # -- calls -----------------------------------------------------------
+    def call(self, op: str, head: dict | None = None,
+             blobs: list[bytes] = (), *,
+             deadline_s: float | None = None) -> tuple[dict, list[bytes]]:
+        """One RPC round-trip; returns (reply head, reply blobs).
+
+        The request key is fixed BEFORE the first attempt, so every
+        retry replays the same key and the server's dedup cache makes
+        re-execution impossible — the answer to "did the timed-out call
+        run?" is always "exactly once"."""
+        if self.quarantined:
+            raise PeerQuarantined(
+                f"replica {self.replica_id} is quarantined "
+                f"({self.reason})")
+        deadline_s = (self.deadline_s if deadline_s is None
+                      else deadline_s)
+        msg = dict(head or {})
+        msg["op"] = op
+        msg["key"] = f"{self.replica_id}:{self._next_key}"
+        self._next_key += 1
+        payload = encode_msg(msg, list(blobs))
+        last: Exception | None = None
+        for attempt in range(self.attempts):
+            if attempt:
+                self.stats["retries"] += 1
+                time.sleep(_backoff_delay(attempt, self.replica_id,
+                                          base_s=self.backoff_base_s,
+                                          cap_s=self.backoff_cap_s))
+            t0 = time.perf_counter()
+            try:
+                self._connect()
+                self._sock.settimeout(deadline_s)
+                self._sock.sendall(encode_frame(payload))
+                reply = read_frame(self._rfile)
+            except (TornFrame, FrameCorrupt) as e:
+                # framing damage: the peer's write path is lying —
+                # no retry, straight to quarantine
+                self._quarantine(f"{type(e).__name__}: {e}")
+            except (socket.timeout, ConnectionError, OSError,
+                    ValueError) as e:
+                self._drop()
+                last = e
+                continue
+            rhead, rblobs = decode_msg(reply)
+            if "err" in rhead:
+                raise RpcRemoteError(rhead["err"])
+            self.stats["calls"] += 1
+            self.stats["rpc_ms"] += (time.perf_counter() - t0) * 1e3
+            return rhead, rblobs
+        self._quarantine(
+            f"RpcDeadline: {self.attempts} attempts x {deadline_s}s "
+            f"exhausted ({type(last).__name__ if last else '?'}: {last})")
+
+    def close(self) -> None:
+        self._drop()

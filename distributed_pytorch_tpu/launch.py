@@ -128,12 +128,92 @@ RPC_TIMEOUT_S = 5.0         # status/fail round-trip budget
 CONNECT_RETRY_S = 60.0      # waiting for the node-0 coordinator to come up
 
 
+# ---------------------------------------------------------------------------
+# heartbeat reading + liveness verdicts: ONE copy, shared by the elastic
+# agent below and the serving fleet's router (fleet/router.py).  Both
+# supervise members that publish atomic hb_rank<R>.json beacons
+# (parallel/elastic.Heartbeat, fleet/replica.BatcherReplica), and both
+# need the same judgment call: a member that has NEVER beaten is a cold
+# start (long compile) judged by PID liveness alone, never by silence.
+
+def heartbeat_path(run_dir: str, rank: int) -> str:
+    """Where member ``rank``'s beacon lands (the Heartbeat contract)."""
+    return os.path.join(run_dir, f"{HEARTBEAT_PREFIX}{rank}.json")
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """One atomically-published beacon: {rank, step, gen, time, age_s};
+    None for missing/torn/half-typed files (beats are tmp+rename, so
+    the next one lands whole — a missed read is late detection, not a
+    death).  ``time`` is informational and optional — age is judged
+    from the file's mtime, so beacons that publish only
+    {rank, step, gen} stay supervisable."""
+    try:
+        with open(path) as f:
+            hb = json.load(f)
+        mtime = os.path.getmtime(path)
+        return {"rank": int(hb["rank"]), "step": int(hb["step"]),
+                "gen": int(hb["gen"]), "time": float(hb.get("time", mtime)),
+                "age_s": time.time() - mtime}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def pid_alive(pid: int | None) -> bool:
+    """POSIX existence probe (signal 0).  Permission errors mean the
+    process exists; no pid to probe reads as dead."""
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+def heartbeat_verdict(hb: dict | None, *, stale_s: float,
+                      gen: int | None = None,
+                      pid: int | None = None) -> str:
+    """Classify one member from its newest beat (``read_heartbeat``):
+
+    - ``"cold"`` — never beaten (in generation ``gen``, when given):
+      still compiling / still spawning.  Silence before the first beat
+      must NEVER read as a hang;
+    - ``"lost"`` — cold AND the given ``pid`` is gone: the process died
+      before it ever beat (the only judgment PID liveness may make);
+    - ``"fresh"`` — newest beat younger than ``stale_s``;
+    - ``"stale"`` — beaten, then silent past ``stale_s``: a HUNG member
+      (wedged collective, live PID), the case PID polling cannot see.
+    """
+    if hb is None or (gen is not None and hb["gen"] != gen):
+        return ("lost" if pid is not None and not pid_alive(pid)
+                else "cold")
+    return "stale" if hb["age_s"] > stale_s else "fresh"
+
+
 class _Coordinator:
     """Generation rendezvous service hosted by the node-0 agent.
 
+    The barrier counts CHANGING membership (round 19, the carried
+    elastic half): it releases a generation when every CURRENT member
+    has arrived — not a fixed ``nnodes`` — so ``join``/``leave`` let
+    the gang grow/shrink between generations without a fixed-size
+    rendezvous.  A ``leave`` during a wait re-evaluates the barrier
+    (the departed node must not wedge survivors), and barrier replies
+    carry the membership the generation rendezvoused at, so arrivals
+    spawn at the CURRENT world size.  With membership never touched,
+    every condition degrades to the fixed-``nnodes`` behavior.
+
     One JSON message per TCP connection:
-      {"op": "barrier", "node": R, "gen": G} -> blocks until all nnodes
-          agents arrive at generation G (or abort) -> {"ok": bool, "abort"}
+      {"op": "barrier", "node": R, "gen": G} -> blocks until every
+          current member arrives at generation G (or abort) ->
+          {"ok": bool, "abort", "world_size", "members"}
+      {"op": "join", "node": R}              -> R becomes a member from
+          the next barrier on -> {"ok", "world_size", "members"}
+      {"op": "leave", "node": R}             -> R stops being counted
+          (and stops blocking any in-flight barrier) -> same reply
       {"op": "fail", "gen": G, "code": C}    -> records G as failed
       {"op": "status", "gen": G}             -> {"failed", "code", "abort"}
       {"op": "done", "node": R}              -> node R is finished (its own
@@ -145,6 +225,7 @@ class _Coordinator:
 
     def __init__(self, nnodes: int, port: int):
         self.nnodes = nnodes
+        self.members: set[int] = set(range(nnodes))
         self.cond = threading.Condition()
         self.arrived: dict[int, set[int]] = {}
         self.failed: dict[int, int] = {}
@@ -153,6 +234,11 @@ class _Coordinator:
         self.finished: set[int] = set()
         self.srv = socket.create_server(("0.0.0.0", port))
         threading.Thread(target=self._serve, daemon=True).start()
+
+    def _membership(self) -> dict:
+        # callers hold self.cond
+        return {"world_size": len(self.members),
+                "members": sorted(self.members)}
 
     def _serve(self) -> None:
         while True:
@@ -178,14 +264,26 @@ class _Coordinator:
                     with self.cond:
                         self.arrived.setdefault(gen, set()).add(msg["node"])
                         self.cond.notify_all()
+                        # every CURRENT member present (membership may
+                        # shrink mid-wait — re-evaluated on notify)
                         ok = self.cond.wait_for(
-                            lambda: (len(self.arrived.get(gen, ()))
-                                     >= self.nnodes or self.abort
-                                     or self.done),
+                            lambda: (self.members
+                                     <= self.arrived.get(gen, set())
+                                     or self.abort or self.done),
                             timeout=BARRIER_TIMEOUT_S)
-                    reply = {"ok": (bool(ok) and not self.abort
-                                    and not self.done),
-                             "abort": self.abort}
+                        reply = {"ok": (bool(ok) and not self.abort
+                                        and not self.done),
+                                 "abort": self.abort,
+                                 **self._membership()}
+                elif op in ("join", "leave"):
+                    node = int(msg["node"])
+                    with self.cond:
+                        if op == "join":
+                            self.members.add(node)
+                        else:
+                            self.members.discard(node)
+                        self.cond.notify_all()
+                        reply = {"ok": True, **self._membership()}
                 elif op == "fail":
                     with self.cond:
                         self.failed.setdefault(msg["gen"],
@@ -214,11 +312,12 @@ class _Coordinator:
                 pass
 
     def wait_all_finished(self, timeout: float) -> bool:
-        """Block until every node has reported done (so peers still polling
-        never see a vanished coordinator); False on timeout."""
+        """Block until every CURRENT member has reported done (so peers
+        still polling never see a vanished coordinator; departed members
+        owe nothing); False on timeout."""
         with self.cond:
             return self.cond.wait_for(
-                lambda: len(self.finished) >= self.nnodes, timeout=timeout)
+                lambda: self.members <= self.finished, timeout=timeout)
 
     def close(self) -> None:
         try:
@@ -386,6 +485,9 @@ class LocalAgent:
         self.log = _log
         self._procs: dict[int, subprocess.Popen] = {}
         self._gen = 0  # current rendezvous generation (RESTART_ATTEMPT)
+        # the membership the newest barrier rendezvoused at (None until
+        # a coordinated generation has passed one) — _barrier records it
+        self._barrier_world: int | None = None
         # graceful-drain accounting across every teardown of this run
         # (satellite: _terminate_all outcome rides GangResult.drain)
         self._drain_stats = {"drained": 0, "exited": 0, "killed": 0}
@@ -566,20 +668,13 @@ class LocalAgent:
             names = os.listdir(run_dir)
         except OSError:
             return out
-        now = time.time()
         for name in names:
             if not (name.startswith(HEARTBEAT_PREFIX)
                     and name.endswith(".json")):
                 continue
-            path = os.path.join(run_dir, name)
-            try:
-                with open(path) as f:
-                    hb = json.load(f)
-                out[int(hb["rank"])] = {
-                    "step": int(hb["step"]), "gen": int(hb["gen"]),
-                    "age_s": now - os.path.getmtime(path)}
-            except (OSError, ValueError, KeyError):
-                continue
+            hb = read_heartbeat(os.path.join(run_dir, name))
+            if hb is not None:
+                out[hb["rank"]] = hb
         return out
 
     def _clear_heartbeats(self, run_dir: str) -> None:
@@ -721,17 +816,21 @@ class LocalAgent:
                     return "lost", (rank, code, kind)
             if not running:
                 return "done", per_rank
-            # heartbeat staleness: only ranks that have beaten in THIS
-            # generation are eligible (a cold compile never beats and
-            # must not be misread as a hang)
+            # heartbeat staleness: one shared verdict (heartbeat_verdict
+            # — the fleet router judges its replicas through the same
+            # helper).  "cold" ranks (no beat this generation — still
+            # compiling) are ineligible; their PID liveness is already
+            # covered by the poll() loop above, so pid=None here.
             beats = self._heartbeats(run_dir)
             for rank in running:
                 hb = beats.get(rank)
-                if hb is None or hb["gen"] != self._gen:
+                verdict = heartbeat_verdict(
+                    hb, stale_s=cfg.heartbeat_timeout_s, gen=self._gen)
+                if verdict == "cold":
                     continue
                 gen_start_step.setdefault(rank, hb["step"])
                 last_step[rank] = hb["step"]
-                if hb["age_s"] > cfg.heartbeat_timeout_s:
+                if verdict == "stale":
                     self.log(f"[launch] rank {rank} heartbeat stale "
                              f"({hb['age_s']:.1f}s > "
                              f"{cfg.heartbeat_timeout_s}s); killing hung "
@@ -773,14 +872,24 @@ class LocalAgent:
         return _rpc(self.master_addr, self.agent_port, msg, timeout)
 
     def _barrier(self, gen: int) -> bool:
-        """Arrive at generation ``gen``; True when all nodes are in.  The
-        node-0 coordinator may come up after us — retry the dial."""
+        """Arrive at generation ``gen``; True when every current member
+        is in.  The node-0 coordinator may come up after us — retry the
+        dial.  The reply's membership (round 19: the barrier counts
+        CHANGING membership, not a fixed nnodes) is recorded so this
+        generation spawns against the world size it rendezvoused at."""
         deadline = time.monotonic() + CONNECT_RETRY_S
         while True:
             try:
                 rep = self._rpc_coord(
                     {"op": "barrier", "node": self.node_rank, "gen": gen},
                     BARRIER_TIMEOUT_S + RPC_TIMEOUT_S)
+                ws = rep.get("world_size")
+                if ws:
+                    self._barrier_world = int(ws)
+                    if ws != self.nnodes:
+                        self.log(f"[launch] generation {gen} rendezvoused "
+                                 f"at world size {ws} (membership "
+                                 f"changed from {self.nnodes})")
                 return bool(rep.get("ok"))
             except (OSError, ValueError):
                 if time.monotonic() > deadline:

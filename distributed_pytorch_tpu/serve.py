@@ -604,6 +604,11 @@ class ContinuousBatcher:
                 or self._inflight is not None
                 or any(o is not None for o in self.occupant))
 
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet generating (queued + mid-
+        admission) — the backlog signal the fleet autoscaler watches."""
+        return len(self.queue) + len(self.admitting)
+
     def timing_stats(self) -> dict:
         """Per-phase wall-clock summary (count / total / p50 / p95 per
         phase) over every ``step()`` so far: ``host_plan`` (admission +

@@ -29,7 +29,18 @@ Fault classes (``FaultPlan.kind``):
   REPLICA id here — the fleet is in-process, so there is no process
   rank to scope by) once its poll tick reaches ``step``; the router
   must detect the loss and rescue the replica's in-flight requests
-  (fleet/router.py, ``maybe_kill_replica``).
+  (fleet/router.py, ``maybe_kill_replica``);
+- ``rpc_drop`` / ``rpc_torn`` / ``rpc_slow``: the socket-fleet transport
+  faults (fleet/transport.py, ``maybe_rpc_fault``).  ``rank`` is again
+  the REPLICA id; ``step`` counts the server's RPC calls; ``op``
+  optionally pins the fault to one RPC op (e.g. ``"poll"``) so arming
+  is immune to call-mix drift.  ``drop``
+  kills the serving endpoint mid-call (a dead peer), ``torn`` truncates
+  the reply frame at the boundary class named by ``mode`` (``header`` |
+  ``payload`` | ``crc`` — a partial write cut by a crash), ``slow``
+  sleeps ``delay_s`` before replying (a hung peer, the client's
+  deadline/backoff path).  The client must detect each and quarantine
+  the peer; the router rescues exactly as for ``replica_loss``.
 
 Plans deliver either programmatically (``install``) or through the
 ``FAULT_PLAN`` env var as JSON — the env path crosses the launcher's
@@ -61,8 +72,9 @@ FAULT_EXIT_CODE = 77
 
 ENV_VAR = "FAULT_PLAN"
 
+RPC_KINDS = ("rpc_drop", "rpc_torn", "rpc_slow")
 KINDS = ("nan_grad", "inf_grad", "loss_spike", "crash", "ckpt_corrupt",
-         "rendezvous", "straggler", "replica_loss")
+         "rendezvous", "straggler", "replica_loss") + RPC_KINDS
 
 
 @dataclass
@@ -84,7 +96,14 @@ class FaultPlan:
     # rollback re-crosses the step); > 1 models a persistent one (the
     # escalation-ladder scenario)
     count: int = 1
-    mode: str = "bitflip"    # ckpt_corrupt: 'bitflip' | 'truncate'
+    # ckpt_corrupt: 'bitflip' | 'truncate';
+    # rpc_torn: 'header' | 'payload' | 'crc' (frame boundary class)
+    mode: str = "bitflip"
+    # rpc_* only: scope the plan to one RPC op ("poll", "submit", ...).
+    # "" = any call.  An op-scoped plan fires on the first MATCHING
+    # call at/past ``step``, so arming survives drift in the call mix
+    # (hello probes, retries, routing) that shifts raw call indices.
+    op: str = ""
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -257,6 +276,35 @@ def maybe_kill_replica(replica: int, tick: int) -> bool:
         return False
     plan.count -= 1
     return True
+
+
+def maybe_rpc_fault(replica: int, call: int,
+                    op: str | None = None) -> FaultPlan | None:
+    """``rpc_drop``/``rpc_torn``/``rpc_slow``: the socket-transport
+    chaos hook (fleet/transport.py RpcServer consults it once per
+    served call).  Returns the armed plan exactly ``count`` times once
+    the server's call counter reaches the plan's ``step``, for the
+    planned replica — ``rank`` is the REPLICA id (-1 = any), exactly
+    as ``maybe_kill_replica`` reads it; the env path (``FAULT_PLAN``)
+    crosses the daemon's process boundary the same way it crosses the
+    launcher's.  A plan with ``op`` set fires only on calls of that op
+    (still at/past ``step`` on the server's global counter) — index-
+    only plans are brittle to call-mix drift (hello probes, retries,
+    routing) silently disarming the chaos.  The caller acts on
+    ``plan.kind``/``mode``/``delay_s``; this hook only decides WHETHER
+    this call is the planned one."""
+    plan = get_plan()
+    if (plan is None or plan.kind not in RPC_KINDS
+            or not _gen_live(plan)):
+        return None
+    if 0 <= plan.rank != replica:
+        return None
+    if plan.op and plan.op != op:
+        return None
+    if call < plan.step or plan.count <= 0:
+        return None
+    plan.count -= 1
+    return plan
 
 
 _RDZV_FAILED = 0

@@ -54,9 +54,14 @@ from .metrics import SpikeDetector
 BUNDLE_VERSION = 1
 BUNDLE_PREFIX = "postmortem_"
 
-# the four trigger classes the flight recorder covers (ISSUE 12)
+# the trigger classes the flight recorder covers: the four of ISSUE 12
+# plus "transport" (round 19) — a socket-fleet peer quarantined by the
+# RPC client (torn/corrupt frame, or deadline exhaustion after retries),
+# written by fleet/daemon.py RemoteReplica before the router's
+# replica_loss rescue bundle, so the socket-layer death and the
+# scheduling-layer recovery each leave their own strict-JSON record
 TRIGGERS = ("sentry_abort", "worker_fault", "elastic_shrink",
-            "replica_loss")
+            "replica_loss", "transport")
 SEVERITIES = ("info", "warn", "critical")
 AGGS = ("last", "mean", "max", "min", "p50", "p95", "spike", "age")
 OPS = ("<=", ">=")

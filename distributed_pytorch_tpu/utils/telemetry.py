@@ -357,6 +357,20 @@ def maybe_enable(run_dir: str | None = None, **kwargs) -> Telemetry | None:
     return enable(run_dir, **kwargs)
 
 
+def child_env(tel: Telemetry | None = None) -> dict[str, str]:
+    """The env contract that hands this process's run directory to a
+    child process: merge into the child's environment and its
+    ``maybe_enable()`` lands in the SAME run dir, so per-process event
+    files (pid-suffixed) interleave into one merged Chrome trace.  The
+    launcher exports ``TELEMETRY_DIR`` by hand; spawned fleet daemons
+    (fleet/daemon.py ``ReplicaProcess``) ride this helper.  Empty dict
+    when telemetry is off — safe to splat unconditionally."""
+    tel = tel if tel is not None else active()
+    run_dir = tel.run_dir if tel is not None else os.environ.get(
+        TELEMETRY_DIR_ENV)
+    return {TELEMETRY_DIR_ENV: run_dir} if run_dir else {}
+
+
 def enable_from_cli(run_dir: str | None = None) -> Telemetry | None:
     """The ONE CLI bootstrap (cli.py / lm_cli.py): ``maybe_enable`` with
     the launcher-aware rank precedence — env ``RANK`` first (the

@@ -75,6 +75,11 @@ RULES: dict[str, tuple[str, float]] = {
     # is a wall-clock median like the other speedups.
     "train_localsgd_speedup": ("higher", 0.10),
     "train_dcn_bytes_per_step_windowed": ("lower", 0.02),
+    # round 19: heartbeat round-trip over the unix-socket RPC — wide
+    # band (sub-ms values are scheduler-noise dominated) plus an
+    # absolute ceiling below so the tax stays decisively under a
+    # decode step
+    "fleet_rpc_overhead_ms": ("lower", 0.50),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,
@@ -91,6 +96,10 @@ ABS_CEILINGS: dict[str, float] = {
     # is spending more than full recomputation should cost (measured
     # ~5-25% on the CPU mesh depending on the rung)
     "lm_remat_step_overhead_pct": 35.0,
+    # round-19 bound: one framed RPC round-trip (heartbeat median) must
+    # stay well under a single decode step (~10 ms on the CPU mesh) —
+    # measured ~0.1-0.3 ms over unix sockets
+    "fleet_rpc_overhead_ms": 5.0,
 }
 
 

@@ -17,8 +17,18 @@ ms.  ``--disaggregate`` (requires ``--paged``) makes replica 0
 prefill-only and the rest decode-only, so every request crosses pools
 as a paged-KV handoff.
 
+Round 19 adds the transport axis: ``--transport {inproc,unix,tcp}``
+runs the fleet leg over REAL replica processes (fleet/daemon.py) —
+each replica its own daemon speaking the crc-framed RPC — and reports
+``rpc_overhead_ms``: the pure wire cost (median heartbeat round-trip,
+no batcher work), the number bench.py's ``fleet_rpc_overhead_ms``
+gate pins.  Socket daemons are forced onto CPU (two processes cannot
+share one TPU) and rebuild the model from the spec; the leg measures
+transport, not device throughput.
+
 Run:  PYTHONPATH=. python scripts/bench_serving.py [--slots 4 --requests 16]
       PYTHONPATH=. python scripts/bench_serving.py --fleet 2 --paged --disaggregate
+      PYTHONPATH=. python scripts/bench_serving.py --fleet 2 --transport unix
 """
 import argparse
 import json
@@ -105,6 +115,42 @@ def run(cb: ContinuousBatcher, prompts, budgets, verbose=False):
                        if isinstance(v, dict)}}
 
 
+def rpc_overhead_ms(fleet, probes: int = 50) -> float | None:
+    """Pure wire overhead for a SOCKET fleet: median heartbeat
+    round-trip over ``probes`` pings (framing + socket + dispatch, no
+    batcher work).  None for in-process fleets / quarantined peers."""
+    rep = next(iter(fleet.replicas.values()))
+    cli = getattr(rep, "client", None)
+    if cli is None or cli.quarantined:
+        return None
+    times = []
+    for _ in range(probes):
+        t0 = time.perf_counter()
+        cli.call("heartbeat")
+        times.append((time.perf_counter() - t0) * 1e3)
+    return round(sorted(times)[len(times) // 2], 4)
+
+
+def fleet_spec(args) -> dict:
+    """The daemon build recipe matching this bench's in-process
+    batcher (fleet/daemon.py spec contract).  dtype does not cross the
+    JSON boundary — socket daemons run the default dtype on CPU."""
+    batcher = dict(slots=args.slots, max_len=1024,
+                   temperature=args.temperature,
+                   prompt_buckets=[32, 128],
+                   steps_per_sync=args.steps_per_sync,
+                   prefill_chunk=args.prefill_chunk,
+                   schedule=args.schedule, paged=args.paged,
+                   speculate=args.speculate, spec_ngram=args.spec_ngram,
+                   prefix_cache=args.prefix_cache,
+                   overlap=not args.no_overlap, kv_dtype=args.kv_dtype)
+    if args.no_refill:
+        batcher["inblock_refill"] = False
+    return {"cfg": dict(vocab_size=4096, d_model=512, n_layers=4,
+                        n_heads=8, head_dim=64, d_ff=2048),
+            "seed": 0, "batcher": batcher}
+
+
 def run_fleet(fleet, prompts, budgets):
     """Drive a ``FleetRouter`` over the workload; router accounting."""
     gids = [fleet.submit(p, max_new=b) for p, b in zip(prompts, budgets)]
@@ -170,10 +216,19 @@ def main():
                     help="with --fleet N>=2: replica 0 prefills, the "
                     "rest decode — every request moves pools as a "
                     "paged-KV handoff (requires --paged)")
+    ap.add_argument("--transport", default="inproc",
+                    choices=("inproc", "unix", "tcp"),
+                    help="fleet transport: inproc shares the process "
+                    "(round 14); unix/tcp spawn each replica as a "
+                    "daemon speaking the crc-framed RPC and report "
+                    "rpc_overhead_ms (requires --fleet)")
     args = ap.parse_args()
     if args.disaggregate and not args.paged:
         ap.error("--disaggregate moves paged KV between pools: "
                  "add --paged")
+    if args.transport != "inproc" and not args.fleet:
+        ap.error("--transport unix|tcp drives a socket fleet: "
+                 "add --fleet N")
 
     cfg = tfm.TransformerConfig(vocab_size=4096, d_model=512, n_layers=4,
                                 n_heads=8, head_dim=64, d_ff=2048)
@@ -196,6 +251,28 @@ def main():
             spec_ngram=args.spec_ngram, prefix_cache=args.prefix_cache,
             overlap=not args.no_overlap, kv_dtype=args.kv_dtype, **kw)
 
+    if args.transport != "inproc":
+        # the daemons compile for themselves (forced to CPU: two
+        # processes cannot share one TPU) — no parent cold pass
+        from distributed_pytorch_tpu.fleet import make_socket_fleet
+
+        fleet = make_socket_fleet(
+            fleet_spec(args), args.fleet, transport=args.transport,
+            disaggregate=args.disaggregate,
+            env={"JAX_PLATFORMS": "cpu"})
+        try:
+            out = run_fleet(fleet, prompts, budgets)
+            out["transport"] = args.transport
+            out["rpc_overhead_ms"] = rpc_overhead_ms(fleet)
+            out["rpc"] = {
+                k: round(sum(r.client.stats[k]
+                             for r in fleet.replicas.values()), 3)
+                for k in ("calls", "retries", "rpc_ms")}
+            print(json.dumps(out))
+        finally:
+            fleet.close()
+        return
+
     # cold pass compiles; the reported (timed) pass reuses its compiled
     # fns through a fresh batcher, so tok/s is warm and stats are clean
     cold = make()
@@ -206,7 +283,9 @@ def main():
         fleet = make_fleet(lambda: warm_clone(cold, make), args.fleet,
                            disaggregate=args.disaggregate)
         try:
-            print(json.dumps(run_fleet(fleet, prompts, budgets)))
+            out = run_fleet(fleet, prompts, budgets)
+            out["transport"] = "inproc"
+            print(json.dumps(out))
         finally:
             fleet.close()
         return

@@ -133,10 +133,13 @@ def pytest_configure(config):
         "and runs with the full suite (wired like the `faults` lane).")
     config.addinivalue_line(
         "markers",
-        "fleet: serving-fleet lane (round 14) — `pytest -m fleet` runs "
-        "the disaggregated prefill/decode fleet (tests/test_fleet.py: "
-        "KV handoff round-trips, prefix-aware routing, LPT fallback, "
-        "session affinity, replica-loss rescue).  All fleet tests are "
+        "fleet: serving-fleet lane (rounds 14+19) — `pytest -m fleet` "
+        "runs the disaggregated prefill/decode fleet (tests/"
+        "test_fleet.py: KV handoff round-trips, prefix-aware routing, "
+        "LPT fallback, session affinity, replica-loss rescue) and the "
+        "multi-process transport (tests/test_fleet_transport.py: crc "
+        "framing + torn-frame matrix, idempotent retry, quarantine, "
+        "socket-fleet chaos rescue, autoscaler).  All fleet tests are "
         "fast and ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic` lanes).")
     config.addinivalue_line(

@@ -384,6 +384,37 @@ def test_bench_json_keys_include_fleet_gate():
     assert sig.parameters["reps"].default >= 3  # hardened window
 
 
+def test_bench_fleet_transport_env_knob_fails_loudly():
+    """A typo'd BENCH_FLEET_TRANSPORT must raise before any measurement
+    (the shared _canon_bool_env contract); unset/''/'0' skip cleanly,
+    '1' runs."""
+    assert bench.canon_fleet_transport_env(None) is False
+    assert bench.canon_fleet_transport_env("") is False
+    assert bench.canon_fleet_transport_env("0") is False
+    assert bench.canon_fleet_transport_env("1") is True
+    for bad in ("yes", "true", "2", " 1", "on"):
+        with pytest.raises(ValueError, match="BENCH_FLEET_TRANSPORT"):
+            bench.canon_fleet_transport_env(bad)
+
+
+def test_bench_json_keys_include_fleet_transport_gate():
+    """Round-19 schema: the multi-process transport keys ride the JSON,
+    the knob is canonicalized pre-bench, and the gate prices a REAL
+    socket fleet (daemons pinned off the parent's accelerator) plus an
+    autoscaler spawn->drain cycle."""
+    import inspect
+    src = inspect.getsource(bench.main)
+    for key in ("fleet_rpc_overhead_ms", "fleet_autoscale_events"):
+        assert key in src, key
+    assert "canon_fleet_transport_env" in src
+    assert "BENCH_FLEET_TRANSPORT" in src
+    tsrc = inspect.getsource(bench.bench_fleet_transport)
+    assert "make_socket_fleet" in tsrc    # real daemons, real sockets
+    assert "JAX_PLATFORMS" in tsrc        # daemons must not grab the TPU
+    assert "FleetAutoscaler" in tsrc
+    assert "rpc_overhead_ms" in tsrc
+
+
 def test_bench_json_keys_include_pp_gate():
     """Round-10 schema: the interleaved-1F1B A/B keys ride the JSON, the
     knobs are canonicalized pre-bench, and the A/B reads its bubble from
@@ -517,6 +548,10 @@ def test_bench_compare_rule_table_covers_baseline_keys():
                 "fleet_tokens_per_sec", "fleet_prefix_hit_rate"):
         assert bc.RULES[key][0] == "higher", key
     for key in ("decode_ms_per_token", "decode_ms_per_token_p95",
-                "elastic_recovery_ms", "fleet_handoff_ms"):
+                "elastic_recovery_ms", "fleet_handoff_ms",
+                "fleet_rpc_overhead_ms"):
         assert bc.RULES[key][0] == "lower", key
     assert bc.ABS_CEILINGS["telemetry_overhead_pct"] == 2.0
+    # round-19: one framed RPC round-trip must stay decisively under a
+    # decode step regardless of the old run's value
+    assert bc.ABS_CEILINGS["fleet_rpc_overhead_ms"] == 5.0

@@ -447,3 +447,109 @@ def test_two_process_hierarchical_training():
     )
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert proc.stdout.count("OK") == 2, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# round 19: shared heartbeat verdicts + changing-membership rendezvous
+
+
+def test_heartbeat_verdict_cold_lost_fresh_stale(tmp_path):
+    """The ONE liveness helper (shared by the elastic agent and the
+    fleet router): never-beat is "cold" (still warming) unless the PID
+    is provably dead ("lost"); a beat that aged out is "stale"."""
+    import subprocess
+
+    from distributed_pytorch_tpu.launch import (heartbeat_path,
+                                                heartbeat_verdict,
+                                                pid_alive,
+                                                read_heartbeat)
+    from distributed_pytorch_tpu.parallel.elastic import Heartbeat
+
+    path = heartbeat_path(str(tmp_path), 0)
+    assert read_heartbeat(path) is None  # no file yet
+    assert heartbeat_verdict(None, stale_s=1.0) == "cold"
+    assert heartbeat_verdict(None, stale_s=1.0,
+                             pid=os.getpid()) == "cold"
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    assert not pid_alive(p.pid)
+    assert heartbeat_verdict(None, stale_s=1.0, pid=p.pid) == "lost"
+
+    hb = Heartbeat(str(tmp_path), 0, 0, min_interval_s=0.0)
+    hb.beat(7)
+    rec = read_heartbeat(path)
+    assert rec["rank"] == 0 and rec["step"] == 7 and rec["age_s"] < 5.0
+    assert heartbeat_verdict(rec, stale_s=5.0) == "fresh"
+    assert heartbeat_verdict({**rec, "age_s": 9.0},
+                             stale_s=5.0) == "stale"
+    # a PREVIOUS generation's beat is this generation's cold start
+    assert heartbeat_verdict(rec, stale_s=5.0, gen=1) == "cold"
+    assert heartbeat_verdict(rec, stale_s=5.0, gen=1,
+                             pid=p.pid) == "lost"
+
+
+def _barrier_client(port, node, gen, out):
+    from distributed_pytorch_tpu.launch import _rpc
+
+    out[node] = _rpc("127.0.0.1", port, {"op": "barrier", "node": node,
+                                         "gen": gen}, 30.0)
+
+
+def test_coordinator_barrier_counts_changing_membership():
+    """The carried elastic half (b): the rendezvous barrier releases on
+    every CURRENT member — leave shrinks the count (and un-wedges an
+    in-flight wait), join grows it back, and replies carry the
+    membership each generation rendezvoused at."""
+    import threading
+
+    from distributed_pytorch_tpu.launch import _Coordinator, _rpc
+
+    coord = _Coordinator(3, 0)
+    port = coord.srv.getsockname()[1]
+    try:
+        # gen 0: fixed-membership behavior — blocks until all 3 arrive
+        out: dict = {}
+        ts = [threading.Thread(target=_barrier_client,
+                               args=(port, n, 0, out)) for n in (0, 1)]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)
+        assert not out  # two of three: still held
+        _barrier_client(port, 2, 0, out)
+        for t in ts:
+            t.join(10)
+        assert all(out[n]["ok"] and out[n]["world_size"] == 3
+                   for n in (0, 1, 2))
+
+        # node 2 leaves mid-wait: the gen-1 barrier must release on the
+        # two survivors without node 2 ever arriving
+        out = {}
+        ts = [threading.Thread(target=_barrier_client,
+                               args=(port, n, 1, out)) for n in (0, 1)]
+        ts[0].start()
+        time.sleep(0.2)
+        rep = _rpc("127.0.0.1", port, {"op": "leave", "node": 2}, 5.0)
+        assert rep["world_size"] == 2 and rep["members"] == [0, 1]
+        ts[1].start()
+        for t in ts:
+            t.join(10)
+        assert all(out[n]["ok"] and out[n]["world_size"] == 2
+                   and out[n]["members"] == [0, 1] for n in (0, 1))
+
+        # node 5 joins: gen 2 counts three members again (new ids fine)
+        rep = _rpc("127.0.0.1", port, {"op": "join", "node": 5}, 5.0)
+        assert rep["world_size"] == 3 and rep["members"] == [0, 1, 5]
+        out = {}
+        ts = [threading.Thread(target=_barrier_client,
+                               args=(port, n, 2, out)) for n in (0, 1)]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)
+        assert not out  # held for the joiner
+        _barrier_client(port, 5, 2, out)
+        for t in ts:
+            t.join(10)
+        assert all(out[n]["ok"] and out[n]["members"] == [0, 1, 5]
+                   for n in (0, 1, 5))
+    finally:
+        coord.close()
