@@ -729,6 +729,109 @@ def bench_train_autotune(batch_per_replica: int = 64, iters: int = 30,
             "ms_default": med[False], "plan": plan.summary()}
 
 
+def canon_route_env(value: str | None) -> bool:
+    """Validate the BENCH_ROUTE knob (round 20): '1' runs the routed
+    hop-graph leg (choose a route on the synthetic wan_dcn profile, run
+    the RoutedSync trainer, report per-hop wire bytes), unset/''/'0'
+    skips it."""
+    return _canon_bool_env(
+        "BENCH_ROUTE", value, default=False,
+        guess="whether to run the routed hop-graph sync leg")
+
+
+def bench_train_routed(batch_per_replica: int = 64, iters: int = 30,
+                       reps: int = 5) -> dict | None:
+    """Routed hop-graph sync leg (round 20, BENCH_ROUTE=1): run the
+    route-searching chooser (parallel/autotune.choose_sync_plan) over
+    the VGG-11 grad census on the synthetic ``wan_dcn`` profile shaped
+    to this fleet's ('dcn', 'ici') factorization, execute the winning
+    route with the RoutedSync trainer (strategy="routed" +
+    ``sync_route``), and A/B it against the hand-built
+    hierarchical+int4 path it generalizes — plus the schedule
+    inspector's PER-HOP wire accounting (``amortized_axis_bytes(...,
+    by_hop=True)``), the deterministic numbers bench_compare gates.
+    Needs >= 4 devices divisible by 2 (a 2-slice factored mesh);
+    returns None (JSON nulls) otherwise.  On CPU meshes expect ~1.0x
+    (no latency-hiding scheduler; the route choice + per-hop byte
+    accounting are the content)."""
+    import jax
+
+    from distributed_pytorch_tpu.parallel import autotune
+    from distributed_pytorch_tpu.train import (TrainConfig, Trainer,
+                                               make_multi_step)
+    from distributed_pytorch_tpu.utils import debug as dbg
+
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        _log(f"[bench] train-routed A/B needs >= 4 devices divisible "
+             f"by 2 (have {n_dev}); omitting")
+        return None
+    dcn_size = 2
+    axes = autotune.train_topology_axes(dcn_size, n_dev)
+    profile = autotune.synthetic_profile("wan_dcn", axes)
+    from distributed_pytorch_tpu.models import vgg
+    census = autotune.grad_census(jax.eval_shape(
+        lambda k: vgg.init(k, "VGG11")[0], jax.random.key(0)))
+    plan = autotune.choose_sync_plan(census, profile)
+    _log("[bench] " + plan.table().replace("\n", "\n[bench] "))
+    route = plan.route
+
+    def build(routed: bool) -> Trainer:
+        cfg = TrainConfig(
+            strategy="routed" if routed else "hierarchical",
+            sync_route=route if routed else None,
+            dcn_compress=None if routed else "int4",
+            batch_size=batch_per_replica, dcn_size=dcn_size,
+            steps_per_loop=iters, compute_dtype="bfloat16")
+        return Trainer(cfg)
+
+    trainers = {False: build(False), True: build(True)}
+    rng = np.random.default_rng(0)
+    global_batch = batch_per_replica * n_dev
+    images = rng.integers(
+        0, 256, (iters, global_batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (iters, global_batch)).astype(np.int32)
+
+    for tr in trainers.values():  # compile + warm outside the timed reps
+        tr.precompile_steps(images, labels)
+        float(tr.train_steps(images, labels)[-1])
+
+    times: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(reps):
+        for mode, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            losses = tr.train_steps(images, labels)
+            float(losses[-1])  # fetch forces the whole donated chain
+            times[mode].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {m: sorted(ts)[len(ts) // 2] for m, ts in times.items()}
+    speedup = med[False] / max(med[True], 1e-9)
+
+    # per-hop wire accounting of the routed program (one trace; the
+    # executable is already compiled) — the rows bench_compare gates
+    tr = trainers[True]
+    img, lbl = tr._stage(images[:1], labels[:1])
+    args = tr._args(img, lbl)
+    if tr._multi_fn is None:
+        tr._multi_fn = make_multi_step(tr.cfg, tr.strategy, tr.mesh,
+                                       fault_sig=tr._fault_sig)
+    sched = dbg.op_schedule(tr._multi_fn, *args)
+    # the [:1] slice traced a K=1 scan, so the schedule is already
+    # per-step — no /iters here (the timed program is K=iters, but the
+    # per-step collective content is identical)
+    by_hop = {k: int(v) for k, v in dbg.amortized_axis_bytes(
+        [(sched, 1)], 1, by_hop=True).items()}
+    bytes_per_step = sum(by_hop.values())
+    _log(f"[bench] train-routed A/B (route={route!r}, {n_dev} dev): "
+         f"{med[True]:.2f} ms/step routed vs {med[False]:.2f} "
+         f"hierarchical_int4 -> {speedup:.3f}x; "
+         f"{bytes_per_step / 1e6:.2f} MB/step by hop "
+         f"{ {k: round(v / 1e6, 3) for k, v in by_hop.items()} } "
+         f"({reps} reps median)")
+    return {"speedup": speedup, "ms_routed": med[True],
+            "ms_hierarchical_int4": med[False], "plan": plan.summary(),
+            "bytes_by_hop": by_hop, "bytes_per_step": bytes_per_step}
+
+
 def canon_telemetry_env(value: str | None) -> bool:
     """Validate the BENCH_TELEMETRY knob: '1' runs the round-13
     telemetry on/off A/B (CPU overhead of the unified event stream),
@@ -1614,6 +1717,10 @@ def main() -> None:
     # BENCH_AUTOTUNE=1 runs calibrate->choose->A/B vs the hand-picked
     # default and stamps the chosen plan into the JSON.
     run_autotune = canon_autotune_env(os.environ.get("BENCH_AUTOTUNE"))
+    # Routed hop-graph knob (round 20), validated loudly pre-bench:
+    # BENCH_ROUTE=1 runs choose-route -> RoutedSync trainer -> per-hop
+    # byte accounting vs the hand-built hierarchical_int4 path.
+    run_route = canon_route_env(os.environ.get("BENCH_ROUTE"))
     # Elastic-recovery knob (round 12), validated loudly pre-bench:
     # BENCH_ELASTIC=1 measures the shrink->reshard->grow recovery gap.
     run_elastic = canon_elastic_env(os.environ.get("BENCH_ELASTIC"))
@@ -1718,6 +1825,16 @@ def main() -> None:
             autotune_ab = bench_train_autotune()
         except Exception as e:
             _log(f"[bench] train-autotune A/B failed ({e}); omitting")
+
+    # Routed hop-graph gate (round 20): chooser-picked route executed
+    # by the RoutedSync trainer, per-hop wire bytes from the schedule
+    # inspector; optional like the other gates.
+    route_ab = None
+    if run_route:
+        try:
+            route_ab = bench_train_routed()
+        except Exception as e:
+            _log(f"[bench] train-routed A/B failed ({e}); omitting")
 
     # Elastic-recovery gate (round 12): shrink -> load_resharded -> grow
     # on the LM trainer; optional like the other gates.
@@ -1891,6 +2008,19 @@ def main() -> None:
                                    if autotune_ab is not None else None),
         "train_autotune_plan": (autotune_ab["plan"]
                                 if autotune_ab is not None else None),
+        # routed hop-graph leg (round 20, BENCH_ROUTE=1): the chooser's
+        # routed plan (route string + per-hop cost rows), the measured
+        # per-hop wire bytes of the executed program, their sum (the
+        # deterministic number bench_compare gates), and the ms ratio
+        # vs the hand-built hierarchical_int4 path.  Null when skipped.
+        "train_routed_plan": (route_ab["plan"]
+                              if route_ab is not None else None),
+        "train_routed_bytes_by_hop": (route_ab["bytes_by_hop"]
+                                      if route_ab is not None else None),
+        "train_routed_bytes_per_step": (route_ab["bytes_per_step"]
+                                        if route_ab is not None else None),
+        "train_routed_speedup": (round(route_ab["speedup"], 3)
+                                 if route_ab is not None else None),
         # elastic-recovery gate (round 12, BENCH_ELASTIC=1): wall-clock
         # of the in-process shrink recovery (mesh rebuild + cross-
         # topology load_resharded + one proving step at the smaller

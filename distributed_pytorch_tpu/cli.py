@@ -64,18 +64,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference hangs forever: timeout=None)")
     # Training hyper-parameters; defaults are the reference's exact values.
     p.add_argument("--strategy", default="ddp",
-                   choices=_strat.available() + ["auto"],
-                   help="gradient-sync strategy, or 'auto' (round 11): "
+                   choices=_strat.available() + ["auto", "routed"],
+                   help="gradient-sync strategy, 'auto' (round 11): "
                         "calibrate per-axis link alpha/beta (cached "
                         "repo-locally) and resolve to the named strategy "
                         "+ bucket/compression knobs minimizing predicted "
-                        "step-sync time (parallel/autotune.py)")
+                        "step-sync time (parallel/autotune.py), or "
+                        "'routed' (round 20): execute the declarative "
+                        "hop-graph given by --sync-route "
+                        "(parallel/routing.py)")
+    p.add_argument("--sync-route", default=None,
+                   help="route string for --strategy routed, in the hop "
+                        "grammar ('ici:rs -> dcn:ring[int4+ef] -> "
+                        "ici:ag'): per hop axis:op with op one of rs, "
+                        "slice, ag, psum, ring[int8|int4[+ef]]; must be "
+                        "a 2-level ('dcn','ici') plan — the trainer's "
+                        "factored-mesh topology")
     p.add_argument("--autotune-profile", default=None,
                    help="profile source for --strategy auto: a synthetic "
                         "preset name (uniform, fast_ici_slow_dcn, "
-                        "inverted, slow, fast) or a profile-JSON path; "
-                        "default: the cached/calibrated profile for this "
-                        "topology")
+                        "inverted, slow, fast, wan_dcn, ici_dcn_wan — "
+                        "the 3-tier preset the route chooser searches) "
+                        "or a profile-JSON path; default: the cached/"
+                        "calibrated profile for this topology")
     p.add_argument("--dcn-size", type=int, default=2,
                    help="number of slices for --strategy hierarchical: the "
                         "data axis factors into Mesh(('dcn','ici')) and "
@@ -274,11 +285,13 @@ def main(argv: list[str] | None = None) -> int:
         overlap_bucket_mb=args.overlap_bucket_mb,
         sync_every=args.sync_every, max_sync_every=max_sync_every,
         autotune_profile=args.autotune_profile,
+        sync_route=args.sync_route,
     )
     mesh = None
     # "auto" resolves inside the Trainer (which then builds whatever mesh
-    # the chosen strategy needs); factored strategies likewise.
-    factored = (args.strategy == "auto" or
+    # the chosen strategy needs); "routed" parses its route there too;
+    # factored strategies likewise.
+    factored = (args.strategy in ("auto", "routed") or
                 getattr(_strat.get(args.strategy), "axes", None) is not None)
     if args.strategy != "none" and not factored:
         mesh = make_mesh(args.num_devices)

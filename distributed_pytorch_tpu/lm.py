@@ -943,7 +943,16 @@ def _two_level_sync(g: PyTree, specs: PyTree,
     same exchange one rung lower: nibble-packed payloads, half the DCN
     bytes, identical residual layout (``_chunk`` is bits-independent).
     Numerics become bucket-LAYOUT-dependent through the row scales (the
-    layout is the partition above, shared with the residual sizing)."""
+    layout is the partition above, shared with the residual sizing).
+
+    Round 20: every bucket body is a routed ``HopPlan`` compiled by
+    ``parallel/routing.execute`` — fsdp buckets run the single-hop
+    ``dcn:psum`` (leaf mode, one multi-operand psum) or
+    ``dcn:ring[bits+ef]`` route, two_level buckets the ``data:rs →
+    dcn:… → data:ag`` route via ``two_level_psum`` (itself routed).
+    The op sequences are identical; the pre-existing loss/census/EF
+    pins on this function now pin the route compiler."""
+    from .parallel import routing
     from .parallel.strategies import QuantizedRing, two_level_psum
 
     g_leaves, td = jax.tree.flatten(g)
@@ -963,7 +972,9 @@ def _two_level_sync(g: PyTree, specs: PyTree,
                 # one psum primitive per bucket, per-leaf payloads (no
                 # concat: leaves keep their own vma; each is already
                 # data-shard-sized)
-                synced = jax.lax.psum(vals, DCN)
+                synced, _ = routing.execute(
+                    routing.HopPlan((routing.Hop("exchange", DCN),)),
+                    vals, concat=False)
             else:
                 synced = two_level_psum(vals, DCN, DATA)
             for i, s in zip(idxs, synced):
@@ -985,18 +996,14 @@ def _two_level_sync(g: PyTree, specs: PyTree,
         offset += seg
         if kind == "fsdp":
             # the bucket is already shard-sized: ring the concatenated
-            # flat vector across slices directly
-            flat = jnp.concatenate([v.ravel().astype(jnp.float32)
-                                    for v in vals])
-            summed, err_rows = ring._ring_sum(flat, DCN, n_dcn,
-                                              residual=res)
-            new_parts.append(err_rows.ravel())
-            synced, off2 = [], 0
-            for i in idxs:
-                gl = g_leaves[i]
-                synced.append(summed[off2:off2 + gl.size]
-                              .reshape(gl.shape).astype(gl.dtype))
-                off2 += gl.size
+            # flat vector across slices directly (the single-hop
+            # dcn:ring[bits+ef] route)
+            synced, new_r = routing.execute(
+                routing.HopPlan((routing.Hop(
+                    "exchange", DCN, algorithm="ring",
+                    bits=dcn_compress, ef=True),)),
+                vals, residuals=[res])
+            new_parts.extend(new_r)
         else:
             captured: dict = {}
 

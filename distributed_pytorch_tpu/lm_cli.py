@@ -150,8 +150,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(parallel/autotune.py)")
     p.add_argument("--autotune-profile", default=None,
                    help="profile source for --sync-plan auto: a "
-                        "synthetic preset name or a profile-JSON path "
-                        "(default: cached/calibrated for this topology)")
+                        "synthetic preset name (incl. wan_dcn and the "
+                        "3-tier ici_dcn_wan the route chooser searches) "
+                        "or a profile-JSON path (default: cached/"
+                        "calibrated for this topology); the resolved "
+                        "plan logs its route string "
+                        "(parallel/routing.py grammar)")
     p.add_argument("--sync-every", type=int, default=1,
                    help="local-SGD window (round 18): run H local "
                         "optimizer steps between cross-slice exchanges "

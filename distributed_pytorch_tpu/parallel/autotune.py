@@ -80,7 +80,15 @@ from ..utils import telemetry
 # remat/chunked-CE rungs with the device's calibrated
 # recompute-seconds-per-byte; a version-2 profile has no such term and
 # would cost rematerialization as free.
-PROFILE_VERSION = 3
+# 4 since round 20: profiles carry the routed-plan era's fields — the
+# concurrent-calibration record (``concurrent`` block in ``measured``
+# plus ``concurrent_delta_pct``) and the 3-level preset vocabulary
+# ('wan' joins 'dcn' as a link role) — and the route chooser
+# (choose_sync_plan) prices hop-graphs from the same per-axis fits.  A
+# version-3 profile predates the busy-MXU calibration option and the
+# wan role; the version gate recalibrates instead of silently steering
+# (regression-tested in tests/test_routing.py).
+PROFILE_VERSION = 4
 
 # Bucket-size candidates (MB).  25 first: the torch-DDP default wins
 # ties (strict-improvement argmin), so the chooser only moves off it
@@ -148,7 +156,14 @@ class TopologyProfile:
     bytes ``utils.memacct.predict_recompute_bytes`` says a remat/chunked
     rung re-runs.  Like ``quant_s_per_byte`` it defaults to 0.0 only for
     hand-built dicts; cached profiles without it are stale and
-    recalibrate (version gate)."""
+    recalibrate (version gate).
+
+    ``concurrent_delta_pct`` (round 20, version 4) records how much the
+    quantize rate degraded when calibration ran against a background
+    matmul stream (``calibrate(concurrent=True)`` — link fits that
+    reflect a busy MXU instead of an idle device); ``None`` means the
+    profile was calibrated idle.  Hand-built dicts default it; cached
+    profiles without the field are version-3 and recalibrate."""
 
     version: int
     device_kind: str
@@ -157,6 +172,7 @@ class TopologyProfile:
     source: str = "calibrated"
     measured: dict = field(default_factory=dict)
     recompute_s_per_byte: float = 0.0
+    concurrent_delta_pct: float | None = None
 
     def key(self) -> str:
         """Cache-file key: device kind + topology (axis names x sizes)."""
@@ -172,7 +188,8 @@ class TopologyProfile:
                               "quant_s_per_byte": l.quant_s_per_byte}
                           for a, l in self.links.items()},
                 "source": self.source, "measured": self.measured,
-                "recompute_s_per_byte": self.recompute_s_per_byte}
+                "recompute_s_per_byte": self.recompute_s_per_byte,
+                "concurrent_delta_pct": self.concurrent_delta_pct}
 
     @classmethod
     def from_json(cls, d: dict) -> "TopologyProfile":
@@ -189,7 +206,9 @@ class TopologyProfile:
                    source=d.get("source", "cache"),
                    measured=d.get("measured", {}),
                    recompute_s_per_byte=float(
-                       d.get("recompute_s_per_byte", 0.0)))
+                       d.get("recompute_s_per_byte", 0.0)),
+                   # pre-round-20 profiles never calibrated busy: None
+                   concurrent_delta_pct=d.get("concurrent_delta_pct"))
 
 
 # Deterministic synthetic profiles for CPU tests and the dryrun: each
@@ -247,6 +266,15 @@ SYNTHETIC_PRESETS = {
     "wan_dcn": lambda axis: _WAN if axis == "dcn" else _FAST,
     "quant_bound": lambda axis: (_SLOW_QUANT_BOUND if axis == "dcn"
                                  else _FAST),
+    # round 20: the ≥3-level mesh the route chooser searches — fast ICI
+    # within a slice, a datacenter-grade DCN tier across slices, and a
+    # WAN-grade cross-site tier above that.  The optimal plan is a
+    # NESTED 3-hop route (ici:rs → dcn:rs → wan:ring[int4+ef] → dcn:ag
+    # → ici:ag): the wan exchange rides a payload already divided by
+    # BOTH faster axes, and at 0.05 GB/s halving its wire dominates the
+    # extra quantize passes (test-pinned in tests/test_routing.py).
+    "ici_dcn_wan": lambda axis: (_WAN if axis == "wan"
+                                 else _SLOW if axis == "dcn" else _FAST),
 }
 
 
@@ -470,42 +498,106 @@ def _time_recompute(*, rows: int = 2048, width: int = 512,
     return best / produced
 
 
+class _BackgroundMatmul:
+    """A host thread that keeps dispatching a jitted matmul chain on the
+    default device while calibration times its ladders — the round-20
+    busy-MXU stream.  Context manager: enter starts the stream, exit
+    joins it.  Dispatch is async (one ``block_until_ready`` per chain of
+    8), so the device queue stays occupied without the host thread
+    monopolizing the GIL."""
+
+    def __init__(self, dim: int = 512):
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, args=(dim,),
+                                        daemon=True)
+
+    def _run(self, dim: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chain(x):
+            for _ in range(8):
+                x = x @ x * (1.0 / dim)
+            return x
+
+        x = jnp.full((dim, dim), 1.0 / dim, jnp.float32)
+        x = chain(x)
+        x.block_until_ready()  # compile outside the timed window
+        while not self._stop.is_set():
+            x = chain(x)
+            x.block_until_ready()
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join()
+        return False
+
+
 def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
               algos=("psum", "rs_ag", "ring"),
-              inner: int = 4, reps: int = 2) -> TopologyProfile:
+              inner: int = 4, reps: int = 2,
+              concurrent: bool = False) -> TopologyProfile:
     """Fit a ``TopologyProfile`` by timing real collectives per axis of
     ``mesh`` (the calibration pass), plus one quantize/dequantize
     round-trip for the compute half of the compressed-hop cost (shared
     across axes — it runs on the device, not the link).  Axes of size 1
-    get a zero-cost link (nothing ever crosses them)."""
+    get a zero-cost link (nothing ever crosses them).
+
+    ``concurrent=True`` (round 20) runs the quantize ladder and the
+    per-axis collective ladders against a background matmul stream
+    (``_BackgroundMatmul``), so the fits reflect a BUSY device — the
+    regime the sync actually runs in (collectives compete with backward
+    compute for the same cores/MXU).  The idle quantize rate is always
+    measured first; the busy-vs-idle delta lands in
+    ``concurrent_delta_pct`` and ``measured['concurrent']`` (recorded in
+    BASELINE round 20)."""
+    import contextlib
     import time
 
     import jax
 
     t0 = time.perf_counter()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    quant = _time_quantize()
+    quant_idle = _time_quantize()
     recompute = _time_recompute()
-    links: dict[str, LinkModel] = {}
-    measured: dict[str, dict] = {"quantize_s_per_byte": quant,
-                                 "recompute_s_per_byte": recompute}
-    for axis, n in sizes.items():
-        if n < 2:
-            links[axis] = LinkModel(alpha_s=0.0, beta_s_per_byte=0.0)
-            continue
-        obs: list[tuple[float, float, float]] = []
-        raw: dict[str, dict] = {}
-        for algo in algos:
-            raw[algo] = {}
-            for b in payload_bytes:
-                t = _time_axis_collective(mesh, axis, b, algo,
-                                          inner=inner, reps=reps)
-                launches, wire_per_byte = _algo_factors(algo, n)
-                obs.append((launches, wire_per_byte * b, t))
-                raw[algo][str(b)] = t
-        links[axis] = dataclasses.replace(fit_alpha_beta(obs),
-                                          quant_s_per_byte=quant)
-        measured[axis] = raw
+    stream = _BackgroundMatmul() if concurrent else contextlib.nullcontext()
+    concurrent_delta = None
+    with stream:
+        quant = _time_quantize() if concurrent else quant_idle
+        if concurrent:
+            concurrent_delta = (quant / quant_idle - 1.0) * 100.0
+        links: dict[str, LinkModel] = {}
+        measured: dict[str, dict] = {"quantize_s_per_byte": quant,
+                                     "recompute_s_per_byte": recompute}
+        if concurrent:
+            measured["concurrent"] = {
+                "quantize_s_per_byte_idle": quant_idle,
+                "quantize_s_per_byte_busy": quant,
+                "delta_pct": concurrent_delta}
+        for axis, n in sizes.items():
+            if n < 2:
+                links[axis] = LinkModel(alpha_s=0.0, beta_s_per_byte=0.0)
+                continue
+            obs: list[tuple[float, float, float]] = []
+            raw: dict[str, dict] = {}
+            for algo in algos:
+                raw[algo] = {}
+                for b in payload_bytes:
+                    t = _time_axis_collective(mesh, axis, b, algo,
+                                              inner=inner, reps=reps)
+                    launches, wire_per_byte = _algo_factors(algo, n)
+                    obs.append((launches, wire_per_byte * b, t))
+                    raw[algo][str(b)] = t
+            links[axis] = dataclasses.replace(fit_alpha_beta(obs),
+                                              quant_s_per_byte=quant)
+            measured[axis] = raw
     tel = telemetry.active()
     if tel is not None:
         # calibration on the unified timeline (round 13): when, how
@@ -519,8 +611,10 @@ def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
     return TopologyProfile(
         version=PROFILE_VERSION,
         device_kind=getattr(jax.devices()[0], "device_kind", "cpu"),
-        axes=sizes, links=links, source="calibrated", measured=measured,
-        recompute_s_per_byte=recompute)
+        axes=sizes, links=links,
+        source="calibrated:concurrent" if concurrent else "calibrated",
+        measured=measured, recompute_s_per_byte=recompute,
+        concurrent_delta_pct=concurrent_delta)
 
 
 def get_profile(spec, axes: dict[str, int], *, cache_dir: str | None = None,
@@ -648,7 +742,14 @@ class SyncPlan:
     hop runs once per ``sync_every`` steps, so ``predicted_ms`` is the
     AMORTIZED per-step figure (dcn term divided by the window) when the
     interval is > 1; ``per_axis`` stays per-EXCHANGE so the dcn row
-    remains comparable to the inspector's boundary-step bytes."""
+    remains comparable to the inspector's boundary-step bytes.
+
+    ``route`` (round 20) is the declarative hop-graph this plan
+    executes, in ``parallel/routing`` grammar (e.g. ``ici:rs →
+    dcn:ring[int8+ef] → ici:ag``) — attached to every 2-level plan the
+    legacy choosers emit and to the routed plans ``choose_sync_plan``
+    searches; ``per_hop`` carries one cost row per hop (AxisPlan with
+    the hop label in ``axis``) for plans priced by the route model."""
 
     strategy: str
     bucket_mb: float
@@ -660,6 +761,8 @@ class SyncPlan:
     profile_source: str
     census_bytes: int
     sync_every: int = 1
+    route: str = ""
+    per_hop: tuple = ()
 
     def axis(self, name: str) -> AxisPlan | None:
         for ap in self.per_axis:
@@ -669,14 +772,20 @@ class SyncPlan:
 
     def summary(self) -> dict:
         """Compact JSON-able form (the bench's train_autotune_plan)."""
-        return {"strategy": self.strategy, "bucket_mb": self.bucket_mb,
-                "dcn_compress": self.dcn_compress,
-                "dcn_size": self.dcn_size, "overlap": self.overlap,
-                "sync_every": self.sync_every,
-                "predicted_ms": round(self.predicted_ms, 4),
-                "profile": self.profile_source,
-                "bytes_by_axis": {ap.axis: ap.predicted_bytes
-                                  for ap in self.per_axis}}
+        out = {"strategy": self.strategy, "bucket_mb": self.bucket_mb,
+               "dcn_compress": self.dcn_compress,
+               "dcn_size": self.dcn_size, "overlap": self.overlap,
+               "sync_every": self.sync_every,
+               "predicted_ms": round(self.predicted_ms, 4),
+               "profile": self.profile_source,
+               "bytes_by_axis": {ap.axis: ap.predicted_bytes
+                                 for ap in self.per_axis}}
+        if self.route:
+            out["route"] = self.route
+        if self.per_hop:
+            out["bytes_by_hop"] = {hp.axis: hp.predicted_bytes
+                                   for hp in self.per_hop}
+        return out
 
     def table(self) -> str:
         """Printable explanation: one row per axis + the decision line."""
@@ -695,6 +804,13 @@ class SyncPlan:
                 f"| {ap.axis} | {ap.algorithm} | {ap.launches} | "
                 f"{ap.predicted_bytes / 1e6:.2f} | "
                 f"{ap.predicted_ms:.3f} |")
+        if self.route:
+            lines.append(f"route: {self.route}")
+        for hp in self.per_hop:
+            lines.append(
+                f"|   hop {hp.axis} | {hp.algorithm} | {hp.launches} | "
+                f"{hp.predicted_bytes / 1e6:.2f} | "
+                f"{hp.predicted_ms:.3f} |")
         return "\n".join(lines)
 
 
@@ -911,6 +1027,207 @@ def predict_named(name: str, census: GradCensus, profile: TopologyProfile,
 
 
 # ---------------------------------------------------------------------------
+# the route model (round 20): price hop-graphs, not strategy names
+
+
+def _axis_parts(axis: str, sizes: dict) -> list[tuple[str, int]]:
+    """Constituent (link, size) pairs of a hop axis.  Route enumeration
+    writes joint axes as 'a+b' (a flat collective over a factored mesh
+    crosses every constituent link); single axes pass through."""
+    return [(a, int(sizes.get(a, 1))) for a in axis.split("+")]
+
+
+def price_route(route, census: GradCensus, profile: TopologyProfile, *,
+                bucket_mb: float = strat.BUCKET_CAP_MB,
+                overlap: bool = False) -> dict:
+    """Predicted cost of executing ``route`` (a ``routing.HopPlan``) for
+    this census on this profile — the hop-graph generalization of
+    ``predict_named``: each hop is priced with its axis' LinkModel
+    alpha-beta fit plus the quantize-compute term of ring hops, payloads
+    divided by every enclosing reduce-scatter.  Returns ``{"ms_total",
+    "ms_exposed", "per_axis", "per_hop", "n_buckets"}`` where
+    ``per_hop`` has one AxisPlan per hop (labelled ``axis:algo`` in
+    route grammar) and ``per_axis`` aggregates hop rows per mesh axis —
+    the inspector-comparable accounting ``plan_bytes_vs_schedule``
+    cross-checks."""
+    links = profile.links
+    sizes = profile.axes
+    bucket_bytes = int(bucket_mb * 1024 * 1024)
+    if route.compressed or overlap:
+        bucket_elems = [b // 4 for b in census.bucket_plan(bucket_bytes)]
+    else:
+        # the post-backward plain path flattens the whole tree once
+        bucket_elems = [census.total_bytes // 4]
+    n_buckets = len(bucket_elems)
+    # per hop: [op_bytes, launches, launch_ms, wire_ms, quant_ms]
+    acc = [[0, 0, 0.0, 0.0, 0.0] for _ in route.hops]
+    for e0 in bucket_elems:
+        e = e0
+        stack: list[tuple[int, int]] = []
+        for hi, hop in enumerate(route.hops):
+            parts = _axis_parts(hop.axis, sizes)
+            n = int(np.prod([ni for _, ni in parts]))
+            active = [(a, ni) for a, ni in parts if ni > 1]
+            if hop.kind == "rs":
+                padded = e + (-e) % max(n, 1)
+                if n > 1 and hop.algorithm == "scatter" and active:
+                    acc[hi][0] += padded * 4
+                    acc[hi][1] += 1
+                    acc[hi][2] += sum(links[a].alpha_s
+                                      for a, _ in active) * 1e3
+                    acc[hi][3] += sum(
+                        padded * 4 * (ni - 1) / ni
+                        * links[a].beta_s_per_byte
+                        for a, ni in active) * 1e3
+                # 'slice' is free: the value is already replicated
+                stack.append((padded, n))
+                e = padded // max(n, 1)
+            elif hop.kind == "exchange":
+                if not active:
+                    continue  # degraded tier: nothing crosses
+                if hop.bits == "f32":
+                    acc[hi][0] += e * 4
+                    acc[hi][1] += 1
+                    acc[hi][2] += sum(links[a].alpha_s
+                                      for a, _ in active) * 1e3
+                    acc[hi][3] += sum(
+                        2 * e * 4 * (ni - 1) / ni
+                        * links[a].beta_s_per_byte
+                        for a, ni in active) * 1e3
+                else:
+                    b, l, q = _quant_ring_bytes(e, n, hop.bits)
+                    acc[hi][0] += b
+                    acc[hi][1] += l
+                    acc[hi][2] += l * sum(links[a].alpha_s
+                                          for a, _ in active) * 1e3
+                    # ppermute payloads cross every constituent link
+                    acc[hi][3] += b * sum(links[a].beta_s_per_byte
+                                          for a, _ in active) * 1e3
+                    acc[hi][4] += q * max(links[a].quant_s_per_byte
+                                          for a, _ in active) * 1e3
+            else:  # 'ag'
+                padded, n2 = stack.pop()
+                if n2 > 1 and active:
+                    acc[hi][1] += 1
+                    acc[hi][2] += sum(links[a].alpha_s
+                                      for a, _ in active) * 1e3
+                    if _GATHER_FALLBACK:
+                        acc[hi][0] += padded * 4
+                        acc[hi][3] += sum(
+                            2 * padded * 4 * (ni - 1) / ni
+                            * links[a].beta_s_per_byte
+                            for a, ni in active) * 1e3
+                    else:
+                        acc[hi][0] += e * 4
+                        acc[hi][3] += sum(
+                            e * 4 * (ni - 1)
+                            * links[a].beta_s_per_byte
+                            for a, ni in active) * 1e3
+                e = padded
+    per_hop: list[AxisPlan] = []
+    by_axis: dict[str, list[float]] = {}
+    for hop, (ob, la, lm, wm, qm) in zip(route.hops, acc):
+        ms = lm + wm + qm
+        per_hop.append(AxisPlan(
+            axis=hop.describe(), algorithm=f"{hop.kind}/{hop.algorithm}",
+            launches=int(la), predicted_bytes=int(ob), predicted_ms=ms))
+        row = by_axis.setdefault(hop.axis, [0, 0, 0.0, []])
+        row[0] += int(ob)
+        row[1] += int(la)
+        row[2] += ms
+        row[3].append(hop.describe().split(":", 1)[1])
+    per_axis = [AxisPlan(axis=a, algorithm="+".join(r[3]),
+                         launches=int(r[1]), predicted_bytes=int(r[0]),
+                         predicted_ms=r[2])
+                for a, r in by_axis.items()]
+    ms_total = sum(hp.predicted_ms for hp in per_hop)
+    launch_ms = sum(a[2] for a in acc)
+    if overlap and n_buckets > 0:
+        # all but the last bucket's wire hides under backward compute
+        ms_exposed = launch_ms + (ms_total - launch_ms) / n_buckets
+    else:
+        ms_exposed = ms_total
+    return {"ms_total": ms_total, "ms_exposed": ms_exposed,
+            "per_axis": per_axis, "per_hop": per_hop,
+            "n_buckets": n_buckets}
+
+
+def _route_label(name: str, compress: str | None,
+                 profile: TopologyProfile) -> str:
+    """The route-grammar description of a NAMED strategy choice — how
+    the legacy choosers' outputs read as hop-graphs (the 2-level plans
+    are literally executed through ``parallel/routing`` now)."""
+    axes = list(profile.axes)
+    flat = "+".join(axes) if len(axes) > 1 else (axes[0] if axes
+                                                 else "data")
+    x = f"ring[{compress}+ef]" if compress else "psum"
+    if name == "hierarchical":
+        fast = next((a for a in axes if a != "dcn"), "ici")
+        if "dcn" in profile.axes:
+            return f"{fast}:rs → dcn:{x} → {fast}:ag"
+        return f"{fast}:rs → {fast}:ag"
+    if name.startswith("two_level"):
+        return f"data:rs → dcn:{x} → data:ag"
+    if name in ("ddp", "bucketed", "flat_autodiff_psum"):
+        return f"{flat}:psum"
+    if name in ("quantized_ring", "quantized_ring_ef"):
+        return f"{flat}:ring[int8+ef]"
+    return ""
+
+
+def choose_sync_plan(census: GradCensus, profile: TopologyProfile, *,
+                     ladder: tuple = BUCKET_LADDER_MB,
+                     overlap: bool = False,
+                     max_sync_every: int = 1,
+                     steps_per_loop: int | None = None) -> SyncPlan:
+    """The route chooser (round 20): enumerate every hop-graph over the
+    profile's axes (``routing.enumerate_routes`` — flat, every 2-level
+    split, and the nested/sequential 3-level shapes on ≥3-level meshes,
+    each at every slow-hop precision), price each with
+    ``price_route`` at every ladder bucket size, and return the
+    cheapest as an explainable routed ``SyncPlan`` (``route`` +
+    ``per_hop`` populated).  Axes are ordered fastest→slowest by fitted
+    inverse bandwidth, so 'nested' always reduces over the cheap links
+    first.  Candidate order breaks exact ties toward the simpler route
+    (enumeration emits flat, then 2-level, then 3-level).  Local-SGD
+    amortization (``max_sync_every``) widens the window against the
+    SLOWEST tier's hop cost — the 3-level generalization of round 18's
+    dcn rule.  Deterministic given a profile (test-pinned on
+    ``uniform``/``wan_dcn``/``ici_dcn_wan``)."""
+    from . import routing
+
+    fast_first = tuple(sorted(
+        profile.axes,
+        key=lambda a: (profile.links[a].beta_s_per_byte,
+                       profile.links[a].alpha_s, a)))
+    slowest = fast_first[-1]
+    best: SyncPlan | None = None
+    for route in routing.enumerate_routes(fast_first):
+        for mb in ladder:
+            pred = price_route(route, census, profile, bucket_mb=mb,
+                               overlap=overlap)
+            ring_bits = [h.bits for h in route.hops
+                         if h.kind == "exchange" and h.bits != "f32"]
+            plan = SyncPlan(
+                strategy="routed", bucket_mb=mb,
+                dcn_compress=ring_bits[-1] if ring_bits else None,
+                dcn_size=profile.axes.get("dcn", 1), overlap=overlap,
+                predicted_ms=pred["ms_exposed"],
+                per_axis=tuple(pred["per_axis"]),
+                profile_source=profile.source,
+                census_bytes=census.total_bytes,
+                route=route.describe(), per_hop=tuple(pred["per_hop"]))
+            plan = _interval_for(plan, max_sync_every,
+                                 align=steps_per_loop,
+                                 slow_axis=slowest)
+            if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
+                best = plan
+    assert best is not None
+    _emit_plan(best, side="routed")
+    return best
+
+
+# ---------------------------------------------------------------------------
 # the chooser
 
 
@@ -921,11 +1238,13 @@ def _mk_plan(name, pred, *, bucket_mb, dcn_compress, dcn_size, overlap,
         dcn_size=dcn_size, overlap=overlap,
         predicted_ms=pred["ms_exposed"],
         per_axis=tuple(pred["per_axis"]),
-        profile_source=profile.source, census_bytes=census.total_bytes)
+        profile_source=profile.source, census_bytes=census.total_bytes,
+        route=_route_label(name, dcn_compress, profile))
 
 
 def _interval_for(plan: SyncPlan, max_sync_every: int,
-                  *, align: int | None = None) -> SyncPlan:
+                  *, align: int | None = None,
+                  slow_axis: str = "dcn") -> SyncPlan:
     """Attach the local-SGD interval dimension (round 18) to a candidate
     plan: widen the window H (powers of 2, up to ``max_sync_every``)
     while the slow hop's AMORTIZED cost still dominates the per-step
@@ -940,11 +1259,11 @@ def _interval_for(plan: SyncPlan, max_sync_every: int,
     amortized per-step figure; the per-axis rows stay per-exchange."""
     if max_sync_every <= 1:
         return plan
-    dcn = plan.axis("dcn")
+    dcn = plan.axis(slow_axis)
     if dcn is None or dcn.predicted_ms <= 0.0:
         return plan
     ici_ms = sum(ap.predicted_ms for ap in plan.per_axis
-                 if ap.axis != "dcn")
+                 if ap.axis != slow_axis)
     h = 1
     while (2 * h <= max_sync_every
            and (align is None or align % (2 * h) == 0)

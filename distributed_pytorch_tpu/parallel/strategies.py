@@ -847,44 +847,42 @@ class Hierarchical:
         same chunk length as the per-step exchange, so the EF residual
         layout and ``init_state`` are unchanged), gathers back over
         ``ici``, and divides by the slice count.  Stateful form returns
-        ``(mean_delta, new_residual)``."""
+        ``(mean_delta, new_residual)``.
+
+        Round 20: the window is the routed plan ``ici:slice → [dcn
+        exchange] → ici:ag`` (the 'slice' rs algorithm encodes
+        "already replicated within the slice") executed per bucket by
+        ``parallel/routing.execute`` — same ops, same EF layout."""
+        from . import routing
         dcn, ici = self._factor(axis)
         n_dcn = lax.axis_size(dcn) if dcn else 1
         n_ici = lax.axis_size(ici)
-        me = lax.axis_index(ici)
         leaves, treedef = jax.tree.flatten(delta)
         out: list[jax.Array | None] = [None] * len(leaves)
         segs = self._segments(leaves, n_dcn, n_ici)
+        hops: list = [routing.Hop("rs", ici, algorithm="slice")]
+        if dcn is not None:
+            hops.append(routing.Hop("exchange", dcn))
+        hops.append(routing.Hop("ag", ici))
+        plan = routing.HopPlan(tuple(hops))
         new_parts, offset = [], 0
         for bucket, seg in zip(make_bucket_plan(leaves, self.bucket_bytes),
                                segs):
             sub = [leaves[i] for i in bucket]
-            flat = jnp.concatenate([g.ravel().astype(jnp.float32)
-                                    for g in sub])
-            total = flat.size
-            padded = jnp.pad(flat, (0, (-total) % n_ici))
-            chunk = padded.size // n_ici
-            shard = lax.dynamic_slice(padded, (me * chunk,), (chunk,))
-            if self.dcn_compress is None:
-                if dcn is not None:
-                    shard = lax.psum(shard, dcn)
-            else:
+            overrides = None
+            captured: dict = {}
+            if self.dcn_compress is not None:
                 residual = sync_state[offset:offset + seg]
-                if n_dcn == 1:
-                    new_parts.append(jnp.zeros_like(residual))
-                else:
-                    shard, err_rows = self._ring._ring_sum(
-                        shard, dcn, n_dcn, residual=residual)
-                    new_parts.append(err_rows.ravel())
                 offset += seg
-            if _all_gather_inv is not None:
-                full = _all_gather_inv(shard, ici, axis=0, tiled=True)
-            else:
-                buf = jnp.zeros_like(padded)
-                buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
-                full = lax.psum(buf, ici)
-            mean = full[:total] * (1.0 / n_dcn)
-            synced = self._split(mean, sub)
+                if dcn is not None:
+                    overrides = {dcn: self._int8_dcn_reduce(
+                        dcn, n_dcn, residual, captured)}
+                else:  # degraded topology: nothing crosses, no loss
+                    captured["res"] = jnp.zeros_like(residual)
+            synced, _ = routing.execute(plan, sub, scale=1.0 / n_dcn,
+                                        overrides=overrides)
+            if self.dcn_compress is not None:
+                new_parts.append(captured["res"])
             for i, s in zip(bucket, synced):
                 out[i] = s
         tree = jax.tree.unflatten(treedef, out)
@@ -943,38 +941,24 @@ def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
     stock hop; a ppermute-based ``dcn_reduce`` forfeits the proof — see
     ``Hierarchical.vma_opaque``).  Shared with the LM trainer's
     factored-mesh gradient sync (lm.py dcn_size), whose jaxpr test pins
-    the shard-sized DCN payload."""
-    n_ici = lax.axis_size(ici)
-    leaves, treedef = jax.tree.flatten(grads)
-    flat = jnp.concatenate(
-        [g.ravel().astype(jnp.float32) for g in leaves])
-    total = flat.size
-    padded = jnp.pad(flat, (0, (-total) % n_ici))
-    # 1. reduce-scatter within the slice (fast link, 1x payload)
-    shard = lax.psum_scatter(padded, ici, scatter_dimension=0, tiled=True)
-    # 2. cross-slice all-reduce of the shard (slow link, payload/ici)
-    if dcn is not None:
-        shard = (dcn_reduce(shard) if dcn_reduce is not None
-                 else lax.psum(shard, dcn))
-    # 3. gather the sum back within the slice (fast link)
-    if _all_gather_inv is not None:
-        full = _all_gather_inv(shard, ici, axis=0, tiled=True)
-    else:
-        me = lax.axis_index(ici)
-        chunk = padded.size // n_ici
-        buf = jnp.zeros_like(padded)
-        buf = lax.dynamic_update_slice(buf, shard, (me * chunk,))
-        full = lax.psum(buf, ici)
-    summed = full[:total]
-    if scale is not None:
-        summed = summed * scale
+    the shard-sized DCN payload.
 
-    out, offset = [], 0
-    for g in leaves:
-        out.append(summed[offset:offset + g.size]
-                   .reshape(g.shape).astype(g.dtype))
-        offset += g.size
-    return jax.tree.unflatten(treedef, out)
+    Round 20: the hand-built loop is retired — the body is now the
+    2-level ``HopPlan`` ``ici:rs → [dcn:psum] → ici:ag`` compiled by
+    ``parallel/routing.execute``, which emits the identical op sequence
+    (pad → psum_scatter → exchange → all_gather_invariant → slice →
+    scale → split); every pre-existing bitwise pin on this function now
+    pins the route compiler transitively."""
+    from . import routing
+    hops: list = [routing.Hop("rs", ici)]
+    if dcn is not None:
+        hops.append(routing.Hop("exchange", dcn))
+    hops.append(routing.Hop("ag", ici))
+    overrides = ({dcn: dcn_reduce}
+                 if dcn is not None and dcn_reduce is not None else None)
+    synced, _ = routing.execute(routing.HopPlan(tuple(hops)), grads,
+                                scale=scale, overrides=overrides)
+    return synced
 
 
 # -- backward-overlapped gradient sync (round 8) ---------------------------

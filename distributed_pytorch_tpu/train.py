@@ -91,6 +91,15 @@ class TrainConfig:
     # the exact full-precision psum.  Rejected for strategies with no
     # DCN hop.
     dcn_compress: str | None = None
+    # Declarative sync route (round 20, parallel/routing.py): a route
+    # string in the hop grammar ("ici:rs → dcn:ring[int4+ef] → ici:ag";
+    # plain "->" works too) executed by RoutedSync instead of a named
+    # strategy.  Requires strategy="routed"; the route must be a 2-level
+    # ('dcn', 'ici') plan — the trainer's factored-mesh topology (3-tier
+    # wan routes run through the RoutedSync surface directly; the
+    # trainer's mesh recipe only builds two tiers).  Compression and EF
+    # live IN the route, so dcn_compress must stay None.
+    sync_route: str | None = None
     # Profile source for strategy="auto" (parallel/autotune.py): None =
     # load the repo-local cached profile for this topology or calibrate
     # and cache one; a synthetic preset name ("uniform",
@@ -678,7 +687,33 @@ class Trainer:
             cfg, self.sync_plan = autotune.resolve_train_auto(
                 cfg, num_devices=num_devices)
         self.cfg = cfg
-        self.strategy = strat.get(cfg.strategy)
+        if cfg.strategy == "routed" or cfg.sync_route is not None:
+            # declarative routed sync (round 20): the route string IS
+            # the strategy — parse it into a HopPlan and execute it with
+            # RoutedSync over the trainer's factored ('dcn', 'ici') mesh
+            from .parallel import routing
+            if cfg.strategy != "routed" or cfg.sync_route is None:
+                raise ValueError(
+                    "routed sync needs BOTH strategy='routed' and a "
+                    f"sync_route string (got strategy={cfg.strategy!r}, "
+                    f"sync_route={cfg.sync_route!r})")
+            if cfg.dcn_compress is not None:
+                raise ValueError(
+                    "strategy='routed' encodes compression in the route "
+                    "itself (e.g. 'dcn:ring[int4+ef]'); dcn_compress "
+                    "must stay None")
+            route_plan = routing.parse_route(cfg.sync_route)
+            if route_plan.mesh_axes() != ("dcn", "ici"):
+                raise ValueError(
+                    f"the trainer's mesh recipe builds two tiers "
+                    f"('dcn', 'ici'); route {route_plan.describe()!r} "
+                    f"spans {route_plan.mesh_axes()} — run other "
+                    f"topologies through RoutedSync directly")
+            self.strategy = routing.RoutedSync(
+                route_plan,
+                n_by_axis=None)  # bound below, from the built mesh
+        else:
+            self.strategy = strat.get(cfg.strategy)
         self.data_axes = getattr(self.strategy, "axes", None) or DATA_AXIS
         if self.strategy.needs_mesh and mesh is None:
             if isinstance(self.data_axes, tuple):
@@ -712,6 +747,11 @@ class Trainer:
                     f"matching the config (or mesh=None to build one)")
         self.mesh = mesh if self.strategy.needs_mesh else None
         self.n_replicas = self.mesh.devices.size if self.mesh else 1
+        if self.mesh is not None and hasattr(self.strategy, "n_by_axis"):
+            # RoutedSync sizes its EF state from static per-axis extents
+            self.strategy.n_by_axis = dict(
+                zip(self.mesh.axis_names,
+                    (int(s) for s in self.mesh.devices.shape)))
         # strategy knobs must land before init_state (dcn compression
         # flips statefulness and the EF residual layout follows the
         # bucket plan + dcn_size) and fail fast on incapable strategies

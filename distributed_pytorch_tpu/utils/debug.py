@@ -199,8 +199,47 @@ def per_axis_collective_stats(sched: list[dict],
             for a in axes}
 
 
+def per_hop_collective_stats(sched: list[dict],
+                             min_bytes: int = 0) -> dict[str, dict]:
+    """``collective_stats`` split BY HOP — one row per (mesh-axes,
+    primitive) pair, keyed ``"axis:prim"`` in the routing grammar's
+    spirit (``parallel/routing``): a 3-hop routed sync traces as e.g.
+    ``{"ici:psum_scatter": ..., "dcn:ppermute": ..., "wan:ppermute":
+    ..., "ici:all_gather": ...}``, so each hop of a ``HopPlan`` is
+    attributable separately even when two hops share a mesh axis (the
+    reduce-scatter and the all-gather of the same bracket).  A
+    collective spanning several axes at once keys them joined with
+    ``"+"`` (``"data+expert:psum"``) — the same joint-axis spelling the
+    route grammar uses for flat plans.  Stats fields match
+    ``collective_stats`` (round 20, the per-hop side of
+    ``plan_bytes_vs_schedule``)."""
+    compute_idx = [i for i, r in enumerate(sched) if r["kind"] == "compute"]
+    first_c = compute_idx[0] if compute_idx else None
+    last_c = compute_idx[-1] if compute_idx else None
+    out: dict[str, dict] = {}
+    for i, r in enumerate(sched):
+        if r["kind"] != "collective" or r["bytes"] < min_bytes:
+            continue
+        key = "+".join(sorted(r["axes"])) + ":" + r["prim"]
+        row = out.setdefault(key, {
+            "total": 0, "interleaved": 0, "tail": 0, "bytes": 0,
+            "compute": len(compute_idx), "executions": 0,
+            "bytes_executed": 0})
+        trips = r.get("trips", 1)
+        row["total"] += 1
+        row["bytes"] += r["bytes"]
+        row["executions"] += trips
+        row["bytes_executed"] += r["bytes"] * trips
+        if last_c is None or i > last_c:
+            row["tail"] += 1
+        elif first_c is not None and i > first_c:
+            row["interleaved"] += 1
+    return out
+
+
 def amortized_axis_bytes(entries, steps: int,
-                         min_bytes: int = 0) -> dict[str, float]:
+                         min_bytes: int = 0, *,
+                         by_hop: bool = False) -> dict[str, float]:
     """Per-axis wire bytes PER STEP of a multi-program step family:
     ``entries`` is an iterable of ``(sched, multiplicity)`` pairs — each
     jaxpr schedule weighted by how many times it runs over a ``steps``-
@@ -213,11 +252,16 @@ def amortized_axis_bytes(entries, steps: int,
     ``amortized_axis_bytes([(local, H), (exchange, 1)], H)`` gives the
     honest dcn-axis bytes/step to compare against the per-step path's
     ``amortized_axis_bytes([(step, 1)], 1)`` — the ~1/H scaling pin
-    (tests/test_localsgd.py, the __graft_entry__ dryrun leg)."""
+    (tests/test_localsgd.py, the __graft_entry__ dryrun leg).
+
+    ``by_hop=True`` (round 20) keys the result per HOP instead of per
+    axis (``per_hop_collective_stats``'s ``"axis:prim"`` keys) — the
+    3-axis-mesh accounting that keeps routed ``HopPlan`` predictions
+    checkable hop-by-hop against emitted programs."""
+    split = per_hop_collective_stats if by_hop else per_axis_collective_stats
     totals: dict[str, float] = {}
     for sched, mult in entries:
-        for axis, stats in per_axis_collective_stats(
-                sched, min_bytes=min_bytes).items():
+        for axis, stats in split(sched, min_bytes=min_bytes).items():
             totals[axis] = (totals.get(axis, 0.0)
                             + float(stats["bytes_executed"]) * mult)
     return {a: b / float(steps) for a, b in totals.items()}
@@ -288,8 +332,22 @@ class ConsistencyError(AssertionError):
     """A data-parallel training invariant was violated."""
 
 
+# Route-grammar hop operations (parallel/routing.Hop.describe()'s part
+# after the ":", bracket suffix stripped) -> the jaxpr primitives that
+# hop lowers to.  "ag" lists psum too: the legacy-runtime gather
+# fallback emits a masked psum instead of all_gather (strategies.py).
+_HOP_OP_PRIMS = {
+    "rs": ("psum_scatter", "reduce_scatter"),
+    "slice": (),            # local dynamic_slice — no collective
+    "ag": ("all_gather", "psum"),
+    "psum": ("psum", "psum2"),
+    "ring": ("ppermute",),
+}
+
+
 def plan_bytes_vs_schedule(plan, sched: list[dict], *,
-                           min_bytes: int = 1024) -> dict[str, dict]:
+                           min_bytes: int = 1024,
+                           by_hop: bool = False) -> dict[str, dict]:
     """Predicted-vs-measured wire accounting for an autotuner SyncPlan
     (parallel/autotune.py) against a traced step's schedule: for each
     axis the plan predicts traffic on, pair its ``predicted_bytes``
@@ -298,9 +356,33 @@ def plan_bytes_vs_schedule(plan, sched: list[dict], *,
     ``bytes_executed`` of that axis's collectives (``min_bytes`` filters
     the scalar loss/health reductions, as everywhere).  Returns
     ``{axis: {"predicted": int, "measured": int, "ratio": float}}`` —
-    the cost model's ground-truth check (round 11)."""
+    the cost model's ground-truth check (round 11).
+
+    ``by_hop=True`` (round 20) compares the plan's ``per_hop`` rows
+    instead (route-model plans only — ``plan.per_hop`` must be
+    populated): each hop label (``"dcn:ring[int4+ef]"``) is matched to
+    the measured ``per_hop_collective_stats`` rows for its axis and the
+    primitives that hop kind lowers to, so a 3-axis routed sync is
+    checkable hop-by-hop, not just axis-by-axis.  Hops predicting no
+    bytes (a ``slice`` reduce-scatter, a degraded size-1 tier) are
+    skipped, same as zero-byte axes."""
+    if by_hop:
+        measured_hops = per_hop_collective_stats(sched, min_bytes=min_bytes)
+        out: dict[str, dict] = {}
+        for hp in getattr(plan, "per_hop", ()) or ():
+            if hp.predicted_bytes <= 0:
+                continue
+            axis, _, op = hp.axis.partition(":")
+            prims = _HOP_OP_PRIMS.get(op.split("[", 1)[0], ())
+            measured = sum(
+                measured_hops.get(f"{axis}:{p}", {}).get("bytes_executed", 0)
+                for p in prims)
+            out[hp.axis] = {"predicted": int(hp.predicted_bytes),
+                            "measured": int(measured),
+                            "ratio": measured / hp.predicted_bytes}
+        return out
     per_axis = per_axis_collective_stats(sched, min_bytes=min_bytes)
-    out: dict[str, dict] = {}
+    out = {}
     for ap in plan.per_axis:
         if ap.predicted_bytes <= 0:
             continue

@@ -80,6 +80,10 @@ RULES: dict[str, tuple[str, float]] = {
     # absolute ceiling below so the tax stays decisively under a
     # decode step
     "fleet_rpc_overhead_ms": ("lower", 0.50),
+    # round 20: routed hop-graph wire bytes per step — deterministic
+    # (schedule-inspector payload accounting, no timing noise), same
+    # tight band as the round-16 dcn-int4 byte key
+    "train_routed_bytes_per_step": ("lower", 0.02),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,

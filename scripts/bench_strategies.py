@@ -42,6 +42,7 @@ import numpy as np  # noqa: E402
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_pytorch_tpu.parallel import autotune  # noqa: E402
+from distributed_pytorch_tpu.parallel import routing  # noqa: E402
 from distributed_pytorch_tpu.parallel import strategies as strat  # noqa: E402
 from distributed_pytorch_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_pytorch_tpu.train import TrainConfig, Trainer  # noqa: E402
@@ -152,9 +153,24 @@ def bench_strategy(name: str) -> tuple[float, dict, bool]:
     compression on the wire: ~9.23 MB f32 -> ~2.34 MB int8 -> ~1.17 MB
     int4 over DCN for VGG11, inspector-measured."""
     compress = None
+    route = None
     if name in ("hierarchical_int8", "hierarchical_int4"):
         name, compress = "hierarchical", name.rsplit("_", 1)[1]
-    if name == "auto":
+    if name == "routed_int4":
+        # the routed row (round 20): the 2-level int4 route through the
+        # declarative hop-graph executor (parallel/routing.py) — the
+        # SAME wire program as the hierarchical_int4 row above it,
+        # declared as a route string instead of hand-built
+        name = "routed"
+        route = "ici:rs → dcn:ring[int4+ef] → ici:ag"
+    if name == "routed":
+        factored = True
+        cfg = TrainConfig(strategy="routed", sync_route=route,
+                          batch_size=PER_DEV_BATCH, augment=False,
+                          dcn_size=2)
+        tr = Trainer(cfg)
+        overlap = False
+    elif name == "auto":
         # the autotuner row (round 11): resolve from the CPU-calibrated
         # factored profile, then measure the resolved plan like any row
         factored = True
@@ -192,6 +208,13 @@ def bench_strategy(name: str) -> tuple[float, dict, bool]:
         tr.cfg.overlap_bucket_mb)
     if name == "auto":
         comm["resolved"] = tr.sync_plan.summary()
+    if name == "routed":
+        # price the route with the hop-graph cost model and record the
+        # route string next to the row's measured per-axis bytes
+        priced = autotune.price_route(
+            routing.parse_route(route), _census(), _profile_for(2))
+        comm["predicted_ms"] = priced["ms_total"]
+        comm["route"] = route
     times = []
     for _ in range(WINDOW):
         t0 = time.perf_counter()
@@ -435,7 +458,8 @@ def bench_lm_pp(pp_size: int = 2,
 
 def main() -> None:
     names = ["none", "ddp", "bucketed", "hierarchical", "hierarchical_int8",
-             "hierarchical_int4", "all_reduce", "gather_scatter_symmetric",
+             "hierarchical_int4", "routed_int4", "all_reduce",
+             "gather_scatter_symmetric",
              "gather_scatter", "quantized", "quantized_ring",
              "quantized_ring_ef", "auto"]
     results: dict[str, float] = {}

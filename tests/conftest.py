@@ -171,3 +171,15 @@ def pytest_configure(config):
         "SLO widen->narrow actuator).  All localsgd tests are fast and "
         "ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic`/`fleet`/`monitor`/`memory` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "routing: multi-hop collective-routing lane (round 20) — "
+        "`pytest -m routing` runs the hop-graph machinery (tests/"
+        "test_routing.py: route grammar/validation refusals, the routed "
+        "executor's bitwise pins vs the hand-built two_level/"
+        "hierarchical paths, the hop-boundary EF invariant on 2- and "
+        "3-axis meshes, the route chooser matrix on uniform/wan_dcn/"
+        "ici_dcn_wan, per-hop inspector accounting, the PROFILE_VERSION "
+        "3->4 recalibrate path).  All routing tests are fast and ride "
+        "tier-1 via `-m 'not slow'` (wired like the `faults`/`elastic`/"
+        "`fleet`/`monitor`/`memory`/`localsgd` lanes).")

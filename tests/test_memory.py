@@ -346,10 +346,12 @@ def test_memory_plan_refuses_unfittable_budget():
 
 @pytest.mark.quick
 def test_profile_carries_recompute_rate():
-    """PROFILE_VERSION 3: the calibrated recompute rate rides the
+    """Since PROFILE_VERSION 3 the calibrated recompute rate rides the
     profile like quant_s_per_byte (serde round-trip; absent key loads as
-    0.0 so a v2 JSON is simply re-calibrated by the version gate)."""
-    assert at.PROFILE_VERSION == 3
+    0.0 so an older JSON is simply re-calibrated by the version gate;
+    version currently 4 — the round-20 concurrent-calibration bump,
+    pinned in tests/test_routing.py)."""
+    assert at.PROFILE_VERSION == 4
     prof = at.synthetic_profile("uniform", {"data": 8})
     assert prof.recompute_s_per_byte > 0
     back = at.TopologyProfile.from_json(prof.to_json())
