@@ -832,6 +832,126 @@ def bench_train_routed(batch_per_replica: int = 64, iters: int = 30,
             "bytes_by_hop": by_hop, "bytes_per_step": bytes_per_step}
 
 
+def canon_moe_a2a_env(value: str | None) -> bool:
+    """Validate the BENCH_MOE_A2A knob (round 21): '1' runs the
+    quantized MoE dispatch A/B (f32 vs int8 expert all_to_all wire),
+    unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_MOE_A2A", value, default=False,
+        guess="whether to run the quantized MoE dispatch A/B")
+
+
+def bench_moe_a2a(train_steps: int = 30, batch: int = 8,
+                  seq: int = 256) -> dict | None:
+    """Quantized expert-dispatch A/B (round 21, BENCH_MOE_A2A=1): train
+    the small byte-LM as a Switch MoE over a dedicated ep=2 expert axis
+    TWICE from identical init — ``moe_dispatch_bits="f32"`` vs
+    ``"int8"`` (the routed ``expert:a2a@int8`` wire) — then report the
+    deterministic numbers bench_compare gates:
+
+    - ``bytes_per_step``: the int8 step program's all_to_all wire bytes
+      (utils/debug.py op_schedule; quantized payload + bitcast f32
+      scale rows ride ONE exchange per direction);
+    - ``dispatch_ratio``: int8/f32 all_to_all bytes — rowwise (d+4)/4d,
+      0.2539 at d_model=256, the <= 0.30 contract tests/test_a2a.py
+      pins;
+    - ``fliprate``: the round-16 flip-rate methodology applied to
+      DISPATCH quantization — teacher-force one held-out corpus batch
+      through the trained int8 model's sharded forward with f32 vs
+      int8 dispatch (identical params, identical routing inputs at the
+      first MoE layer) and count per-position argmax flips; routing
+      disagreement anywhere downstream of the first MoE layer
+      surfaces here.
+
+    Needs an even device count >= 2 (the ep=2 expert axis); returns
+    None (JSON nulls) otherwise."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_pytorch_tpu import lm as lm_mod
+    from distributed_pytorch_tpu.data import lm_corpus
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.utils import debug as dbg
+    from distributed_pytorch_tpu.utils.compat import shard_map
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        _log(f"[bench] moe-a2a A/B needs an even device count >= 2 "
+             f"(have {n_dev}); omitting")
+        return None
+    batch = max(batch, n_dev)
+    batch -= batch % n_dev  # shards over (data, expert)
+
+    def build(bits: str) -> LMTrainer:
+        model = tfm.TransformerConfig(
+            vocab_size=256, d_model=256, n_layers=4, n_heads=4,
+            head_dim=64, d_ff=512, n_experts=4,
+            moe_dispatch_bits=bits)
+        return LMTrainer(LMTrainConfig(model=model, dp=n_dev // 2,
+                                       ep=2, compute_dtype=None))
+
+    trainers = {"f32": build("f32"), "int8": build("int8")}
+    data = lm_corpus.encode(lm_corpus.synthetic_corpus(1 << 18, seed=3))
+    rng = np.random.default_rng(0)
+    losses: dict[str, list[float]] = {k: [] for k in trainers}
+    for _ in range(train_steps):
+        idx = rng.integers(0, len(data) - seq - 1, batch)
+        toks = np.stack([data[i:i + seq] for i in idx]).astype(np.int32)
+        tgts = np.stack([data[i + 1:i + seq + 1]
+                         for i in idx]).astype(np.int32)
+        for k, tr in trainers.items():  # identical batches both sides
+            losses[k].append(float(tr.train_step(toks, tgts)))
+
+    def a2a_bytes(tr: LMTrainer) -> int:
+        sched = dbg.op_schedule(tr.step_fn, tr.params, tr.opt_state,
+                                jnp.asarray(toks), jnp.asarray(tgts))
+        return int(sum(r["bytes"] for r in sched
+                       if r["kind"] == "collective"
+                       and r["prim"] == "all_to_all"))
+
+    bytes_f32 = a2a_bytes(trainers["f32"])
+    bytes_int8 = a2a_bytes(trainers["int8"])
+    ratio = bytes_int8 / max(bytes_f32, 1)
+
+    idx = rng.integers(0, len(data) - seq, batch)
+    held = jnp.asarray(np.stack([data[i:i + seq]
+                                 for i in idx]).astype(np.int32))
+    tr8 = trainers["int8"]
+    specs = lm_mod.param_specs(tr8.cfg)
+    bspec = lm_mod._lm_batch_spec(tr8.cfg)
+
+    def argmax_with(bits: str) -> np.ndarray:
+        mcfg = dataclasses.replace(tr8.cfg.model, moe_dispatch_bits=bits)
+
+        def local_fwd(params, tokens):
+            return tfm.apply(params, tokens, cfg=mcfg,
+                             tp_axis=lm_mod.MODEL, ep_axis=lm_mod.EXPERT)
+
+        sm = shard_map(local_fwd, mesh=tr8.mesh,
+                       in_specs=(specs, bspec), out_specs=P(*bspec, None))
+        return np.asarray(jnp.argmax(jax.jit(sm)(tr8.params, held),
+                                     axis=-1))
+
+    ref = argmax_with("f32")
+    q = argmax_with("int8")
+    flips = int((ref != q).sum())
+    total = int(ref.size)
+    _log(f"[bench] moe-a2a A/B (ep=2, {n_dev} dev): "
+         f"{bytes_int8} B/step int8 vs {bytes_f32} f32 -> "
+         f"ratio {ratio:.4f}; flip rate {flips}/{total} = "
+         f"{flips / total:.5f}; final loss f32 {losses['f32'][-1]:.4f} "
+         f"vs int8 {losses['int8'][-1]:.4f}")
+    return {"bytes_per_step": bytes_int8, "bytes_f32": bytes_f32,
+            "dispatch_ratio": ratio, "fliprate": flips / total,
+            "flips": flips, "positions": total,
+            "loss_f32": losses["f32"][-1],
+            "loss_int8": losses["int8"][-1]}
+
+
 def canon_telemetry_env(value: str | None) -> bool:
     """Validate the BENCH_TELEMETRY knob: '1' runs the round-13
     telemetry on/off A/B (CPU overhead of the unified event stream),
@@ -1721,6 +1841,10 @@ def main() -> None:
     # BENCH_ROUTE=1 runs choose-route -> RoutedSync trainer -> per-hop
     # byte accounting vs the hand-built hierarchical_int4 path.
     run_route = canon_route_env(os.environ.get("BENCH_ROUTE"))
+    # Quantized MoE dispatch knob (round 21), validated loudly
+    # pre-bench: BENCH_MOE_A2A=1 A/Bs f32 vs int8 expert all_to_all
+    # dispatch (wire bytes + the round-16 flip-rate gate).
+    run_moe_a2a = canon_moe_a2a_env(os.environ.get("BENCH_MOE_A2A"))
     # Elastic-recovery knob (round 12), validated loudly pre-bench:
     # BENCH_ELASTIC=1 measures the shrink->reshard->grow recovery gap.
     run_elastic = canon_elastic_env(os.environ.get("BENCH_ELASTIC"))
@@ -1835,6 +1959,16 @@ def main() -> None:
             route_ab = bench_train_routed()
         except Exception as e:
             _log(f"[bench] train-routed A/B failed ({e}); omitting")
+
+    # Quantized MoE dispatch gate (round 21): f32 vs int8 expert
+    # all_to_all wire bytes + the dispatch flip-rate; optional like
+    # the other gates.
+    moe_a2a_ab = None
+    if run_moe_a2a:
+        try:
+            moe_a2a_ab = bench_moe_a2a()
+        except Exception as e:
+            _log(f"[bench] moe-a2a A/B failed ({e}); omitting")
 
     # Elastic-recovery gate (round 12): shrink -> load_resharded -> grow
     # on the LM trainer; optional like the other gates.
@@ -2021,6 +2155,18 @@ def main() -> None:
                                         if route_ab is not None else None),
         "train_routed_speedup": (round(route_ab["speedup"], 3)
                                  if route_ab is not None else None),
+        # quantized MoE dispatch leg (round 21, BENCH_MOE_A2A=1): the
+        # int8-dispatch step program's per-step all_to_all wire bytes,
+        # the int8/f32 wire ratio ((d+4)/4d rowwise incl. bitcast
+        # scale rows — the <= 0.30 contract), and the round-16
+        # flip-rate gate applied to dispatch quantization.  All null
+        # when the A/B is skipped.
+        "moe_a2a_bytes_per_step": (moe_a2a_ab["bytes_per_step"]
+                                   if moe_a2a_ab is not None else None),
+        "moe_a2a_dispatch_ratio": (round(moe_a2a_ab["dispatch_ratio"], 4)
+                                   if moe_a2a_ab is not None else None),
+        "moe_router_flip_rate": (round(moe_a2a_ab["fliprate"], 5)
+                                 if moe_a2a_ab is not None else None),
         # elastic-recovery gate (round 12, BENCH_ELASTIC=1): wall-clock
         # of the in-process shrink recovery (mesh rebuild + cross-
         # topology load_resharded + one proving step at the smaller

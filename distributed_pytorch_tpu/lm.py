@@ -133,6 +133,19 @@ class LMTrainConfig:
     # Profile source for sync_plan="auto": None = cached/calibrated, or
     # a synthetic preset name / profile-JSON path / TopologyProfile.
     autotune_profile: Any = None
+    # Explicit routed sync surface (round 21, the round-20 follow-up —
+    # the CNN trainer's strategy="routed" analogue): a route string in
+    # the parallel/routing grammar pinning the gradient sync by hand
+    # instead of searching for it ("data:psum" on a flat mesh;
+    # "data:rs -> dcn:psum -> data:ag" or
+    # "data:rs -> dcn:ring[int8|int4+ef] -> data:ag" on a factored
+    # one).  Resolved by autotune.resolve_lm_route into the explicit
+    # knobs above (the exact routes `_two_level_sync` already
+    # executes), so a routed config trains BITWISE-identically to the
+    # explicit config it names; anything the LM machinery cannot run —
+    # other shapes, pp/pp_size, combining with sync_plan="auto" or
+    # dcn_compress — refuses loudly (strategies.require_lm_route).
+    sync_route: str | None = None
     # Interleaved-1F1B pipeline parallelism (round 10): pp_size > 0 routes
     # training through make_lm_1f1b_train_step — layer chunks partitioned
     # over a dedicated 'pp' mesh axis, one explicit forward/backward unit
@@ -476,6 +489,24 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
         if cfg.model.n_experts % cfg.ep:
             raise ValueError(f"{cfg.model.n_experts} experts do not shard "
                              f"over ep={cfg.ep}")
+    if (cfg.model.moe_dispatch_bits != "f32"
+            or cfg.model.moe_a2a_chunks > 1):
+        # The a2a knobs act where the MoE layer crosses a mesh axis (the
+        # EP / tensor-axis call sites in models/transformer.block); on a
+        # layout with no expert exchange they would silently no-op.
+        if not cfg.model.n_experts:
+            raise ValueError(
+                f"moe_dispatch_bits={cfg.model.moe_dispatch_bits!r}/"
+                f"moe_a2a_chunks={cfg.model.moe_a2a_chunks} configure the "
+                f"expert all_to_all of an MoE model; this model is dense "
+                f"(n_experts=0)")
+        if cfg.ep == 1 and cfg.tp == 1:
+            raise ValueError(
+                f"moe_dispatch_bits={cfg.model.moe_dispatch_bits!r}/"
+                f"moe_a2a_chunks={cfg.model.moe_a2a_chunks} shape the "
+                f"expert all_to_all wire, but ep=1 and tp=1 route "
+                f"experts locally (no exchange to compress or overlap) "
+                f"— raise ep or tp, or drop the knobs")
     if cfg.pp > 1:
         from .parallel.pipeline import _uniform_moe
         if cfg.model.n_experts and not _uniform_moe(cfg.model):
@@ -2347,6 +2378,15 @@ class LMTrainer:
         # resolves to (test-pinned).  The explainable plan is kept on
         # the trainer.
         self.sync_plan = None
+        # sync_route (round 21): the hand-pinned routed surface resolves
+        # through the SAME mechanism — parse, refuse what the LM sync
+        # machinery cannot execute, translate the dcn hop's wire format
+        # into dcn_compress — so a routed config trains
+        # bitwise-identically to the explicit config it names.
+        self.sync_route_plan = None
+        if cfg.sync_route is not None:
+            from .parallel import autotune
+            cfg, self.sync_route_plan = autotune.resolve_lm_route(cfg)
         if cfg.sync_plan == "auto":
             from .parallel import autotune
             cfg, self.sync_plan = autotune.resolve_lm_auto(cfg)

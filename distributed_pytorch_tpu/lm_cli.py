@@ -148,6 +148,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "--dcn-compress/--bucket-mb to the plan "
                         "minimizing predicted step-sync time "
                         "(parallel/autotune.py)")
+    p.add_argument("--sync-route", default=None,
+                   help="pin the gradient sync route by hand (round 21, "
+                        "the parallel/routing grammar; '->' accepted for "
+                        "the arrow): 'data:psum' on a flat mesh, or "
+                        "'data:rs -> dcn:psum -> data:ag' / 'data:rs -> "
+                        "dcn:ring[int8|int4+ef] -> data:ag' on a "
+                        "factored one.  Resolves into the explicit "
+                        "knobs (trains bitwise-identically to them); "
+                        "refuses pp, --sync-plan auto, and "
+                        "--dcn-compress alongside")
     p.add_argument("--autotune-profile", default=None,
                    help="profile source for --sync-plan auto: a "
                         "synthetic preset name (incl. wan_dcn and the "
@@ -364,7 +374,8 @@ def main(argv: list[str] | None = None) -> int:
         remat=args.remat or "none",
         sync_every=args.sync_every, staleness=args.staleness,
         max_sync_every=max_sync_every,
-        sync_plan=args.sync_plan, autotune_profile=args.autotune_profile)
+        sync_plan=args.sync_plan, autotune_profile=args.autotune_profile,
+        sync_route=args.sync_route)
     trainer = LMTrainer(cfg)
     heartbeat = drain_guard = None
     if args.elastic:

@@ -67,12 +67,28 @@ class TransformerConfig:
     moe_router: str = "tokens"   # 'tokens' (top-k) | 'experts' (expert choice)
     router_z_coef: float = 0.0   # z-loss weight relative to the aux weight
     capacity_factor: float = 2.0
+    # Round 21: wire precision of the expert-parallel dispatch/combine
+    # all_to_alls ('f32' exact; 'int8'/'int4' rowwise-quantized payloads
+    # with per-token f32 scale rows on the same exchange — the routed
+    # expert:a2a@bits format), and the capacity-chunk count whose
+    # combine/FFN interleaving hides the exchange (1 = the historical
+    # unchunked program, bitwise).  Both apply only where the MoE layer
+    # actually crosses a mesh axis (the EP / tensor-axis call sites).
+    moe_dispatch_bits: str = "f32"
+    moe_a2a_chunks: int = 1
 
     def __post_init__(self):
         kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
         if self.n_heads % kv:
             raise ValueError(f"n_heads {self.n_heads} not divisible by "
                              f"n_kv_heads {kv}")
+        if self.moe_dispatch_bits not in ("f32", "int8", "int4"):
+            raise ValueError(
+                f"moe_dispatch_bits must be f32, int8, or int4, got "
+                f"{self.moe_dispatch_bits!r}")
+        if self.moe_a2a_chunks < 1:
+            raise ValueError(
+                f"moe_a2a_chunks must be >= 1, got {self.moe_a2a_chunks}")
 
     @property
     def ff(self) -> int:
@@ -328,7 +344,9 @@ def block(
                 lp["moe"], hf, n_experts=cfg.n_experts,
                 capacity_factor=cfg.capacity_factor, axis=ep_axis,
                 top_k=cfg.moe_top_k, router_mode=cfg.moe_router,
-                z_coef=cfg.router_z_coef)
+                z_coef=cfg.router_z_coef,
+                dispatch_bits=cfg.moe_dispatch_bits,
+                a2a_chunks=cfg.moe_a2a_chunks)
             # aux is identical on every tp rank (replicated routing)
         elif tp_axis is not None:
             # Experts on the tensor axis itself (round-2 layout): tokens
@@ -348,7 +366,9 @@ def block(
                 lp["moe"], h_loc, n_experts=cfg.n_experts,
                 capacity_factor=cfg.capacity_factor, axis=tp_axis,
                 top_k=cfg.moe_top_k, router_mode=cfg.moe_router,
-                z_coef=cfg.router_z_coef)
+                z_coef=cfg.router_z_coef,
+                dispatch_bits=cfg.moe_dispatch_bits,
+                a2a_chunks=cfg.moe_a2a_chunks)
             down = jnp.zeros_like(hf)
             down = lax.dynamic_update_slice_in_dim(
                 down, out_loc, idx * t_loc, 0)

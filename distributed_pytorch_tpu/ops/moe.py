@@ -15,7 +15,15 @@ experts are sharded over a mesh axis.  Design:
 - **Expert parallelism** (``axis``): each device holds E_local = E/n
   experts and routes its own T tokens; one ``lax.all_to_all`` carries every
   device's per-expert buffers to the expert's owner and a second carries
-  results back.  XLA lowers these to ICI all-to-alls.
+  results back.  XLA lowers these to ICI all-to-alls.  Since round 21 both
+  trips route through ``parallel/routing.execute_a2a`` — the same executor
+  the ``expert:a2a@…`` route grammar compiles to — so the wire can be
+  rowwise-quantized (``dispatch_bits='int8'/'int4'``, per-token f32 scales
+  riding the same exchange; activation compression, gated by the round-16
+  flip-rate methodology rather than an EF ledger) and capacity-chunked
+  (``a2a_chunks>1``: chunk k's combine all-to-all overlaps chunk k+1's
+  expert FFN).  At the defaults (f32, 1 chunk) the emitted program is
+  bitwise the pre-round-21 hand-built one.
 - **Load-balance aux loss**: the Switch aux ``E * sum_e f_e * p_e`` over
   this device's tokens (f = routed fraction, p = mean router prob).
 - **Router z-loss** (``z_coef``): mean squared logsumexp of the router
@@ -40,6 +48,7 @@ from jax import lax
 # load the runtime-compat shims (axis_size/pcast polyfills on
 # legacy jax) before anything in this module traces
 from ..utils import compat as _compat  # noqa: F401
+from ..parallel import routing as _routing
 
 Array = jax.Array
 PyTree = Any
@@ -72,6 +81,8 @@ def moe_apply(
     top_k: int = 1,                # 1 = Switch, 2 = classic top-2 MoE
     router_mode: str = "tokens",   # 'tokens' (top-k) | 'experts' (EC)
     z_coef: float = 0.0,           # router z-loss weight (added into aux)
+    dispatch_bits: str = "f32",    # a2a wire precision: f32 | int8 | int4
+    a2a_chunks: int = 1,           # capacity chunks for combine/FFN overlap
 ) -> tuple[Array, Array]:
     """Returns (out (T, D), auxiliary loss scalar).
 
@@ -90,6 +101,20 @@ def moe_apply(
     ``router_mode='experts'``: each expert picks its top-C tokens by router
     affinity (C = ceil(T * capacity_factor / E)); a token's output is the
     gate-weighted sum over every expert that picked it.
+
+    ``dispatch_bits``: wire precision of the two expert all-to-alls
+    (round 21).  'int8'/'int4' rowwise-quantize each dispatched token row
+    with its f32 scale riding the same exchange — the
+    ``parallel/routing`` ``expert:a2a@bits`` wire format; the backward
+    cotangent is compressed identically.  'f32' is the exact hand-built
+    exchange.  Requires ``axis`` — without an expert-parallel axis there
+    is no wire to compress.
+
+    ``a2a_chunks``: split the (E, C) capacity buffers into this many
+    capacity slices so chunk k's combine all-to-all issues between chunk
+    k's and chunk k+1's expert FFN matmuls (async collectives then hide
+    the exchange behind compute).  ``1`` is the historical unchunked
+    program, bitwise.  Requires ``axis`` for the same reason.
 
     CAVEAT (expert-choice acausality): the per-expert top-C selection ranks
     over the flattened (B*S) token dim, so in causal LM training a token's
@@ -114,6 +139,21 @@ def moe_apply(
     if router_mode == "experts" and top_k != 1:
         raise ValueError("expert-choice routing has no top_k (experts pick "
                          "tokens); leave top_k=1")
+    if dispatch_bits not in ("f32", "int8", "int4"):
+        raise ValueError(f"dispatch_bits must be f32, int8, or int4, "
+                         f"got {dispatch_bits!r}")
+    if dispatch_bits != "f32" and axis is None:
+        raise ValueError(
+            f"dispatch_bits={dispatch_bits!r} quantizes the expert "
+            f"all_to_all wire; without an expert-parallel axis there is "
+            f"no wire to compress (the local einsum path is exact)")
+    if a2a_chunks < 1:
+        raise ValueError(f"a2a_chunks must be >= 1, got {a2a_chunks}")
+    if a2a_chunks > 1 and axis is None:
+        raise ValueError(
+            f"a2a_chunks={a2a_chunks} pipelines the dispatch/combine "
+            f"all_to_alls against the expert FFN; without an "
+            f"expert-parallel axis there is no exchange to overlap")
     e_local = e // n
     # min(·, t): expert-choice top_k needs cap <= t; more slots than tokens
     # is meaningless in either mode.
@@ -163,27 +203,43 @@ def moe_apply(
 
     xin = jnp.einsum("tec,td->ecd", dispatch, x)         # (E, C, D)
 
-    # -- expert exchange (EP): my tokens -> expert owners ------------------
-    if axis is not None:
-        xin = xin.reshape(n, e_local, cap, d)
-        # slot j of the result = the buffer device j routed to my experts
-        xin = lax.all_to_all(xin, axis, split_axis=0, concat_axis=0,
-                             tiled=False)
-        xin = jnp.moveaxis(xin, 0, 1).reshape(e_local, n * cap, d)
-
     # -- per-expert SwiGLU (batched over the local expert dim) -------------
-    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin,
-                               params["w_gate"].astype(x.dtype)))
-    u = jnp.einsum("ecd,edf->ecf", xin, params["w_up"].astype(x.dtype))
-    yout = jnp.einsum("ecf,efd->ecd", g * u,
-                      params["w_down"].astype(x.dtype))
+    def expert_ffn(xe: Array) -> Array:
+        g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe,
+                                   params["w_gate"].astype(x.dtype)))
+        u = jnp.einsum("ecd,edf->ecf", xe, params["w_up"].astype(x.dtype))
+        return jnp.einsum("ecf,efd->ecd", g * u,
+                          params["w_down"].astype(x.dtype))
 
-    # -- return trip and combine ------------------------------------------
-    if axis is not None:
-        yout = jnp.moveaxis(yout.reshape(e_local, n, cap, d), 1, 0)
-        yout = lax.all_to_all(yout, axis, split_axis=0, concat_axis=0,
-                              tiled=False)
-        yout = yout.reshape(e, cap, d)
+    if axis is None:
+        yout = expert_ffn(xin)
+    else:
+        # Both trips route through the ONE a2a executor (round 21): slot
+        # j of the dispatch result = the buffer device j routed to my
+        # experts; combine is the exact inverse trip.
+        hop = _routing.Hop("a2a", _routing._A2A_AXIS, bits=dispatch_bits)
+        chunks = min(a2a_chunks, cap)
+        if chunks == 1:
+            xin = _routing.execute_a2a(hop, xin, direction="dispatch",
+                                       axis=axis)
+            yout = expert_ffn(xin)
+            yout = _routing.execute_a2a(hop, yout, direction="combine",
+                                        axis=axis)
+        else:
+            # Capacity-chunked overlap: trace order is d0 f0 c0 d1 f1 c1
+            # …, so chunk k's combine all-to-all sits strictly between
+            # chunk k's and chunk k+1's expert matmuls — the async
+            # window XLA hides the exchange in (inspector-pinned by
+            # tests/test_a2a.py).
+            bounds = [(k * cap) // chunks for k in range(chunks + 1)]
+            parts = []
+            for k in range(chunks):
+                xk = _routing.execute_a2a(
+                    hop, xin[:, bounds[k]:bounds[k + 1]],
+                    direction="dispatch", axis=axis)
+                parts.append(_routing.execute_a2a(
+                    hop, expert_ffn(xk), direction="combine", axis=axis))
+            yout = jnp.concatenate(parts, axis=1)
 
     out = jnp.einsum("tec,ecd->td", combine, yout)       # (T, D)
     return out, aux.astype(jnp.float32)

@@ -88,7 +88,13 @@ from ..utils import telemetry
 # version-3 profile predates the busy-MXU calibration option and the
 # wan role; the version gate recalibrates instead of silently steering
 # (regression-tested in tests/test_routing.py).
-PROFILE_VERSION = 4
+# 5 since round 21: calibration times an all-to-all ladder rung per
+# axis (the expert-dispatch collective, wire factor (n-1)/n) and the
+# MoE dispatch chooser (choose_moe_plan) prices dispatch bit-widths
+# from the same per-axis fits.  A version-4 profile's alpha-beta fit
+# never saw an all-to-all observation; the version gate recalibrates
+# instead of silently steering (regression-tested in tests/test_a2a.py).
+PROFILE_VERSION = 5
 
 # Bucket-size candidates (MB).  25 first: the torch-DDP default wins
 # ties (strict-improvement argmin), so the chooser only moves off it
@@ -355,6 +361,8 @@ def _algo_factors(algo: str, n: int) -> tuple[float, float]:
         return 2.0, 2.0 * (n - 1) / n
     if algo == "ring":   # n-1 chained full-payload ppermute hops
         return float(n - 1), float(n - 1)
+    if algo == "a2a":    # all-to-all: each device keeps its own 1/n block
+        return 1.0, float(n - 1) / n
     raise ValueError(f"unknown calibration algorithm {algo!r}")
 
 
@@ -397,6 +405,10 @@ def _time_axis_collective(mesh, axis: str, payload_bytes: int, algo: str,
         if algo == "rs_ag":
             s = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
             return lax.all_gather(s, axis, axis=0, tiled=True) * (1.0 / n)
+        if algo == "a2a":  # the expert-dispatch permutation (round 21)
+            y = lax.all_to_all(x.reshape(n, elems // n), axis,
+                               split_axis=0, concat_axis=0, tiled=False)
+            return y.reshape(elems)
         acc = x
         for _ in range(n - 1):  # ring: chained full-payload hops
             acc = lax.ppermute(acc, axis, perm)
@@ -541,14 +553,17 @@ class _BackgroundMatmul:
 
 
 def calibrate(mesh, *, payload_bytes=(256 << 10, 1 << 20, 4 << 20),
-              algos=("psum", "rs_ag", "ring"),
+              algos=("psum", "rs_ag", "ring", "a2a"),
               inner: int = 4, reps: int = 2,
               concurrent: bool = False) -> TopologyProfile:
     """Fit a ``TopologyProfile`` by timing real collectives per axis of
     ``mesh`` (the calibration pass), plus one quantize/dequantize
     round-trip for the compute half of the compressed-hop cost (shared
     across axes — it runs on the device, not the link).  Axes of size 1
-    get a zero-cost link (nothing ever crosses them).
+    get a zero-cost link (nothing ever crosses them).  The ladder's
+    fourth rung (round 21) is the all-to-all — the expert-dispatch
+    permutation, wire factor ``(n-1)/n`` — so the same (alpha, beta)
+    fit also prices MoE dispatch (``choose_moe_plan``).
 
     ``concurrent=True`` (round 20) runs the quantize ladder and the
     per-axis collective ladders against a background matmul stream
@@ -1068,6 +1083,11 @@ def price_route(route, census: GradCensus, profile: TopologyProfile, *,
             parts = _axis_parts(hop.axis, sizes)
             n = int(np.prod([ni for _, ni in parts]))
             active = [(a, ni) for a, ni in parts if ni > 1]
+            if hop.kind == "a2a":
+                raise ValueError(
+                    "a2a hops are activation collectives priced by "
+                    "choose_moe_plan (capacity census), not by the "
+                    "gradient-bucket pricer")
             if hop.kind == "rs":
                 padded = e + (-e) % max(n, 1)
                 if n > 1 and hop.algorithm == "scatter" and active:
@@ -1225,6 +1245,142 @@ def choose_sync_plan(census: GradCensus, profile: TopologyProfile, *,
     assert best is not None
     _emit_plan(best, side="routed")
     return best
+
+
+# ---------------------------------------------------------------------------
+# the MoE dispatch chooser (round 21)
+
+
+def _a2a_row_bytes(d: int, bits: str) -> float:
+    """Wire bytes one d-element f32 token row occupies on the expert
+    all-to-all at ``bits`` — the routed executor's exact format: f32 is
+    full-width; int8/int4 ship the quantized lanes (nibble pairs at
+    int4) plus the row's f32 scale bitcast onto the same row."""
+    if bits == "f32":
+        return 4.0 * d
+    if bits == "int8":
+        return d + 4.0
+    if bits == "int4":
+        return d / 2.0 + 4.0
+    raise ValueError(f"unknown dispatch bits {bits!r}")
+
+
+@dataclass(frozen=True)
+class MoePlan:
+    """The MoE dispatch chooser's explainable output: which wire width
+    the expert all-to-alls run at, why (every candidate priced in
+    ``per_bits``), and the predicted wire bytes the accounting
+    inspectors (``plan_bytes_vs_schedule(by_hop=True)``) hold the
+    compiled program to.  ``sync_every`` exists for inspector API parity
+    with :class:`SyncPlan` (dispatch runs every step)."""
+
+    dispatch_bits: str
+    axis: str
+    predicted_ms: float
+    per_bits: tuple = ()         # one priced AxisPlan row per candidate
+    per_hop: tuple = ()          # the chosen row(s), inspector-comparable
+    per_axis: tuple = ()         # alias of per_hop (axis-level view)
+    profile_source: str = ""
+    dispatch_bytes: int = 0      # per-step wire bytes at the chosen width
+    sync_every: int = 1
+    route: str = ""              # 'expert:a2a@<bits>'
+
+    def summary(self) -> dict:
+        return {
+            "dispatch_bits": self.dispatch_bits, "axis": self.axis,
+            "predicted_ms": round(self.predicted_ms, 4),
+            "dispatch_bytes": self.dispatch_bytes, "route": self.route,
+            "profile_source": self.profile_source,
+            "bytes_by_bits": {p.axis: p.predicted_bytes
+                              for p in self.per_bits},
+            "ms_by_bits": {p.axis: round(p.predicted_ms, 4)
+                           for p in self.per_bits},
+        }
+
+    def table(self) -> str:
+        rows = ["| dispatch | wire bytes/step | predicted ms |",
+                "|---|---|---|"]
+        for p in self.per_bits:
+            pick = (" ←" if p.axis.rsplit("@", 1)[1] == self.dispatch_bits
+                    else "")
+            rows.append(f"| {p.axis} | {p.predicted_bytes} | "
+                        f"{p.predicted_ms:.4f}{pick} |")
+        return "\n".join(rows)
+
+
+def choose_moe_plan(profile: TopologyProfile, *, axis: str, tokens: int,
+                    d_model: int, n_experts: int,
+                    capacity_factor: float = 2.0, top_k: int = 1,
+                    bits_options: tuple = ("f32", "int8"),
+                    a2a_per_step: int = 4) -> MoePlan:
+    """Price the expert dispatch/combine all-to-alls over ``profile``'s
+    ``axis`` link at every candidate wire width and return the cheapest
+    as an explainable :class:`MoePlan` (round 21).
+
+    The census is the MoE layer's own capacity arithmetic: each step
+    moves the full ``(E, C, D)`` buffer — ``E * C`` rows of
+    ``_a2a_row_bytes(d_model, bits)`` with ``C = min(max(1, ceil(T *
+    top_k * capacity_factor / E)), T)`` — once per all-to-all, and a
+    train step issues ``a2a_per_step`` of them (dispatch + combine
+    forward, their transposes backward: 4 per MoE layer; pass 2 to
+    price a forward-only program, or scale by the MoE layer count).
+    Cost per width follows the calibrated alpha-beta-quant fit:
+    ``launches * alpha + wire_bytes * (n-1)/n * beta`` plus — for
+    compressed widths — the quantize/dequantize passes over the f32
+    payload at the link's ``quant_s_per_byte``, priced at the actual
+    width via ``_QUANT_PASSES`` (the round-11 lesson: the wire saving
+    is only real if the compute that buys it is in the model).  f32
+    wins exact ties (strict-improvement argmin, candidate order) —
+    the chooser declines compression on quantize-bound links
+    (``quant_bound`` preset) and fast uniform meshes, and takes int8 on
+    slow/WAN expert links (matrix pinned in tests/test_a2a.py).  int4
+    stays OUT of the default ladder — its routed-token flip rate has
+    not cleared the 0.02 gate at small d_model — pass
+    ``bits_options=("f32", "int8", "int4")`` to let the pricer consider
+    it."""
+    import math
+
+    if axis not in profile.axes:
+        raise ValueError(
+            f"profile has no {axis!r} axis (axes: "
+            f"{sorted(profile.axes)}) — calibrate the mesh the experts "
+            f"actually shard over")
+    n = int(profile.axes[axis])
+    link = profile.links[axis]
+    cap = min(max(1, math.ceil(tokens * top_k * capacity_factor
+                               / n_experts)), tokens)
+    rows = n_experts * cap
+    wire_factor = (n - 1) / n if n > 1 else 0.0
+    per_bits: list[AxisPlan] = []
+    for bits in bits_options:
+        payload = rows * _a2a_row_bytes(d_model, bits)
+        launch_ms = link.alpha_s * 1e3 * a2a_per_step
+        wire_ms = (payload * wire_factor * link.beta_s_per_byte
+                   * 1e3 * a2a_per_step)
+        quant_ms = 0.0
+        if bits != "f32":
+            quant_ms = (rows * d_model * 4.0 * _QUANT_PASSES[bits]
+                        * link.quant_s_per_byte * 1e3 * a2a_per_step)
+        per_bits.append(AxisPlan(
+            axis=f"{axis}:a2a@{bits}", algorithm="a2a",
+            launches=a2a_per_step,
+            predicted_bytes=int(payload * a2a_per_step),
+            predicted_ms=launch_ms + wire_ms + quant_ms))
+    best = per_bits[0]
+    for cand in per_bits[1:]:
+        if cand.predicted_ms < best.predicted_ms - 1e-12:
+            best = cand
+    bits = best.axis.rsplit("@", 1)[1]
+    # per_hop speaks the PROFILE's (mesh) axis name so the inspector can
+    # match the compiled program's collectives; ``route`` speaks the
+    # declarative grammar ('expert' tier) like every HopPlan.
+    plan = MoePlan(
+        dispatch_bits=bits, axis=axis, predicted_ms=best.predicted_ms,
+        per_bits=tuple(per_bits), per_hop=(best,), per_axis=(best,),
+        profile_source=profile.source, dispatch_bytes=best.predicted_bytes,
+        route=f"expert:a2a@{bits}")
+    _emit_plan(plan, side="moe")
+    return plan
 
 
 # ---------------------------------------------------------------------------
@@ -1652,4 +1808,41 @@ def resolve_lm_auto(cfg):
         bucket_mb=cfg.bucket_mb if cfg.bucket_mb is not None
         else plan.bucket_mb)
     _emit_plan(plan, side="lm")
+    return resolved, plan
+
+
+def resolve_lm_route(cfg):
+    """Resolve ``LMTrainConfig(sync_route=...)`` — the hand-pinned
+    routed surface (round 21, the round-20 follow-up) — into the
+    explicit knobs the LM sync machinery executes; returns
+    ``(resolved_cfg, HopPlan)``.
+
+    The same resolve-to-named-knobs mechanism as ``sync_plan='auto'``:
+    parse the route (``routing.parse_route``), refuse what the trainer
+    cannot run (``strategies.require_lm_route`` — wrong shapes for this
+    topology, pp, combining with auto or an explicit dcn_compress),
+    and translate the dcn hop's wire format into ``dcn_compress``.
+    Round 20 already rebuilt ``_two_level_sync`` on
+    ``routing.execute``, so the accepted routes ARE the programs the
+    explicit knobs compile — a routed config trains BITWISE-identically
+    to the config it names (parser + equivalence pinned in
+    tests/test_a2a.py)."""
+    from . import routing
+    from .strategies import require_lm_route
+
+    plan = routing.parse_route(cfg.sync_route)
+    require_lm_route(plan, dcn=cfg.dcn_size > 1,
+                     pp=cfg.pp > 1 or cfg.pp_size > 0,
+                     dcn_compress=cfg.dcn_compress,
+                     sync_plan=cfg.sync_plan)
+    ring_bits = [h.bits for h in plan.hops
+                 if h.kind == "exchange" and h.bits != "f32"]
+    resolved = dataclasses.replace(
+        cfg, sync_route=None,
+        dcn_compress=ring_bits[0] if ring_bits else None)
+    tel = telemetry.active()
+    if tel is not None:
+        tel.event("sync_plan", phase="autotune", side="lm_route",
+                  route=plan.describe(),
+                  dcn_compress=ring_bits[0] if ring_bits else None)
     return resolved, plan

@@ -47,6 +47,7 @@ packing and per-256-row scale layout stay single-sourced.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -59,7 +60,16 @@ from . import strategies as _strat
 PyTree = Any
 
 _BITS = ("f32", "int8", "int4")
-_KINDS = ("rs", "exchange", "ag")
+_KINDS = ("rs", "exchange", "ag", "a2a")
+
+# The expert-dispatch exchange (kind 'a2a', round 21) runs only over
+# the dedicated expert tier: it permutes whole (device, expert, slot)
+# token buffers, which is meaningful for exactly one mesh role.  The
+# executor (`execute_a2a`) accepts any mesh axis NAME at call time —
+# ops/moe.py binds whatever the caller's expert axis is called — but a
+# declarative ROUTE must say 'expert' so plans stay topology-tier
+# statements like every other hop.
+_A2A_AXIS = "expert"
 
 
 @dataclass(frozen=True)
@@ -67,19 +77,26 @@ class Hop:
     """One edge of a sync route.
 
     kind       'rs' (reduce-scatter over ``axis``), 'exchange'
-               (all-reduce of the current shard over ``axis``), or 'ag'
+               (all-reduce of the current shard over ``axis``), 'ag'
                (all-gather back over ``axis`` — must close the matching
-               'rs').
-    axis       mesh axis name the hop runs over.
+               'rs'), or 'a2a' (the expert dispatch/combine all-to-all,
+               round 21 — a pure permutation, not a reduction).
+    axis       mesh axis name the hop runs over ('expert' for a2a hops
+               in declarative routes; :func:`execute_a2a` rebinds the
+               concrete mesh axis at call time).
     algorithm  rs: 'scatter' (``psum_scatter``) or 'slice' (take the
                static ``axis_index`` chunk — free when the value is
                already replicated over ``axis``, the local-SGD window
                case).  exchange: 'psum' (one XLA all-reduce) or 'ring'
                (chained-ppermute quantized ring).  ag: 'gather'.
-    bits       wire precision of a ring exchange ('f32' psum hops are
-               always full-width).
+               a2a: 'alltoall' (one ``lax.all_to_all``).
+    bits       wire precision of a ring exchange or a2a hop ('f32'
+               psum/rs/ag hops are always full-width).
     ef         thread an error-feedback residual through this ring hop
                (consumes/refills one residual segment in plan order).
+               Never legal on a2a hops: the all-to-all compresses
+               *activations*, whose error leaves the program with the
+               step — there is no persistent ledger to feed back into.
     """
 
     kind: str
@@ -89,7 +106,8 @@ class Hop:
     ef: bool = False
 
     def __post_init__(self):
-        defaults = {"rs": "scatter", "exchange": "psum", "ag": "gather"}
+        defaults = {"rs": "scatter", "exchange": "psum", "ag": "gather",
+                    "a2a": "alltoall"}
         if self.kind not in _KINDS:
             raise ValueError(f"hop kind must be one of {_KINDS}, "
                              f"got {self.kind!r}")
@@ -97,7 +115,8 @@ class Hop:
             object.__setattr__(self, "algorithm", defaults[self.kind])
         allowed = {"rs": ("scatter", "slice"),
                    "exchange": ("psum", "ring"),
-                   "ag": ("gather",)}[self.kind]
+                   "ag": ("gather",),
+                   "a2a": ("alltoall",)}[self.kind]
         if self.algorithm not in allowed:
             raise ValueError(
                 f"{self.kind} hop over {self.axis!r}: algorithm must be "
@@ -105,12 +124,20 @@ class Hop:
         if self.bits not in _BITS:
             raise ValueError(f"bits must be one of {_BITS}, "
                              f"got {self.bits!r}")
-        if self.bits != "f32" and not (self.kind == "exchange"
-                                       and self.algorithm == "ring"):
+        if self.bits != "f32" and not (self.kind == "a2a"
+                                       or (self.kind == "exchange"
+                                           and self.algorithm == "ring")):
             raise ValueError(
-                f"bits={self.bits!r} requires a ring exchange hop; "
-                f"{self.kind}/{self.algorithm} over {self.axis!r} is "
-                f"always full-width")
+                f"bits={self.bits!r} requires a ring exchange or a2a "
+                f"hop; {self.kind}/{self.algorithm} over {self.axis!r} "
+                f"is always full-width")
+        if self.kind == "a2a" and self.ef:
+            raise ValueError(
+                f"ef=True on the a2a hop over {self.axis!r}: the "
+                f"all-to-all compresses activations, not gradient "
+                f"partial sums — quantization error leaves with the "
+                f"step, so there is no residual ledger to thread "
+                f"(ef is a ring-exchange contract)")
         if self.ef and self.bits == "f32":
             raise ValueError(
                 f"ef=True requires a compressed (int8/int4) ring hop; "
@@ -122,6 +149,8 @@ class Hop:
                     else f"{self.axis}:slice")
         if self.kind == "ag":
             return f"{self.axis}:ag"
+        if self.kind == "a2a":
+            return f"{self.axis}:a2a@{self.bits}"
         if self.algorithm == "psum":
             return f"{self.axis}:psum"
         tag = self.bits + ("+ef" if self.ef else "")
@@ -144,6 +173,7 @@ class HopPlan:
         stack: list[str] = []
         seen_rs: set[str] = set()
         seen_x: set[str] = set()
+        seen_a2a: set[str] = set()
         for hop in self.hops:
             if not isinstance(hop, Hop):
                 raise ValueError(f"plan entries must be Hop, got {hop!r}")
@@ -164,6 +194,25 @@ class HopPlan:
                         f"ag over {hop.axis!r} must close the innermost "
                         f"open rs ({stack[-1]!r}); rs/ag pair LIFO")
                 stack.pop()
+            elif hop.kind == "a2a":
+                if hop.axis != _A2A_AXIS:
+                    raise ValueError(
+                        f"a2a over {hop.axis!r}: the all-to-all is the "
+                        f"expert-dispatch exchange and routes only over "
+                        f"the {_A2A_AXIS!r} tier (reduce/gather hops "
+                        f"cover every other axis role)")
+                if stack:
+                    raise ValueError(
+                        f"a2a over {hop.axis!r} inside the open rs "
+                        f"bracket over {stack!r} — the dispatch "
+                        f"exchange permutes whole (expert, slot) token "
+                        f"buffers and cannot run on a scattered shard")
+                if hop.axis in seen_a2a:
+                    raise ValueError(
+                        f"axis {hop.axis!r} carries two a2a hops — one "
+                        f"a2a hop describes BOTH directions (dispatch "
+                        f"and combine ride the same wire format)")
+                seen_a2a.add(hop.axis)
             else:
                 if hop.axis in seen_x:
                     raise ValueError(
@@ -313,7 +362,9 @@ def parse_route(route: str) -> HopPlan:
     arrow glyph) back into a validated ``HopPlan``.  The grammar is
     exactly what ``describe()`` emits: per hop ``axis:op`` with op one
     of ``rs`` / ``slice`` / ``ag`` / ``psum`` /
-    ``ring[int8|int4[+ef]]``."""
+    ``ring[int8|int4[+ef]]`` / ``a2a@f32|int8|int4`` (the expert
+    dispatch exchange — ``expert:a2a@int8`` is the quantized-dispatch
+    route, round 21)."""
     hops = []
     for part in route.replace("->", "→").split("→"):
         part = part.strip()
@@ -338,10 +389,13 @@ def parse_route(route: str) -> HopPlan:
                 raise ValueError(f"bad ring tag {tag!r} in hop {part!r}")
             hops.append(Hop("exchange", axis, algorithm="ring",
                             bits=bits, ef=ef == "ef"))
+        elif op.startswith("a2a@"):
+            hops.append(Hop("a2a", axis, bits=op[len("a2a@"):]))
         else:
             raise ValueError(
                 f"unknown hop op {op!r} in route {route!r} (want rs, "
-                f"slice, ag, psum, or ring[int8|int4[+ef]])")
+                f"slice, ag, psum, ring[int8|int4[+ef]], or "
+                f"a2a@f32|int8|int4)")
     return HopPlan(tuple(hops))
 
 
@@ -513,6 +567,106 @@ def execute(plan: HopPlan, tree: PyTree, *,
                    .reshape(g.shape).astype(g.dtype))
         offset += g.size
     return jax.tree.unflatten(treedef, out), new_res
+
+
+# -- the expert all-to-all executor (round 21) -----------------------------
+
+def _a2a_quant_exchange(x: jax.Array, axis: str, bits: str) -> jax.Array:
+    """One quantized ``lax.all_to_all`` over ``axis`` of a device-major
+    ``(n, ...)`` buffer: symmetric rowwise quantization over the last
+    (feature) dim — int8 lanes, or the ``QuantizedRing`` nibble packing
+    at int4 — with each row's f32 scale bitcast to 4 int8 lanes and
+    CONCATENATED onto its payload row, so the scales ride the *same*
+    exchange.  One collective either way, same census as f32; the wire
+    carries ``d + 4`` (int8) or ``d/2 + 4`` (int4) bytes per d-element
+    f32 row instead of ``4d``."""
+    levels = 127.0 if bits == "int8" else 7.0
+    d = x.shape[-1]
+    if bits == "int4" and d % 2:
+        raise ValueError(
+            f"int4 a2a nibble-packs feature pairs; the trailing (model) "
+            f"dim must be even, got {d}")
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / levels, 1e-30)
+    q = jnp.clip(jnp.round(xf / scale), -levels, levels).astype(jnp.int8)
+    if bits == "int4":
+        ring = _strat.QuantizedRing(bits=4)
+        q = ring._pack(q).reshape(q.shape[:-1] + (d // 2,))
+    srows = lax.bitcast_convert_type(scale[..., 0], jnp.int8)  # (..., 4)
+    wire = lax.all_to_all(jnp.concatenate([q, srows], axis=-1), axis,
+                          split_axis=0, concat_axis=0, tiled=False)
+    q_out, s_out = wire[..., :-4], wire[..., -4:]
+    scale_out = lax.bitcast_convert_type(s_out, jnp.float32)[..., None]
+    if bits == "int4":
+        ring = _strat.QuantizedRing(bits=4)
+        q_out = ring._unpack(q_out, q_out.shape[:-1] + (d,))
+    return (q_out.astype(jnp.float32) * scale_out).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _a2a_wire_q(x: jax.Array, axis: str, bits: str) -> jax.Array:
+    return _a2a_quant_exchange(x, axis, bits)
+
+
+def _a2a_wire_q_fwd(x, axis, bits):
+    return _a2a_quant_exchange(x, axis, bits), None
+
+
+def _a2a_wire_q_bwd(axis, bits, _res, g):
+    # all_to_all(split=0, concat=0) is its own transpose — a symmetric
+    # block permutation — so the cotangent rides the SAME quantized
+    # wire: both directions of the dispatch move low-bit bytes, and
+    # quant→dequant is straight-through (activation compression; the
+    # round-16 flip-rate gate, not an EF ledger, bounds the damage).
+    return (_a2a_quant_exchange(g, axis, bits),)
+
+
+_a2a_wire_q.defvjp(_a2a_wire_q_fwd, _a2a_wire_q_bwd)
+
+
+def execute_a2a(hop: Hop, x: jax.Array, *, direction: str,
+                axis: str | None = None) -> jax.Array:
+    """Execute one direction of the expert all-to-all hop on an MoE
+    exchange buffer — the ONE executor both ``ops/moe.py`` directions
+    route through (round 21).
+
+    ``direction='dispatch'`` takes the router's ``(E, C, D)`` capacity
+    buffer and returns the expert-major ``(E/n, n*C, D)`` buffer each
+    device's local experts consume; ``direction='combine'`` is the exact
+    inverse trip for the expert outputs.  At ``bits='f32'`` the emitted
+    op sequence is literally the hand-built one (reshape → all_to_all →
+    moveaxis → reshape), so the routed path is bitwise ≡ and census-≡
+    the pre-round-21 ``ops/moe.py``; at int8/int4 the wire payload is
+    rowwise-quantized with scales on the same exchange (see
+    :func:`_a2a_quant_exchange`) and the backward pass compresses the
+    cotangent's wire identically via a ``custom_vjp``.
+
+    ``axis`` rebinds the concrete mesh axis at call time (plans say
+    'expert'; the caller's mesh may say 'model' or anything else).
+    """
+    if hop.kind != "a2a":
+        raise ValueError(
+            f"execute_a2a wants an a2a hop, got {hop.describe()!r}")
+    ax = axis or hop.axis
+    n = lax.axis_size(ax)
+
+    def wire(v):
+        if hop.bits == "f32":
+            return lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        return _a2a_wire_q(v, ax, hop.bits)
+
+    if direction == "dispatch":
+        e, cap, d = x.shape
+        x = wire(x.reshape(n, e // n, cap, d))
+        return jnp.moveaxis(x, 0, 1).reshape(e // n, n * cap, d)
+    if direction == "combine":
+        e_local, ncap, d = x.shape
+        x = wire(jnp.moveaxis(x.reshape(e_local, n, ncap // n, d), 1, 0))
+        return x.reshape(n * e_local, ncap // n, d)
+    raise ValueError(
+        f"direction must be 'dispatch' or 'combine', got {direction!r}")
 
 
 # -- the routed strategy (plug-in protocol, parallel/strategies.py) --------

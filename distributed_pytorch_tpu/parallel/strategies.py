@@ -1229,6 +1229,73 @@ def require_lm_overlap_streamable(*, fsdp: bool, dcn: bool,
         "trainer's overlap=True covers the explicit-strategy case)")
 
 
+def require_lm_route(plan, *, dcn: bool, pp: bool,
+                     dcn_compress: str | None,
+                     sync_plan: str | None) -> None:
+    """The LM trainer's routed-surface capability check
+    (``LMTrainConfig(sync_route=...)``, round 21 — the round-20
+    follow-up): ONE definition site shared by
+    ``autotune.resolve_lm_route``, ``lm_cli``, and the bench pre-checks.
+    ``plan`` is a parsed ``routing.HopPlan`` (duck-typed — strategies
+    cannot import routing, routing imports us).
+
+    The LM trainer executes exactly the routes its factored-mesh sync
+    machinery (``_two_level_sync``) compiles: the flat ``data:psum`` on
+    an unfactored mesh, and ``data:rs → dcn:psum → data:ag`` /
+    ``data:rs → dcn:ring[int8|int4+ef] → data:ag`` on a factored one —
+    anything else must refuse loudly rather than silently run a
+    different program than the route names.  pp/pp_size gradient paths
+    are hand-emitted (the long-standing dcn_compress refusal), and the
+    route carries its own wire format, so combining with an explicit
+    ``dcn_compress`` or with ``sync_plan='auto'`` (search vs pin) is
+    ambiguous — set one, not both."""
+    if sync_plan is not None:
+        raise ValueError(
+            "sync_route pins the gradient route by hand; "
+            "sync_plan='auto' searches for one — ambiguous together, "
+            "set one, not both")
+    if dcn_compress is not None:
+        raise ValueError(
+            "sync_route encodes the dcn hop's wire format in the route "
+            "itself (e.g. 'dcn:ring[int4+ef]'); an explicit "
+            "dcn_compress alongside is ambiguous — drop it")
+    if pp:
+        raise ValueError(
+            "sync_route does not compose with pipeline parallelism "
+            "(pp/pp_size): the pipeline's gradient reductions are "
+            "hand-emitted per stage, not routed through "
+            "_two_level_sync — drop the pipeline or the route")
+    hops = list(plan.hops)
+    if not dcn:
+        if (len(hops) == 1 and hops[0].kind == "exchange"
+                and hops[0].axis == "data"
+                and hops[0].algorithm == "psum"):
+            return
+        raise ValueError(
+            f"with dcn_size=1 the LM data sync is the flat 'data:psum' "
+            f"(per-leaf cotangent psums); got {plan.describe()!r} — "
+            f"factor the mesh (dcn_size >= 2) to route a two-level "
+            f"plan")
+    ok_shape = (len(hops) == 3
+                and hops[0].kind == "rs" and hops[0].axis == "data"
+                and hops[0].algorithm == "scatter"
+                and hops[1].kind == "exchange" and hops[1].axis == "dcn"
+                and hops[2].kind == "ag" and hops[2].axis == "data")
+    if not ok_shape:
+        raise ValueError(
+            f"the LM factored-mesh sync executes routes shaped "
+            f"'data:rs → dcn:psum → data:ag' or 'data:rs → "
+            f"dcn:ring[int8|int4+ef] → data:ag' (what _two_level_sync "
+            f"compiles); got {plan.describe()!r}")
+    x = hops[1]
+    if x.algorithm == "ring" and not x.ef:
+        raise ValueError(
+            f"the LM dcn ring threads the error-feedback residual "
+            f"through the train step's sync-state channel; a "
+            f"compressed dcn hop must be ring[int8|int4+ef], got "
+            f"{x.describe()!r}")
+
+
 def require_pp_schedulable(*, n_stages: int, n_micro: int, n_layers: int,
                            interleave: int = 1) -> None:
     """The interleaved-1F1B composition check (``LMTrainConfig(pp_size >

@@ -342,6 +342,10 @@ _HOP_OP_PRIMS = {
     "ag": ("all_gather", "psum"),
     "psum": ("psum", "psum2"),
     "ring": ("ppermute",),
+    # round 21: the expert dispatch/combine exchange ('expert:a2a@bits'
+    # hops) lowers to all_to_all at every wire width — the quantized
+    # payload+scale concat rides the same primitive
+    "a2a": ("all_to_all",),
 }
 
 
@@ -373,7 +377,9 @@ def plan_bytes_vs_schedule(plan, sched: list[dict], *,
             if hp.predicted_bytes <= 0:
                 continue
             axis, _, op = hp.axis.partition(":")
-            prims = _HOP_OP_PRIMS.get(op.split("[", 1)[0], ())
+            # strip both tag syntaxes: 'ring[int4+ef]' and 'a2a@int8'
+            prims = _HOP_OP_PRIMS.get(
+                op.split("[", 1)[0].split("@", 1)[0], ())
             measured = sum(
                 measured_hops.get(f"{axis}:{p}", {}).get("bytes_executed", 0)
                 for p in prims)

@@ -84,6 +84,12 @@ RULES: dict[str, tuple[str, float]] = {
     # (schedule-inspector payload accounting, no timing noise), same
     # tight band as the round-16 dcn-int4 byte key
     "train_routed_bytes_per_step": ("lower", 0.02),
+    # round 21: quantized MoE dispatch — all_to_all wire bytes and the
+    # int8/f32 wire ratio are deterministic schedule-inspector payload
+    # accounting (no timing noise), same tight band as the routed and
+    # dcn-int4 byte keys
+    "moe_a2a_bytes_per_step": ("lower", 0.02),
+    "moe_a2a_dispatch_ratio": ("lower", 0.02),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,
@@ -104,6 +110,12 @@ ABS_CEILINGS: dict[str, float] = {
     # stay well under a single decode step (~10 ms on the CPU mesh) —
     # measured ~0.1-0.3 ms over unix sockets
     "fleet_rpc_overhead_ms": 5.0,
+    # round-21 bound: the round-16 flip-rate methodology applied to
+    # int8 expert DISPATCH (teacher-forced argmax flips, f32 vs int8
+    # dispatch at identical params) — measured 0.000 on the ep=2 CPU
+    # mesh at d_model=256 (rowwise scales track token magnitude, so
+    # the perturbation sits well under near-tie width)
+    "moe_router_flip_rate": 0.02,
 }
 
 

@@ -20,6 +20,7 @@ Prints one JSON line per strategy plus a markdown table on stderr.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -339,6 +340,79 @@ def bench_lm_remat_selective() -> tuple[float, dict, bool]:
     return sum(times) / len(times), comm, False
 
 
+def bench_moe_a2a_int8() -> tuple[float, dict, bool]:
+    """The quantized expert-dispatch row (round 21): the small LM as a
+    Switch MoE (n_experts=4) over a dedicated ep=2 expert axis with the
+    chooser-picked int8 all_to_all wire (``expert:a2a@int8``), same
+    window discipline as the LM rows.  Its extra columns are the
+    cost-model cross-check the row exists for: ``choose_moe_plan``'s
+    capacity-census byte prediction (E*C rows of d+4 wire bytes, times
+    a2a_per_step=4 per MoE layer) NEXT TO the schedule inspector's
+    measured all_to_all bytes — the same arithmetic prices the route
+    and counts the compiled program, so the pair must agree exactly
+    (the ratio-1.0 pin lives in tests/test_a2a.py).  s/step is not
+    comparable to the VGG rows (different model/loss); the byte
+    columns are the content."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    model = tfm.TransformerConfig(vocab_size=256, d_model=128, n_layers=4,
+                                  n_heads=2, head_dim=64, d_ff=256,
+                                  n_experts=4)
+    batch, seq = 2 * N_DEV, 128
+    # the capacity census prices PER-DEVICE tokens (the batch shards
+    # over the joint (data, expert) axes — N_DEV ways)
+    local_tokens = batch * seq // N_DEV
+    # the expert link is slow relative to quantization throughput, so
+    # the chooser takes the int8 wire (the matrix tests/test_a2a.py pins)
+    profile = autotune.synthetic_profile("slow", {"expert": 2})
+    plan = autotune.choose_moe_plan(
+        profile, axis="expert", tokens=local_tokens,
+        d_model=model.d_model, n_experts=model.n_experts,
+        capacity_factor=model.capacity_factor, top_k=model.moe_top_k)
+    assert plan.dispatch_bits == "int8", plan.summary()
+    model = dataclasses.replace(model,
+                                moe_dispatch_bits=plan.dispatch_bits)
+    cfg = LMTrainConfig(model=model, dp=N_DEV // 2, ep=2,
+                        compute_dtype=None)
+    tr = LMTrainer(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, (batch, seq)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    tr.train_step(toks, tgts)  # compile + warm-up (excluded)
+    sched = dbg.op_schedule(tr.step_fn, tr.params, tr.opt_state, toks, tgts)
+    stats = dbg.collective_stats(sched)
+    per_axis = dbg.per_axis_collective_stats(sched)
+    n_moe = sum(model.is_moe_layer(i) for i in range(model.n_layers))
+    measured_a2a = int(sum(r["bytes"] for r in sched
+                           if r["kind"] == "collective"
+                           and r["prim"] == "all_to_all"))
+    comm = {"comm_bytes_per_step": stats["bytes_executed"],
+            "collective_count": stats["executions"],
+            "comm_bytes_static": stats["bytes"],
+            "collective_count_static": stats["total"],
+            "collectives_interleaved": stats["interleaved"],
+            "comm_bytes_by_axis": {a: s["bytes_executed"]
+                                   for a, s in per_axis.items()},
+            "collective_count_by_axis": {a: s["executions"]
+                                         for a, s in per_axis.items()},
+            "hlo_collective_count": None, "hlo_collectives": None,
+            # the MoE pricer's per-layer ms, scaled to the program's
+            # MoE layer count (moe_every=2 -> 2 of 4 layers)
+            "predicted_ms": plan.predicted_ms * n_moe,
+            "route": plan.route,
+            "a2a_bytes_predicted": plan.dispatch_bytes * n_moe,
+            "a2a_bytes_measured": measured_a2a}
+    times = []
+    for _ in range(WINDOW):
+        t0 = time.perf_counter()
+        loss = tr.train_step(toks, tgts)
+        float(loss)  # value fetch: the honest end-of-step barrier
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times), comm, False
+
+
 def bench_hierarchical_localsgd(
         sync_every: int = 4) -> tuple[float, dict, bool]:
     """The communication-sparse row (round 18): the hierarchical
@@ -511,6 +585,17 @@ def main() -> None:
                       "sec_per_step": round(t, 4), "window": WINDOW,
                       "per_dev_batch": PER_DEV_BATCH, "overlap": False,
                       **comm}), flush=True)
+    # the quantized expert-dispatch row (round 21): chooser-picked
+    # expert:a2a@int8 wire on the ep=2 axis, with choose_moe_plan's
+    # capacity-census byte prediction next to the inspector's measured
+    # all_to_all bytes — same LM caveat as above
+    t, comm, _ = bench_moe_a2a_int8()
+    names.append("moe_a2a_int8")
+    results["moe_a2a_int8"], comms["moe_a2a_int8"] = t, comm
+    print(json.dumps({"strategy": "moe_a2a_int8",
+                      "sec_per_step": round(t, 4), "window": WINDOW,
+                      "per_dev_batch": PER_DEV_BATCH, "overlap": False,
+                      **comm}), flush=True)
 
     def axis_mb(c: dict) -> str:
         """dcn/ici MB column for the factored strategies, '-' otherwise."""
@@ -520,6 +605,8 @@ def main() -> None:
                     f"{by_axis.get('ici', 0) / 1e6:.2f}")
         if "pp" in by_axis:  # the pipeline row: stage-boundary bytes
             return f"pp {by_axis['pp'] / 1e6:.2f}"
+        if "expert" in by_axis:  # the MoE row: expert all_to_all bytes
+            return f"ep {by_axis['expert'] / 1e6:.2f}"
         return "-"
 
     def bubble(c: dict) -> str:
@@ -556,6 +643,11 @@ def main() -> None:
     if "auto" in comms and "resolved" in comms["auto"]:
         print(f"\nauto resolved: {comms['auto']['resolved']}",
               file=sys.stderr)
+    if "moe_a2a_int8" in comms:
+        c = comms["moe_a2a_int8"]
+        print(f"moe_a2a_int8 ({c['route']}) a2a bytes "
+              f"predicted/measured: {c['a2a_bytes_predicted']}/"
+              f"{c['a2a_bytes_measured']}", file=sys.stderr)
 
 
 if __name__ == "__main__":

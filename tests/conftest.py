@@ -183,3 +183,17 @@ def pytest_configure(config):
         "3->4 recalibrate path).  All routing tests are fast and ride "
         "tier-1 via `-m 'not slow'` (wired like the `faults`/`elastic`/"
         "`fleet`/`monitor`/`memory`/`localsgd` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "a2a: expert all-to-all lane (round 21) — `pytest -m a2a` runs "
+        "the routed MoE dispatch machinery (tests/test_a2a.py: the "
+        "a2a hop grammar round-trips and refusals, the routed-f32 "
+        "bitwise + collective-census identity vs the hand-built "
+        "exchange, the int8 wire's <= 0.30x byte contract and "
+        "flip-rate/loss-curve gates, the capacity-chunked "
+        "compute-overlapped combine interleaving pin, the "
+        "choose_moe_plan matrix, the PROFILE_VERSION 4->5 recalibrate "
+        "path, and the per-hop inspector ratio pins).  All a2a tests "
+        "are fast and ride tier-1 via `-m 'not slow'` (wired like the "
+        "`faults`/`elastic`/`fleet`/`monitor`/`memory`/`localsgd`/"
+        "`routing` lanes).")

@@ -348,10 +348,12 @@ def test_memory_plan_refuses_unfittable_budget():
 def test_profile_carries_recompute_rate():
     """Since PROFILE_VERSION 3 the calibrated recompute rate rides the
     profile like quant_s_per_byte (serde round-trip; absent key loads as
-    0.0 so an older JSON is simply re-calibrated by the version gate;
-    version currently 4 — the round-20 concurrent-calibration bump,
-    pinned in tests/test_routing.py)."""
-    assert at.PROFILE_VERSION == 4
+    0.0 so an older JSON is simply re-calibrated by the version gate —
+    the stale-version path itself is pinned against
+    ``autotune.PROFILE_VERSION`` in tests/test_routing.py and
+    tests/test_a2a.py, never against a literal: the round-20 3→4 bump
+    broke a hard-coded ``== 3`` here, the round-21 hygiene sweep)."""
+    assert at.PROFILE_VERSION >= 3  # the recompute-rate field's floor
     prof = at.synthetic_profile("uniform", {"data": 8})
     assert prof.recompute_s_per_byte > 0
     back = at.TopologyProfile.from_json(prof.to_json())
