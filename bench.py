@@ -952,6 +952,134 @@ def bench_moe_a2a(train_steps: int = 30, batch: int = 8,
             "loss_int8": losses["int8"][-1]}
 
 
+def canon_wan_env(value: str | None) -> bool:
+    """Validate the BENCH_WAN knob (round 22): '1' runs the DiLoCo WAN
+    leg (plain-mean vs outer-optimizer window boundaries at matched H,
+    plus the chooser's predicted WAN bytes/optimizer-step vs the
+    inspector's measured figure), unset/''/'0' skips it."""
+    return _canon_bool_env(
+        "BENCH_WAN", value, default=False,
+        guess="whether to run the DiLoCo WAN outer-optimizer A/B")
+
+
+def bench_wan_diloco(sync_every: int = 8, iters: int = 16,
+                     reps: int = 5) -> dict | None:
+    """DiLoCo WAN leg (round 22, BENCH_WAN=1): train the small byte-LM
+    on a 2-slice factored ('dcn', 'data') mesh at window length
+    ``sync_every`` TWICE from identical init — plain window-mean anchor
+    update vs the Nesterov outer optimizer over the same averaged
+    window delta — and report:
+
+    - ``speedup``: plain/outer ms-per-step ratio at matched H (the
+      outer step is one O(params) momentum update per WINDOW, so the
+      expected figure is ~1.0x — the claim is "outer costs nothing on
+      the wire", not "outer is faster");
+    - ``bytes_per_opt_step``: the boundary exchange program's dcn-axis
+      wire bytes amortized over the H optimizer steps it serves
+      (schedule-inspector measured — outer momentum rides the anchor
+      update, NOT the exchange, so this must equal the plain windowed
+      figure);
+    - ``bytes_per_opt_step_predicted``: the route chooser's amortized
+      WAN-hop bytes/optimizer-step for the SAME parameter census on
+      the synthetic ``ici_dcn_wan`` profile at ``max_sync_every=H``
+      (the round-22 per-hop interval search — deterministic, gated
+      ±2% by bench_compare like the measured figure);
+    - ``plan``: the chooser's full routed plan summary (route,
+      ``interval_by_hop``, ``outer_opt``) for the JSON record.
+
+    Needs an even device count >= 2 (the 2-slice dcn axis); returns
+    None (JSON nulls) otherwise.  On CPU meshes expect ~1.0x; the byte
+    accounting and the plan are the content."""
+    import jax
+
+    from distributed_pytorch_tpu.data import lm_corpus
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+    from distributed_pytorch_tpu.parallel import autotune
+    from distributed_pytorch_tpu.utils import debug as dbg
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        _log(f"[bench] wan-diloco A/B needs an even device count >= 2 "
+             f"(have {n_dev}); omitting")
+        return None
+    h = sync_every
+    iters = -(-iters // h) * h  # whole windows only
+    batch = max(8, n_dev)
+    batch -= batch % n_dev
+
+    def build(outer: bool) -> LMTrainer:
+        model = tfm.TransformerConfig(
+            vocab_size=256, d_model=128, n_layers=2, n_heads=4,
+            head_dim=32, d_ff=256)
+        return LMTrainer(LMTrainConfig(
+            model=model, compute_dtype=None, dp=n_dev, dcn_size=2,
+            sync_every=h, max_sync_every=h,
+            outer_opt="nesterov" if outer else None,
+            outer_momentum=0.9, outer_lr=1.0))
+
+    trainers = {"plain": build(False), "outer": build(True)}
+    data = lm_corpus.encode(lm_corpus.synthetic_corpus(1 << 16, seed=7))
+    seq = 64
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(iters):
+        idx = rng.integers(0, len(data) - seq - 1, batch)
+        toks = np.stack([data[i:i + seq] for i in idx]).astype(np.int32)
+        tgts = np.stack([data[i + 1:i + seq + 1]
+                         for i in idx]).astype(np.int32)
+        batches.append((toks, tgts))
+
+    losses: dict[str, float] = {}
+    for k, tr in trainers.items():  # warm: compile step + exchange
+        for toks, tgts in batches:
+            losses[k] = float(tr.train_step(toks, tgts))
+
+    times: dict[str, list[float]] = {k: [] for k in trainers}
+    for _ in range(reps):
+        for k, tr in trainers.items():  # alternate: drift hits both
+            t0 = time.perf_counter()
+            for toks, tgts in batches:
+                last = tr.train_step(toks, tgts)
+            float(last)  # fetch forces the chain
+            times[k].append((time.perf_counter() - t0) / iters * 1e3)
+    med = {k: sorted(ts)[len(ts) // 2] for k, ts in times.items()}
+    speedup = med["plain"] / max(med["outer"], 1e-9)
+
+    # measured: the outer trainer's boundary exchange program, dcn wire
+    # bytes amortized over the H optimizer steps each exchange serves
+    tr = trainers["outer"]
+    sched = dbg.op_schedule(tr._exchange_fn, tr.params, tr._delta,
+                            tr._outer_m)
+    measured = dbg.amortized_axis_bytes([(sched, 1)], h).get("dcn", 0.0)
+
+    # predicted: the round-22 per-hop interval search over the same
+    # census on the synthetic 3-tier WAN profile — its wan-hop row is
+    # already amortized per optimizer step (price_route intervals)
+    axes = {"wan": 2, "dcn": 2, "data": 2}
+    profile = autotune.synthetic_profile("ici_dcn_wan", axes)
+    census = autotune.grad_census(tr.params)
+    plan = autotune.choose_sync_plan(census, profile, max_sync_every=h)
+    predicted = sum(hp.predicted_bytes for hp in plan.per_hop
+                    if hp.axis.startswith("wan:"))
+    _log("[bench] " + plan.table().replace("\n", "\n[bench] "))
+    _log(f"[bench] wan-diloco A/B (dcn_size=2, sync_every={h}, {n_dev} "
+         f"dev): {med['outer']:.2f} ms/step outer vs {med['plain']:.2f} "
+         f"plain-mean -> {speedup:.3f}x; dcn "
+         f"{measured / 1e6:.3f} MB/opt-step measured, wan "
+         f"{predicted / 1e6:.3f} MB/opt-step predicted "
+         f"(plan outer_opt={plan.outer_opt}, intervals="
+         f"{dict(plan.interval_by_hop)}); final loss plain "
+         f"{losses['plain']:.4f} vs outer {losses['outer']:.4f} "
+         f"({reps} reps median)")
+    return {"speedup": speedup, "ms_outer": med["outer"],
+            "ms_plain": med["plain"], "sync_every": h,
+            "bytes_per_opt_step": measured,
+            "bytes_per_opt_step_predicted": int(predicted),
+            "plan": plan.summary(),
+            "loss_plain": losses["plain"], "loss_outer": losses["outer"]}
+
+
 def canon_telemetry_env(value: str | None) -> bool:
     """Validate the BENCH_TELEMETRY knob: '1' runs the round-13
     telemetry on/off A/B (CPU overhead of the unified event stream),
@@ -1845,6 +1973,10 @@ def main() -> None:
     # pre-bench: BENCH_MOE_A2A=1 A/Bs f32 vs int8 expert all_to_all
     # dispatch (wire bytes + the round-16 flip-rate gate).
     run_moe_a2a = canon_moe_a2a_env(os.environ.get("BENCH_MOE_A2A"))
+    # DiLoCo WAN knob (round 22), validated loudly pre-bench:
+    # BENCH_WAN=1 A/Bs plain-mean vs outer-optimizer window boundaries
+    # at matched H + predicted-vs-measured WAN bytes/optimizer-step.
+    run_wan = canon_wan_env(os.environ.get("BENCH_WAN"))
     # Elastic-recovery knob (round 12), validated loudly pre-bench:
     # BENCH_ELASTIC=1 measures the shrink->reshard->grow recovery gap.
     run_elastic = canon_elastic_env(os.environ.get("BENCH_ELASTIC"))
@@ -1969,6 +2101,16 @@ def main() -> None:
             moe_a2a_ab = bench_moe_a2a()
         except Exception as e:
             _log(f"[bench] moe-a2a A/B failed ({e}); omitting")
+
+    # DiLoCo WAN gate (round 22): outer-optimizer vs plain-mean window
+    # boundaries + the chooser's predicted WAN bytes/optimizer-step vs
+    # the inspector's measured figure; optional like the other gates.
+    wan_ab = None
+    if run_wan:
+        try:
+            wan_ab = bench_wan_diloco()
+        except Exception as e:
+            _log(f"[bench] wan-diloco A/B failed ({e}); omitting")
 
     # Elastic-recovery gate (round 12): shrink -> load_resharded -> grow
     # on the LM trainer; optional like the other gates.
@@ -2167,6 +2309,25 @@ def main() -> None:
                                    if moe_a2a_ab is not None else None),
         "moe_router_flip_rate": (round(moe_a2a_ab["fliprate"], 5)
                                  if moe_a2a_ab is not None else None),
+        # DiLoCo WAN leg (round 22, BENCH_WAN=1): plain-mean vs outer-
+        # optimizer window boundaries at matched H (~1.0x expected —
+        # the outer step is off the wire), the boundary exchange's
+        # measured dcn bytes amortized per optimizer step, the route
+        # chooser's predicted WAN-hop bytes/optimizer-step on the
+        # synthetic 3-tier profile (both deterministic accounting,
+        # tight-banded in bench_compare), and the chooser's routed
+        # plan.  All null when the A/B is skipped.
+        "wan_diloco_speedup": (round(wan_ab["speedup"], 3)
+                               if wan_ab is not None else None),
+        "wan_diloco_bytes_per_opt_step": (wan_ab["bytes_per_opt_step"]
+                                          if wan_ab is not None else None),
+        "wan_bytes_per_opt_step_predicted": (
+            wan_ab["bytes_per_opt_step_predicted"]
+            if wan_ab is not None else None),
+        "wan_diloco_plan": (wan_ab["plan"]
+                            if wan_ab is not None else None),
+        "wan_diloco_sync_every": (wan_ab["sync_every"]
+                                  if wan_ab is not None else None),
         # elastic-recovery gate (round 12, BENCH_ELASTIC=1): wall-clock
         # of the in-process shrink recovery (mesh rebuild + cross-
         # topology load_resharded + one proving step at the smaller

@@ -120,6 +120,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "interval dimension and the monitor's "
                         "sync-relax actuator (default: the --sync-every "
                         "value — relaxation stays opt-in)")
+    p.add_argument("--outer-opt", default=None,
+                   choices=["nesterov", "momentum"],
+                   help="DiLoCo outer optimizer (round 22): at each "
+                        "--sync-every window boundary, treat the averaged "
+                        "window delta as an outer gradient and apply it "
+                        "through a momentum/Nesterov step on the anchor "
+                        "instead of adding the plain mean (zero momentum "
+                        "with unit outer lr is bitwise the plain mean)")
+    p.add_argument("--outer-momentum", type=float, default=0.9,
+                   help="outer optimizer momentum (0 <= mu < 1; DiLoCo's "
+                        "reference value is 0.9)")
+    p.add_argument("--outer-lr", type=float, default=1.0,
+                   help="outer optimizer learning rate on the averaged "
+                        "window delta (> 0; 1.0 = step by the full mean)")
+    p.add_argument("--sync-every-per-slice", default=None,
+                   help="comma-separated per-slice window lengths (LM "
+                        "trainer only — the VGG trainer's windows are "
+                        "gang-wide; this parser refuses it loudly so the "
+                        "two CLIs stay flag-compatible)")
     p.add_argument("--model", default="VGG11",
                    choices=["VGG11", "VGG13", "VGG16", "VGG19"])
     p.add_argument("--epochs", type=int, default=1)     # main.py:106
@@ -238,7 +257,18 @@ def main(argv: list[str] | None = None) -> int:
                      "it (or drop the bounds)")
     max_sync_every = (args.max_sync_every if args.max_sync_every is not None
                       else max(args.sync_every, 1))
-    if args.sync_every != 1 or max_sync_every != 1:
+    sync_every_per_slice = None
+    if args.sync_every_per_slice is not None:
+        try:
+            sync_every_per_slice = tuple(
+                int(x) for x in args.sync_every_per_slice.split(","))
+        except ValueError:
+            parser.error(
+                f"--sync-every-per-slice must be a comma-separated list of "
+                f"ints, got {args.sync_every_per_slice!r}")
+    if (args.sync_every != 1 or max_sync_every != 1
+            or args.outer_opt is not None
+            or sync_every_per_slice is not None):
         # window coherence at the parser (the ONE require_* definition
         # site the Trainer re-checks): meshless strategies have no
         # collective to amortize, overlap streams the per-step sync a
@@ -250,7 +280,11 @@ def main(argv: list[str] | None = None) -> int:
                 sync_every=args.sync_every,
                 max_sync_every=max_sync_every,
                 mesh=not meshless, overlap=args.overlap,
-                trainer="train")
+                trainer="train",
+                outer_opt=args.outer_opt,
+                outer_momentum=args.outer_momentum,
+                outer_lr=args.outer_lr,
+                sync_every_per_slice=sync_every_per_slice)
         except ValueError as e:
             parser.error(str(e))
 
@@ -284,6 +318,8 @@ def main(argv: list[str] | None = None) -> int:
         dcn_compress=args.dcn_compress, overlap=args.overlap,
         overlap_bucket_mb=args.overlap_bucket_mb,
         sync_every=args.sync_every, max_sync_every=max_sync_every,
+        outer_opt=args.outer_opt, outer_momentum=args.outer_momentum,
+        outer_lr=args.outer_lr,
         autotune_profile=args.autotune_profile,
         sync_route=args.sync_route,
     )

@@ -288,6 +288,29 @@ class LMTrainConfig:
     # sync_every up to this bound on a step-time SLO breach).  Default
     # 1: relaxation is strictly opt-in.
     max_sync_every: int = 1
+    # DiLoCo outer optimizer (round 22): at each window boundary the
+    # anchor moves by outer_opt(mean delta) instead of the plain mean —
+    # Nesterov/heavy-ball momentum ON THE ANCHOR (f32, host-side per
+    # device like the EF residual) recovers convergence lost to wide
+    # windows, so H can widen at matched quality (measured band,
+    # tests/test_diloco.py).  None (default) is the round-18 plain
+    # mean, UNTOUCHED at build time; momentum==0 ∧ lr==1 collapses to
+    # the same plain-add branch (OuterOptimizer.trivial) — bitwise.
+    outer_opt: str | None = None      # None | "nesterov" | "momentum"
+    outer_momentum: float = 0.9
+    outer_lr: float = 1.0
+    # Per-slice non-uniform windows (round 22): each WAN-attached slice
+    # owns its own H_i (a multiple of the base sync_every, which must
+    # equal min(H_i)).  At a base boundary only slices with
+    # step % H_i == 0 participate: skippers contribute an EXACT zero
+    # delta through a (dcn,)-shaped participation mask inside the
+    # exchange (EF ledger invariant pinned) and keep accumulating
+    # locally; participants' deltas average over ALL n_dcn slices and
+    # everyone adopts the anchor move, so params stay replicated.  The
+    # per-slice SyncRelaxHook widens a straggling slice's own H without
+    # staling healthy slices.  None (default) = uniform windows,
+    # bitwise (build-time branch).
+    sync_every_per_slice: tuple | None = None
     @property
     def dtype(self) -> jnp.dtype | None:
         """compute_dtype resolved to a jnp dtype (None = float32 params)."""
@@ -380,7 +403,8 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
                 "hand-emitted without the stateful sync-state channel "
                 "(open item); drop the pipeline or the compression")
     if (cfg.sync_every != 1 or cfg.staleness != 0
-            or cfg.max_sync_every != 1):
+            or cfg.max_sync_every != 1 or cfg.outer_opt is not None
+            or cfg.sync_every_per_slice is not None):
         # the ONE window-coherence check site (round 18,
         # parallel/strategies.py require_* consolidation): interval
         # bounds, staleness-vs-window ordering, and the combos the LM
@@ -392,7 +416,9 @@ def validate_lm_cfg(cfg: LMTrainConfig) -> None:
             max_sync_every=cfg.max_sync_every, mesh=True,
             overlap=cfg.overlap, pp=cfg.pp > 1 or cfg.pp_size > 0,
             grad_accum=cfg.grad_accum, dcn_size=cfg.dcn_size,
-            trainer="lm")
+            trainer="lm", outer_opt=cfg.outer_opt,
+            outer_momentum=cfg.outer_momentum, outer_lr=cfg.outer_lr,
+            sync_every_per_slice=cfg.sync_every_per_slice)
     if cfg.fsdp_gather_dtype is not None:
         if cfg.fsdp_gather_dtype not in ("int8", "int4"):
             raise ValueError(
@@ -1471,6 +1497,19 @@ def _lm_window_wire_bytes(cfg: LMTrainConfig, mesh: Mesh) -> int:
     return total
 
 
+def _lm_outer(cfg: LMTrainConfig):
+    """The configured DiLoCo outer optimizer, or None for the plain-mean
+    boundary — also None when trivial (momentum==0 ∧ lr==1), the
+    build-time collapse that keeps zero-momentum bitwise ≡ round 18."""
+    from .parallel.strategies import OuterOptimizer
+    if cfg.sync_every > 1 and cfg.outer_opt is not None:
+        outer = OuterOptimizer(cfg.outer_opt, cfg.outer_momentum,
+                               cfg.outer_lr)
+        if not outer.trivial:
+            return outer
+    return None
+
+
 def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
     """The communication-sparse program family (round 18,
     ``sync_every = H > 1`` on the factored multislice mesh):
@@ -1498,7 +1537,23 @@ def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
       (dispatched S steps later) folds the average into the anchor and
       subtracts the snapshot from the live delta — local progress made
       during the S steps is kept, and the DCN round-trip has S local
-      steps to drain under."""
+      steps to drain under.
+
+    Round 22 grows two build-time variants on the boundary programs
+    (the legacy plain-mean/uniform branches stay byte-identical):
+
+    - ``cfg.outer_opt``: ``exchange``/``apply`` take (and return) the
+      DiLoCo outer-momentum tree ``m`` and move the anchor by
+      ``outer_opt(mean delta)`` instead of the plain add.
+    - ``cfg.sync_every_per_slice``: ``exchange`` takes a host-computed
+      (n_dcn,) f32 participation MASK — slices with mask==0 contribute
+      an exact zero delta (masked before prescale, inside the
+      shard_map, so the EF residual ledger stays exact) and keep their
+      accumulated delta; the mean still divides by all n_dcn slices
+      and every slice adopts the anchor move, so params stay
+      replicated.  Argument order: ``[anchor, delta]``
+      ``+ [sync_state] if dcn_compress + [m] if outer + [mask] if
+      per-slice``; returns mirror the inputs minus the mask."""
     tx = make_optimizer(cfg)
     grad_step = _make_window_grad_step(cfg, mesh)
     specs = param_specs(cfg)
@@ -1524,8 +1579,19 @@ def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
                         if a not in compat.vma_of(x))
         return compat.pcast(x, missing, to="varying") if missing else x
 
-    def _ex_core(delta, residual):
+    outer = _lm_outer(cfg)
+    use_outer = outer is not None
+    per_slice = cfg.sync_every_per_slice is not None
+
+    def _ex_core(delta, residual, mask=None):
         d = jax.tree.map(lambda x: x[0], delta)
+        if mask is not None:
+            # per-slice windows (round 22): zero a skipping slice's
+            # contribution BEFORE prescale, inside the shard_map — the
+            # downstream int8/int4 ring quantizes the masked value, so
+            # the EF residual ledger stays exact (invariant-pinned)
+            my = mask[jax.lax.axis_index(DCN)]
+            d = jax.tree.map(lambda x: x * my.astype(x.dtype), d)
         d = jax.tree.map(_prescale, d, specs)
         d = jax.tree.map(_vary_all, d)
         if compress:
@@ -1535,16 +1601,37 @@ def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
             return d_avg, new_r[None]
         return _two_level_sync(d, specs, bucket_bytes=bucket_bytes)
 
+    ex_core_m = None
     if compress:
+        if per_slice:
+            ex_core_m = shard_map(
+                _ex_core, mesh=mesh, in_specs=(dspec, rspec, P()),
+                out_specs=(specs, rspec), check_vma=False)
         ex_core = shard_map(
             _ex_core, mesh=mesh, in_specs=(dspec, rspec),
             out_specs=(specs, rspec),
             # the ring's ppermute-assembled result (see _make_grad_step)
             check_vma=False)
     else:
+        if per_slice:
+            ex_core_m = shard_map(
+                lambda delta, mask: _ex_core(delta, None, mask),
+                mesh=mesh, in_specs=(dspec, P()), out_specs=specs,
+                # the varying-index mask gather defeats the static
+                # replication proof the same way the ring assembly does
+                check_vma=False)
         ex_core = shard_map(
             lambda delta: _ex_core(delta, None), mesh=mesh,
             in_specs=(dspec,), out_specs=specs)
+
+    def _mask_reset(delta, mask):
+        # participants (mask==1) restart their window from zero;
+        # skippers keep the accumulated delta — a jnp.where select, so
+        # the kept values are bitwise untouched
+        def reset(x):
+            mb = mask.reshape((n_dcn,) + (1,) * (x.ndim - 1))
+            return jnp.where(mb != 0, jnp.zeros_like(x), x)
+        return jax.tree.map(reset, delta)
 
     @partial(jax.jit, donate_argnums=compat.donate(1, 2))
     def local_step(anchor, delta, opt_state, tokens, targets, step_no=0,
@@ -1567,11 +1654,32 @@ def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
         return delta, opt_state, loss, ok, met
 
     if compress:
-        @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
-        def exchange(anchor, delta, sync_state):
-            d_avg, sync_state = ex_core(delta, sync_state)
-            anchor = jax.tree.map(jnp.add, anchor, d_avg)
-            return anchor, jax.tree.map(jnp.zeros_like, delta), sync_state
+        if use_outer and per_slice:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+            def exchange(anchor, delta, sync_state, m, mask):
+                d_avg, sync_state = ex_core_m(delta, sync_state, mask)
+                anchor, m = outer.apply(anchor, d_avg, m)
+                return anchor, _mask_reset(delta, mask), sync_state, m
+        elif use_outer:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+            def exchange(anchor, delta, sync_state, m):
+                d_avg, sync_state = ex_core(delta, sync_state)
+                anchor, m = outer.apply(anchor, d_avg, m)
+                return (anchor, jax.tree.map(jnp.zeros_like, delta),
+                        sync_state, m)
+        elif per_slice:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
+            def exchange(anchor, delta, sync_state, mask):
+                d_avg, sync_state = ex_core_m(delta, sync_state, mask)
+                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                return anchor, _mask_reset(delta, mask), sync_state
+        else:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
+            def exchange(anchor, delta, sync_state):
+                d_avg, sync_state = ex_core(delta, sync_state)
+                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                return (anchor, jax.tree.map(jnp.zeros_like, delta),
+                        sync_state)
 
         @partial(jax.jit, donate_argnums=compat.donate(1))
         def launch(delta, sync_state):
@@ -1581,21 +1689,49 @@ def make_lm_window_steps(cfg: LMTrainConfig, mesh: Mesh):
             # delta keeps evolving — and gets donated — in between)
             return d_avg, delta, sync_state
     else:
-        @partial(jax.jit, donate_argnums=compat.donate(0, 1))
-        def exchange(anchor, delta):
-            d_avg = ex_core(delta)
-            anchor = jax.tree.map(jnp.add, anchor, d_avg)
-            return anchor, jax.tree.map(jnp.zeros_like, delta)
+        if use_outer and per_slice:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
+            def exchange(anchor, delta, m, mask):
+                d_avg = ex_core_m(delta, mask)
+                anchor, m = outer.apply(anchor, d_avg, m)
+                return anchor, _mask_reset(delta, mask), m
+        elif use_outer:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2))
+            def exchange(anchor, delta, m):
+                d_avg = ex_core(delta)
+                anchor, m = outer.apply(anchor, d_avg, m)
+                return anchor, jax.tree.map(jnp.zeros_like, delta), m
+        elif per_slice:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1))
+            def exchange(anchor, delta, mask):
+                d_avg = ex_core_m(delta, mask)
+                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                return anchor, _mask_reset(delta, mask)
+        else:
+            @partial(jax.jit, donate_argnums=compat.donate(0, 1))
+            def exchange(anchor, delta):
+                d_avg = ex_core(delta)
+                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                return anchor, jax.tree.map(jnp.zeros_like, delta)
 
         @jax.jit
         def launch(delta):
             return ex_core(delta), delta
 
-    @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
-    def apply_pending(anchor, delta, d_avg, snap):
-        anchor = jax.tree.map(jnp.add, anchor, d_avg)
-        delta = jax.tree.map(jnp.subtract, delta, snap)
-        return anchor, delta
+    if use_outer:
+        # staleness-deferred apply with the outer step: the momentum
+        # update happens where the mean delta actually lands
+        @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3, 4))
+        def apply_pending(anchor, delta, d_avg, snap, m):
+            anchor, m = outer.apply(anchor, d_avg, m)
+            delta = jax.tree.map(jnp.subtract, delta, snap)
+            return anchor, delta, m
+    else:
+        @partial(jax.jit, donate_argnums=compat.donate(0, 1, 2, 3))
+        def apply_pending(anchor, delta, d_avg, snap):
+            anchor = jax.tree.map(jnp.add, anchor, d_avg)
+            delta = jax.tree.map(jnp.subtract, delta, snap)
+            return anchor, delta
 
     return local_step, exchange, launch, apply_pending
 
@@ -2475,6 +2611,11 @@ class LMTrainer:
         self._pending = None
         self._window_t0 = None
         self._window_wire_bytes = None
+        # DiLoCo outer optimizer (round 22): the f32 momentum tree on
+        # the anchor (None without outer_opt — the plain-mean boundary)
+        # and the boundary-step counter the telemetry gauge reads
+        self._outer_m = None
+        self._outer_steps = 0
         if cfg.sync_every > 1:
             self._init_window_state()
         self._eval_fn = None
@@ -2575,6 +2716,14 @@ class LMTrainer:
         self._pending = None
         self._window_t0 = None
         self._window_wire_bytes = _lm_window_wire_bytes(cfg, mesh)
+        self._outer_m = None
+        if _lm_outer(cfg) is not None:
+            # f32 momentum shadows the anchor leaf-for-leaf (same
+            # shardings — it moves with the anchor, never the wire)
+            self._outer_m = jax.tree.map(
+                lambda p: jax.device_put(
+                    jnp.zeros(p.shape, jnp.float32), p.sharding),
+                self.params)
 
     def tighten_grad_clip(self, factor: float = 0.5) -> float:
         """Multiply the gradient-clip norm by ``factor`` and rebuild the
@@ -2679,6 +2828,8 @@ class LMTrainer:
         self._pending = None
         self._window_t0 = None
         self._window_wire_bytes = None
+        self._outer_m = None  # fresh momentum after a resize (carry-drop
+        # contract, same as sync_state); _init_window_state re-zeros it
         if cfg.sync_every > 1:
             self._init_window_state()
         self._eval_fn = None
@@ -2857,13 +3008,28 @@ class LMTrainer:
         boundary = self._step % h == 0
         if boundary:
             if s == 0:
+                # round-22 boundary arg packing: [anchor, delta]
+                # + [sync_state] if compressed + [m] if outer
+                # + [mask] if per-slice (mask is never returned)
+                per = self.cfg.sync_every_per_slice
+                args = [self.params, self._delta]
                 if self.sync_state is not None:
-                    self.params, self._delta, self.sync_state = \
-                        self._exchange_fn(self.params, self._delta,
-                                          self.sync_state)
-                else:
-                    self.params, self._delta = self._exchange_fn(
-                        self.params, self._delta)
+                    args.append(self.sync_state)
+                if self._outer_m is not None:
+                    args.append(self._outer_m)
+                if per is not None:
+                    args.append(jnp.asarray(
+                        [1.0 if self._step % hi == 0 else 0.0
+                         for hi in per], jnp.float32))
+                out = list(self._exchange_fn(*args))
+                self.params, self._delta = out[0], out[1]
+                i = 2
+                if self.sync_state is not None:
+                    self.sync_state = out[i]
+                    i += 1
+                if self._outer_m is not None:
+                    self._outer_m = out[i]
+                    self._outer_steps += 1
             else:
                 # staleness-hidden: enqueue the exchange now; the mean
                 # delta lands at step kH + S while local compute runs
@@ -2876,8 +3042,13 @@ class LMTrainer:
         elif self._pending is not None and self._step % h == s:
             d_avg, snap = self._pending
             self._pending = None
-            self.params, self._delta = self._apply_fn(
-                self.params, self._delta, d_avg, snap)
+            if self._outer_m is not None:
+                self.params, self._delta, self._outer_m = self._apply_fn(
+                    self.params, self._delta, d_avg, snap, self._outer_m)
+                self._outer_steps += 1
+            else:
+                self.params, self._delta = self._apply_fn(
+                    self.params, self._delta, d_avg, snap)
         faults.maybe_crash(self._step)
         tel = telemetry.active()
         if tel is not None:
@@ -2888,6 +3059,15 @@ class LMTrainer:
                 telemetry.emit_sync_windows(
                     tel, self._window_t0, self._step - h, h, h,
                     wire_bytes=self._window_wire_bytes, phase="train")
+                if (self.cfg.sync_every_per_slice is not None
+                        or self._outer_m is not None):
+                    telemetry.emit_window_plan(
+                        tel, step=self._step - 1,
+                        sync_every_per_slice=(
+                            self.cfg.sync_every_per_slice),
+                        outer_steps=(self._outer_steps
+                                     if self._outer_m is not None
+                                     else None), phase="train")
             self._emit_cache_size(tel, self.step_fn)
         return loss
 

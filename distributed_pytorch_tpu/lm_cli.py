@@ -183,6 +183,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "autotuner and the monitor's sync-relax "
                         "actuator (default: the --sync-every value — "
                         "relaxation stays opt-in)")
+    p.add_argument("--outer-opt", choices=("nesterov", "momentum"),
+                   default=None,
+                   help="DiLoCo outer optimizer (round 22): move the "
+                        "anchor by outer_opt(mean window delta) at each "
+                        "--sync-every boundary instead of the plain "
+                        "mean — momentum on the anchor recovers "
+                        "convergence lost to wide windows (requires "
+                        "--sync-every > 1)")
+    p.add_argument("--outer-momentum", type=float, default=0.9,
+                   help="outer-optimizer momentum coefficient in "
+                        "[0, 1) (default 0.9; 0 with lr 1 is bitwise "
+                        "the plain mean)")
+    p.add_argument("--outer-lr", type=float, default=1.0,
+                   help="outer-optimizer learning rate (> 0; scales "
+                        "the anchor step, default 1.0)")
+    p.add_argument("--sync-every-per-slice", default=None,
+                   help="per-slice non-uniform windows (round 22): "
+                        "comma-separated H per dcn slice (e.g. '2,4' — "
+                        "one entry per --dcn-size slice, each a "
+                        "multiple of --sync-every with min == "
+                        "--sync-every); a slice skipping a boundary "
+                        "contributes an exact zero delta and keeps "
+                        "accumulating (no --staleness)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer over the data axis")
     # elastic gang membership (round 12; launch.py --elastic is the agent
@@ -308,8 +331,18 @@ def main(argv: list[str] | None = None) -> int:
                      "(each tick block is already checkpointed); drop one")
     max_sync_every = (args.max_sync_every if args.max_sync_every is not None
                       else max(args.sync_every, 1))
+    sync_every_per_slice = None
+    if args.sync_every_per_slice is not None:
+        try:
+            sync_every_per_slice = tuple(
+                int(x) for x in args.sync_every_per_slice.split(","))
+        except ValueError:
+            parser.error("--sync-every-per-slice wants comma-separated "
+                         f"ints (one H per dcn slice), got "
+                         f"{args.sync_every_per_slice!r}")
     if (args.sync_every != 1 or args.staleness != 0
-            or max_sync_every != 1):
+            or max_sync_every != 1 or args.outer_opt is not None
+            or sync_every_per_slice is not None):
         # the ONE definition site for window coherence — the same check
         # validate_lm_cfg runs, surfaced at the parser so incoherent
         # combos die with a usage error instead of a traceback
@@ -321,7 +354,10 @@ def main(argv: list[str] | None = None) -> int:
                 overlap=args.overlap,
                 pp=args.pp > 1 or args.pp_size > 0,
                 grad_accum=args.grad_accum, dcn_size=args.dcn_size,
-                trainer="lm")
+                trainer="lm", outer_opt=args.outer_opt,
+                outer_momentum=args.outer_momentum,
+                outer_lr=args.outer_lr,
+                sync_every_per_slice=sync_every_per_slice)
         except ValueError as e:
             parser.error(str(e))
     if args.elastic:
@@ -374,6 +410,9 @@ def main(argv: list[str] | None = None) -> int:
         remat=args.remat or "none",
         sync_every=args.sync_every, staleness=args.staleness,
         max_sync_every=max_sync_every,
+        outer_opt=args.outer_opt, outer_momentum=args.outer_momentum,
+        outer_lr=args.outer_lr,
+        sync_every_per_slice=sync_every_per_slice,
         sync_plan=args.sync_plan, autotune_profile=args.autotune_profile,
         sync_route=args.sync_route)
     trainer = LMTrainer(cfg)

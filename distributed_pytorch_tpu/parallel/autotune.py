@@ -778,6 +778,16 @@ class SyncPlan:
     sync_every: int = 1
     route: str = ""
     per_hop: tuple = ()
+    # Round 22 (the WAN/DiLoCo dimension): ``outer_opt`` is the chooser's
+    # boundary-update recommendation (None = plain mean; "nesterov" when
+    # a ≥3-tier route widened an interval — wide windows want outer
+    # momentum, the measured convergence-band claim);
+    # ``interval_by_hop`` records the per-tier sync interval assignment
+    # as sorted (axis, H) pairs — ``sync_every`` stays the BASE (minimum)
+    # interval, what the trainer's window cadence compiles to, and the
+    # slower tiers' wider H map to per-slice windows.
+    outer_opt: str | None = None
+    interval_by_hop: tuple = ()
 
     def axis(self, name: str) -> AxisPlan | None:
         for ap in self.per_axis:
@@ -800,6 +810,10 @@ class SyncPlan:
         if self.per_hop:
             out["bytes_by_hop"] = {hp.axis: hp.predicted_bytes
                                    for hp in self.per_hop}
+        if self.outer_opt is not None:
+            out["outer_opt"] = self.outer_opt
+        if self.interval_by_hop:
+            out["interval_by_hop"] = dict(self.interval_by_hop)
         return out
 
     def table(self) -> str:
@@ -821,6 +835,11 @@ class SyncPlan:
                 f"{ap.predicted_ms:.3f} |")
         if self.route:
             lines.append(f"route: {self.route}")
+        if self.interval_by_hop:
+            lines.append("intervals: " + ", ".join(
+                f"{a}:H={h}" for a, h in self.interval_by_hop)
+                + (f" (outer_opt={self.outer_opt})"
+                   if self.outer_opt else ""))
         for hp in self.per_hop:
             lines.append(
                 f"|   hop {hp.axis} | {hp.algorithm} | {hp.launches} | "
@@ -1054,7 +1073,8 @@ def _axis_parts(axis: str, sizes: dict) -> list[tuple[str, int]]:
 
 def price_route(route, census: GradCensus, profile: TopologyProfile, *,
                 bucket_mb: float = strat.BUCKET_CAP_MB,
-                overlap: bool = False) -> dict:
+                overlap: bool = False,
+                intervals: dict[str, int] | None = None) -> dict:
     """Predicted cost of executing ``route`` (a ``routing.HopPlan``) for
     this census on this profile — the hop-graph generalization of
     ``predict_named``: each hop is priced with its axis' LinkModel
@@ -1064,7 +1084,15 @@ def price_route(route, census: GradCensus, profile: TopologyProfile, *,
     ``per_hop`` has one AxisPlan per hop (labelled ``axis:algo`` in
     route grammar) and ``per_axis`` aggregates hop rows per mesh axis —
     the inspector-comparable accounting ``plan_bytes_vs_schedule``
-    cross-checks."""
+    cross-checks.
+
+    ``intervals`` (round 22) prices PER-HOP local-SGD windows: a hop on
+    axis ``a`` with ``intervals[a] = H`` runs once per H optimizer
+    steps, so its bytes/launch-ms/wire-ms/quantize-ms rows are divided
+    by H — the returned figures become amortized per-OPTIMIZER-STEP
+    costs (the predicted WAN bytes/optimizer-step table the round-22
+    bench pins).  Launch counts stay per-exchange.  Default None is the
+    round-20 per-step accounting, untouched."""
     links = profile.links
     sizes = profile.axes
     bucket_bytes = int(bucket_mb * 1024 * 1024)
@@ -1144,6 +1172,16 @@ def price_route(route, census: GradCensus, profile: TopologyProfile, *,
                             * links[a].beta_s_per_byte
                             for a, ni in active) * 1e3
                 e = padded
+    if intervals:
+        # amortize each hop's per-exchange cost over its window: H
+        # optimizer steps share one exchange on this tier (launch
+        # counts stay per-exchange — they describe the boundary
+        # program, not the per-step average)
+        for hi, hop in enumerate(route.hops):
+            h = intervals.get(hop.axis, 1)
+            if h > 1:
+                ob, la, lm, wm, qm = acc[hi]
+                acc[hi] = [ob / h, la, lm / h, wm / h, qm / h]
     per_hop: list[AxisPlan] = []
     by_axis: dict[str, list[float]] = {}
     for hop, (ob, la, lm, wm, qm) in zip(route.hops, acc):
@@ -1237,9 +1275,17 @@ def choose_sync_plan(census: GradCensus, profile: TopologyProfile, *,
                 profile_source=profile.source,
                 census_bytes=census.total_bytes,
                 route=route.describe(), per_hop=tuple(pred["per_hop"]))
-            plan = _interval_for(plan, max_sync_every,
-                                 align=steps_per_loop,
-                                 slow_axis=slowest)
+            if max_sync_every > 1 and len(fast_first) >= 3:
+                # round 22: ≥3-tier meshes price the interval PER HOP
+                # (dcn H × wan H), with the outer-opt recommendation
+                plan = _route_intervals(
+                    plan, route, census, profile, max_sync_every,
+                    overlap=overlap, fast_first=fast_first,
+                    align=steps_per_loop)
+            else:
+                plan = _interval_for(plan, max_sync_every,
+                                     align=steps_per_loop,
+                                     slow_axis=slowest)
             if best is None or plan.predicted_ms < best.predicted_ms - 1e-12:
                 best = plan
     assert best is not None
@@ -1396,6 +1442,55 @@ def _mk_plan(name, pred, *, bucket_mb, dcn_compress, dcn_size, overlap,
         per_axis=tuple(pred["per_axis"]),
         profile_source=profile.source, census_bytes=census.total_bytes,
         route=_route_label(name, dcn_compress, profile))
+
+
+def _route_intervals(plan: SyncPlan, route, census: GradCensus,
+                     profile: TopologyProfile, max_sync_every: int, *,
+                     overlap: bool, fast_first: tuple,
+                     align: int | None = None) -> SyncPlan:
+    """Per-TIER interval assignment for ≥3-level routes (round 22, the
+    WAN generalization of ``_interval_for``): walking tiers
+    fastest→slowest, each slow tier's window H doubles (powers of 2,
+    monotone — a slower tier never syncs more often than a faster one)
+    while its amortized per-step cost still dominates everything that
+    runs more often, then the route re-prices with
+    ``price_route(intervals=...)`` so the candidate competes on the
+    amortized figure.  The plan's ``sync_every`` becomes the BASE
+    (minimum assigned) interval — the trainer's compiled boundary
+    cadence — with the wider tiers recorded in ``interval_by_hop`` (the
+    per-slice-window recommendation), and ``outer_opt`` set to
+    "nesterov": a widened window wants the DiLoCo outer step (the
+    measured wider-window-at-matched-quality band,
+    tests/test_diloco.py).  ``per_axis`` stays per-exchange, like
+    ``_interval_for``."""
+    if max_sync_every <= 1:
+        return plan
+    axis_ms = {ap.axis: ap.predicted_ms for ap in plan.per_axis}
+    intervals: dict[str, int] = {}
+    h_floor = 1
+    for i, a in enumerate(fast_first):
+        if i == 0 or axis_ms.get(a, 0.0) <= 0.0:
+            continue
+        faster = sum(axis_ms[b] / intervals.get(b, 1)
+                     for b in fast_first[:i] if b in axis_ms)
+        h = h_floor
+        while (2 * h <= max_sync_every
+               and (align is None or align % (2 * h) == 0)
+               and axis_ms[a] / h > faster):
+            h *= 2
+        if h > 1:
+            intervals[a] = h
+            h_floor = h
+    if not intervals:
+        return plan
+    pred = price_route(route, census, profile, bucket_mb=plan.bucket_mb,
+                       overlap=overlap, intervals=intervals)
+    return dataclasses.replace(
+        plan, sync_every=min(intervals.values()),
+        predicted_ms=pred["ms_exposed"],
+        per_hop=tuple(pred["per_hop"]),
+        interval_by_hop=tuple(sorted(intervals.items())),
+        outer_opt="nesterov")
 
 
 def _interval_for(plan: SyncPlan, max_sync_every: int,
@@ -1706,6 +1801,11 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
             "strategy='auto' resolves sync_every itself (within "
             "max_sync_every); an explicit sync_every alongside auto is "
             "ambiguous — pin the strategy to pin the window")
+    if cfg.outer_opt is not None:
+        raise ValueError(
+            "strategy='auto' resolves the boundary update itself; an "
+            "explicit outer_opt alongside auto is ambiguous — pin the "
+            "strategy to pin the outer optimizer")
     n = num_devices if num_devices is not None else len(jax.devices())
     if n < 2:
         plan = SyncPlan(strategy="none", bucket_mb=float(strat.BUCKET_CAP_MB),
@@ -1738,6 +1838,7 @@ def resolve_train_auto(cfg, *, num_devices: int | None = None):
         else cfg.dcn_size,
         dcn_compress=plan.dcn_compress,
         sync_every=plan.sync_every,
+        outer_opt=plan.outer_opt,
         overlap_bucket_mb=(cfg.overlap_bucket_mb
                            if cfg.overlap_bucket_mb is not None
                            else plan.bucket_mb))
@@ -1783,6 +1884,11 @@ def resolve_lm_auto(cfg):
             "sync_plan='auto' resolves sync_every itself (within "
             "max_sync_every); an explicit sync_every alongside auto is "
             "ambiguous — drop sync_plan to pin the window by hand")
+    if cfg.outer_opt is not None:
+        raise ValueError(
+            "sync_plan='auto' resolves the boundary update itself; an "
+            "explicit outer_opt alongside auto is ambiguous — drop "
+            "sync_plan to pin the outer optimizer by hand")
     census = grad_census(jax.eval_shape(
         lambda k: tfm.init(k, cfg.model), jax.random.key(0)))
     axes = lm_topology_axes(cfg)
@@ -1805,6 +1911,7 @@ def resolve_lm_auto(cfg):
     resolved = dataclasses.replace(
         cfg, sync_plan=None, dcn_compress=plan.dcn_compress,
         sync_every=plan.sync_every,
+        outer_opt=plan.outer_opt,
         bucket_mb=cfg.bucket_mb if cfg.bucket_mb is not None
         else plan.bucket_mb)
     _emit_plan(plan, side="lm")

@@ -961,6 +961,101 @@ def two_level_psum(grads: PyTree, dcn: str | None, ici: str,
     return synced
 
 
+# -- DiLoCo outer optimizer over window deltas (round 22) -------------------
+#
+# The round-18 window boundary applies the plain cross-slice MEAN of the
+# accumulated deltas to the anchor.  The DiLoCo recipe (PAPERS.md) keeps
+# an OUTER optimizer state on the anchor instead: the mean delta is the
+# outer "gradient", and Nesterov/heavy-ball momentum over it lets a much
+# wider window (H=8+) track the per-step trajectory — the "wider window
+# at matched quality" claim tests/test_diloco.py measures with the
+# round-18 convergence-band methodology.
+
+class OuterOptimizer:
+    """The window-boundary anchor update ``anchor <- anchor + lr * step``
+    where ``step`` is Nesterov (``mu*m' + d``) or heavy-ball (``m'``)
+    momentum over the exchanged mean delta (``m' = mu*m + d``).  Momentum
+    state is f32, anchor-shaped; arithmetic runs in f32 and casts back to
+    each leaf's dtype.
+
+    ``trivial`` (mu == 0 and lr == 1) marks the configuration whose
+    update IS the plain mean fold-in: the trainers branch at BUILD time
+    and emit the round-18 ``jnp.add`` path with no momentum state at all,
+    so zero-momentum outer-opt is bitwise (and jaxpr-census) identical to
+    plain mean — the same build-time-branch discipline that keeps
+    ``sync_every=1`` out of the windowed builders."""
+
+    KINDS = ("nesterov", "momentum")
+
+    def __init__(self, kind: str, momentum: float = 0.9,
+                 lr: float = 1.0):
+        if kind not in self.KINDS:
+            raise ValueError(f"outer_opt must be one of {self.KINDS} "
+                             f"(or None for the plain mean), got {kind!r}")
+        self.kind = kind
+        self.momentum = float(momentum)
+        self.lr = float(lr)
+
+    @property
+    def trivial(self) -> bool:
+        """True when the update degenerates to ``anchor + d_avg`` exactly
+        — callers must then take the plain-mean build path (bitwise)."""
+        return self.momentum == 0.0 and self.lr == 1.0
+
+    # -- tree form (the LM trainer's anchor-shaped momentum) ---------------
+    def init_state(self, anchor: PyTree) -> PyTree:
+        """f32 zero momentum, one leaf per anchor leaf."""
+        return jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), anchor)
+
+    def apply(self, anchor: PyTree, d_avg: PyTree,
+              m: PyTree) -> tuple[PyTree, PyTree]:
+        """One outer step: ``(new_anchor, new_momentum)``.  Static Python
+        branch on ``trivial`` so the degenerate config emits exactly the
+        round-18 plain-mean ops."""
+        if self.trivial:
+            return jax.tree.map(jnp.add, anchor, d_avg), m
+        mu = self.momentum
+        m = jax.tree.map(
+            lambda d, mi: mu * mi + d.astype(jnp.float32), d_avg, m)
+        if self.kind == "nesterov":
+            step = jax.tree.map(
+                lambda d, mi: mu * mi + d.astype(jnp.float32), d_avg, m)
+        else:
+            step = m
+        anchor = jax.tree.map(
+            lambda a, s: (a.astype(jnp.float32)
+                          + self.lr * s).astype(a.dtype), anchor, step)
+        return anchor, m
+
+    # -- flat form (the VGG trainer packs momentum into the sync-state
+    #    carry, after the EF residual segments) ----------------------------
+    @staticmethod
+    def state_len(params: PyTree) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    def init_flat(self, params: PyTree) -> jax.Array:
+        return jnp.zeros((self.state_len(params),), jnp.float32)
+
+    def apply_flat(self, anchor: PyTree, d_avg: PyTree,
+                   flat_m: jax.Array) -> tuple[PyTree, jax.Array]:
+        """``apply`` with the momentum held as ONE flat f32 vector (leaf
+        order, ravelled) — the layout that rides train.py's per-device
+        sync-state carry next to the EF residual segments."""
+        if self.trivial:
+            return jax.tree.map(jnp.add, anchor, d_avg), flat_m
+        leaves, treedef = jax.tree.flatten(anchor)
+        m_leaves, offset = [], 0
+        for leaf in leaves:
+            m_leaves.append(flat_m[offset:offset + leaf.size]
+                            .reshape(leaf.shape))
+            offset += leaf.size
+        m_tree = jax.tree.unflatten(treedef, m_leaves)
+        anchor, m_tree = self.apply(anchor, d_avg, m_tree)
+        return anchor, jnp.concatenate(
+            [m.ravel() for m in jax.tree.leaves(m_tree)])
+
+
 # -- backward-overlapped gradient sync (round 8) ---------------------------
 #
 # The one trick torch DDP plays that the post-backward strategies above do
@@ -1336,7 +1431,11 @@ def require_sync_window(*, sync_every: int, staleness: int = 0,
                         overlap: bool = False, pp: bool = False,
                         grad_accum: int = 1, dcn_size: int | None = None,
                         steps_per_loop: int | None = None,
-                        trainer: str = "train") -> None:
+                        trainer: str = "train",
+                        outer_opt: str | None = None,
+                        outer_momentum: float = 0.9,
+                        outer_lr: float = 1.0,
+                        sync_every_per_slice: tuple | None = None) -> None:
     """The communication-sparse window coherence check
     (``TrainConfig(sync_every=H)`` / ``LMTrainConfig(sync_every=H)``,
     round 18): ONE definition site — the round-9 ``require_*``
@@ -1355,7 +1454,22 @@ def require_sync_window(*, sync_every: int, staleness: int = 0,
     LM windows relax the DCN hop specifically, so they need a factored
     mesh (dcn_size >= 2) to have a slow axis to relax; and bounded
     staleness must leave the window room to hide under (0 <= S < H,
-    S = 0 meaning apply-at-boundary)."""
+    S = 0 meaning apply-at-boundary).
+
+    Round 22 (DiLoCo): ``outer_opt`` (None | 'nesterov' | 'momentum')
+    is the window-boundary anchor optimizer — it updates at boundaries,
+    so it needs a window (sync_every > 1) to have boundaries at all;
+    ``outer_momentum`` must sit in [0, 1) and ``outer_lr`` be positive.
+    ``sync_every_per_slice`` (LM only) gives each 'dcn' slice its own
+    interval: a tuple of dcn_size entries, every entry a multiple of
+    the base ``sync_every`` (slices exchange only at base boundaries,
+    some skipping), with ``min == sync_every`` (the base IS the
+    tightest slice's cadence — anything else would mean boundaries no
+    compiled program runs).  Per-slice windows do not compose with
+    bounded staleness (the skip mask and the deferred apply would both
+    reinterpret the same boundary), and the VGG trainer's windows are
+    gang-wide by construction (one flat replica axis — there is no
+    per-slice program to skip)."""
     if sync_every < 1:
         raise ValueError(f"sync_every must be >= 1, got {sync_every}")
     if max_sync_every < 1:
@@ -1372,6 +1486,60 @@ def require_sync_window(*, sync_every: int, staleness: int = 0,
             f"staleness={staleness} >= sync_every={sync_every}: the "
             f"delayed window exchange must land before the next one "
             f"launches (0 <= S < H; S=0 applies at the boundary step)")
+    if outer_opt is not None:
+        if outer_opt not in OuterOptimizer.KINDS:
+            raise ValueError(
+                f"outer_opt must be None, 'nesterov', or 'momentum', "
+                f"got {outer_opt!r}")
+        if sync_every == 1:
+            raise ValueError(
+                f"outer_opt={outer_opt!r} needs sync_every > 1: the "
+                f"outer step applies at window boundaries — with "
+                f"per-step sync there is no window delta to apply it to")
+        if not 0.0 <= outer_momentum < 1.0:
+            raise ValueError(
+                f"outer_momentum must sit in [0, 1), got "
+                f"{outer_momentum}")
+        if outer_lr <= 0.0:
+            raise ValueError(f"outer_lr must be > 0, got {outer_lr}")
+    if sync_every_per_slice is not None:
+        per = tuple(sync_every_per_slice)
+        if trainer != "lm":
+            raise ValueError(
+                "sync_every_per_slice is an LM-trainer (factored 'dcn' "
+                "mesh) feature: the VGG trainer's windows are gang-wide "
+                "over one flat replica axis — there is no per-slice "
+                "boundary program to skip")
+        if sync_every == 1:
+            raise ValueError(
+                "sync_every_per_slice needs the windowed mode "
+                "(sync_every > 1): the base interval is the compiled "
+                "boundary cadence the per-slice windows subdivide")
+        if staleness > 0:
+            raise ValueError(
+                f"sync_every_per_slice does not compose with "
+                f"staleness={staleness}: the skip mask and the deferred "
+                f"apply would both reinterpret the same boundary; pick "
+                f"one relaxation")
+        if dcn_size is not None and len(per) != dcn_size:
+            raise ValueError(
+                f"sync_every_per_slice has {len(per)} entries but "
+                f"dcn_size={dcn_size}: one interval per slice")
+        if any(not isinstance(h, int) or h < 1 for h in per):
+            raise ValueError(
+                f"sync_every_per_slice entries must be ints >= 1, got "
+                f"{per}")
+        if any(h % sync_every for h in per):
+            raise ValueError(
+                f"every sync_every_per_slice entry must be a multiple "
+                f"of the base sync_every={sync_every} (slices exchange "
+                f"only at base boundaries), got {per}")
+        if min(per) != sync_every:
+            raise ValueError(
+                f"min(sync_every_per_slice)={min(per)} must equal the "
+                f"base sync_every={sync_every}: the base is the "
+                f"tightest slice's cadence — a larger base would mean "
+                f"boundaries no compiled program runs")
     if sync_every == 1:
         return
     if not mesh:

@@ -138,6 +138,18 @@ class TrainConfig:
     # SLO breach).  Default 1: relaxation is OPT-IN — staleness is a
     # convergence trade the user must accept explicitly.
     max_sync_every: int = 1
+    # DiLoCo outer optimizer (round 22): at each window boundary the
+    # anchor moves by outer_opt(mean delta) instead of the plain mean —
+    # Nesterov/heavy-ball momentum ON THE ANCHOR recovers convergence
+    # lost to wide windows (the "wider window at matched quality"
+    # claim, measured in tests/test_diloco.py).  The f32 momentum state
+    # rides the sync_state carry as a flat tail, so the window scan's
+    # signature is unchanged.  None (default) is the round-18 plain
+    # mean, UNTOUCHED at build time; so is momentum==0 ∧ lr==1 (the
+    # OuterOptimizer.trivial collapse) — bitwise, not approximately.
+    outer_opt: str | None = None      # None | "nesterov" | "momentum"
+    outer_momentum: float = 0.9
+    outer_lr: float = 1.0
 
     @property
     def dtype(self):
@@ -259,6 +271,18 @@ def make_train_step(cfg: TrainConfig, strategy: strat.Strategy,
     return step
 
 
+def _outer_of(cfg: TrainConfig) -> strat.OuterOptimizer | None:
+    """The configured DiLoCo outer optimizer, or None for the plain-mean
+    boundary — also None when trivial (momentum==0 ∧ lr==1), which is the
+    build-time collapse that keeps zero-momentum bitwise ≡ round 18."""
+    if cfg.sync_every > 1 and cfg.outer_opt is not None:
+        outer = strat.OuterOptimizer(cfg.outer_opt, cfg.outer_momentum,
+                                     cfg.outer_lr)
+        if not outer.trivial:
+            return outer
+    return None
+
+
 def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                     mesh: Mesh | None, fault_sig: bool | None = None):
     """Build a compiled K-step training loop (``lax.scan`` over stacked
@@ -298,10 +322,17 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
     # jaxpr, specs, compile count — is byte-identical to round 17 by
     # construction, not by test luck.
     windowed = cfg.sync_every > 1
-    if windowed:
+    if windowed or cfg.outer_opt is not None:
         strat.require_sync_window(
             sync_every=cfg.sync_every, max_sync_every=cfg.max_sync_every,
-            mesh=mesh is not None, overlap=cfg.overlap, trainer="train")
+            mesh=mesh is not None, overlap=cfg.overlap, trainer="train",
+            outer_opt=cfg.outer_opt, outer_momentum=cfg.outer_momentum,
+            outer_lr=cfg.outer_lr)
+    # DiLoCo outer optimizer (round 22): built ONLY when configured and
+    # non-trivial, so the plain-mean boundary below stays byte-identical
+    # by construction (same discipline as the sync_every==1 gate).
+    outer = _outer_of(cfg)
+    use_outer = outer is not None
     # The data axis may be factored: hierarchical runs over ('dcn', 'ici').
     data_axes = getattr(strategy, "axes", None) or DATA_AXIS
     bn_axis = data_axes if (cfg.sync_bn and mesh is not None) else None
@@ -497,19 +528,43 @@ def make_multi_step(cfg: TrainConfig, strategy: strat.Strategy,
                 # — the window's ONE slow exchange (shard-sized over dcn
                 # for hierarchical, incl. the int8/int4+EF ring; the
                 # full strategy collective for flat strategies)
-                if hier:
-                    ex = (strategy.window_exchange(delta, axis,
-                                                   sync_state)
-                          if stateful
-                          else strategy.window_exchange(delta, axis))
+                if use_outer:
+                    # the outer momentum rides sync_state as a flat f32
+                    # TAIL after the strategy's residual segments —
+                    # split at a trace-time-static offset, exchange on
+                    # the residual part only, then move the anchor by
+                    # outer_opt(mean delta) instead of the plain add
+                    m_len = strat.OuterOptimizer.state_len(anchor)
+                    res_len = sync_state.shape[0] - m_len
+                    res = sync_state[:res_len]
+                    if hier:
+                        ex = (strategy.window_exchange(delta, axis, res)
+                              if stateful
+                              else strategy.window_exchange(delta, axis))
+                    else:
+                        ex = (strategy(delta, axis, res) if stateful
+                              else strategy(delta, axis))
+                    if stateful:
+                        d_avg, res = ex
+                    else:
+                        d_avg = ex
+                    anchor, m_flat = outer.apply_flat(
+                        anchor, d_avg, sync_state[res_len:])
+                    sync_state = jnp.concatenate([res, m_flat])
                 else:
-                    ex = (strategy(delta, axis, sync_state) if stateful
-                          else strategy(delta, axis))
-                if stateful:
-                    d_avg, sync_state = ex
-                else:
-                    d_avg = ex
-                anchor = jax.tree.map(jnp.add, anchor, d_avg)
+                    if hier:
+                        ex = (strategy.window_exchange(delta, axis,
+                                                       sync_state)
+                              if stateful
+                              else strategy.window_exchange(delta, axis))
+                    else:
+                        ex = (strategy(delta, axis, sync_state)
+                              if stateful else strategy(delta, axis))
+                    if stateful:
+                        d_avg, sync_state = ex
+                    else:
+                        d_avg = ex
+                    anchor = jax.tree.map(jnp.add, anchor, d_avg)
                 delta = jax.tree.map(jnp.zeros_like, delta)
                 return (anchor, delta, state, opt_state, sync_state,
                         step), outs
@@ -764,7 +819,9 @@ class Trainer:
         strat.require_sync_window(
             sync_every=cfg.sync_every, max_sync_every=cfg.max_sync_every,
             mesh=self.mesh is not None, overlap=cfg.overlap,
-            steps_per_loop=cfg.steps_per_loop, trainer="train")
+            steps_per_loop=cfg.steps_per_loop, trainer="train",
+            outer_opt=cfg.outer_opt, outer_momentum=cfg.outer_momentum,
+            outer_lr=cfg.outer_lr)
 
         key = jax.random.key(cfg.seed)
         self.init_key, self.data_key = jax.random.split(key)
@@ -779,6 +836,14 @@ class Trainer:
             sync_state = self.strategy.init_state(params, self.n_replicas)
         else:
             sync_state = jnp.zeros((0,), jnp.float32)
+        if _outer_of(cfg) is not None:
+            # DiLoCo outer momentum (round 22): a flat f32 tail appended
+            # after the strategy's residual segments — same carry slot,
+            # so the window scan's signature and specs are unchanged
+            sync_state = jnp.concatenate(
+                [sync_state,
+                 jnp.zeros((strat.OuterOptimizer.state_len(params),),
+                           jnp.float32)])
         sync_state = jnp.broadcast_to(
             sync_state[None], (self.n_replicas,) + sync_state.shape)
 
@@ -1098,7 +1163,8 @@ class Trainer:
                 sync_every=cfg.sync_every,
                 max_sync_every=cfg.max_sync_every, mesh=True,
                 overlap=cfg.overlap, steps_per_loop=cfg.steps_per_loop,
-                trainer="train")
+                trainer="train", outer_opt=cfg.outer_opt,
+                outer_momentum=cfg.outer_momentum, outer_lr=cfg.outer_lr)
             self.cfg = cfg
         if not self.strategy.needs_mesh:
             raise ValueError(
@@ -1162,6 +1228,13 @@ class Trainer:
                                                   self.n_replicas)
         else:
             sync_state = jnp.zeros((0,), jnp.float32)
+        if _outer_of(self.cfg) is not None:
+            # fresh outer momentum after a resize (anchor topology
+            # changed; same convention as the EF residual reset)
+            sync_state = jnp.concatenate(
+                [sync_state,
+                 jnp.zeros((strat.OuterOptimizer.state_len(params_host),),
+                           jnp.float32)])
         self.sync_state = jax.device_put(
             jnp.broadcast_to(sync_state[None],
                              (self.n_replicas,) + sync_state.shape), shd)

@@ -686,13 +686,57 @@ class SyncRelaxHook:
     The rebuild drops per-device optimizer divergence and any
     un-exchanged window delta (both trainers' documented carry-drop
     contract) — acceptable for an actuator that fires on the SLO
-    cadence, not per step."""
+    cadence, not per step.
 
-    def __init__(self, trainer, *, rule: str = "step_time", log=None):
+    Round 22 adds the PER-SLICE mode: ``slice_rules`` maps additional
+    rule names to slice indices (e.g. ``{"step_time_site1": 1}`` from
+    a rule scoped to one WAN site's spans).  A breach of a mapped rule
+    widens ONLY that slice's entry in ``cfg.sync_every_per_slice``
+    (doubling within ``max_sync_every``), so a straggling site
+    amortizes its own WAN hop without staling the healthy slices; the
+    clear narrows that slot back to its base.  Widen/narrow always
+    move by powers of two from the base tuple, so the checker's
+    min/multiple invariants hold at every transition (the gang-wide
+    base interval is ``min`` of the tuple and never rises above the
+    healthy slices' base)."""
+
+    def __init__(self, trainer, *, rule: str = "step_time", log=None,
+                 slice_rules: dict[str, int] | None = None):
         self.trainer = trainer
         self.rule = rule
         self.log = log
         self.base = trainer.cfg.sync_every
+        self.slice_rules = dict(slice_rules or {})
+        per = getattr(trainer.cfg, "sync_every_per_slice", None)
+        dcn = getattr(trainer.cfg, "dcn_size", 1) or 1
+        # the base tuple the clear narrows back to (uniform windows
+        # expand to (H, ..., H) on first per-slice widen)
+        self.base_slices = (tuple(per) if per is not None
+                           else (self.base,) * dcn)
+        self.had_per = per is not None  # narrow restores None when the
+        # config started uniform (the bitwise build-time branch)
+
+    def _emit(self, cur: int | tuple, target: int | tuple,
+              direction: str, st: SloState,
+              slice_idx: int | None = None) -> None:
+        scope = "" if slice_idx is None else f" [slice {slice_idx}]"
+        msg = (f"[monitor] request_sync_relax{scope}: sync_every "
+               f"{cur} -> {target} ({direction}, rule {st.rule.name})")
+        log_line(msg)
+        if self.log is not None:
+            try:
+                self.log(msg)
+            except Exception:
+                pass
+        tel = telemetry.active()
+        if tel is not None:
+            extra = {} if slice_idx is None else {"slice": slice_idx}
+            tel.event("request_sync_relax", phase="slo",
+                      rule=st.rule.name, direction=direction,
+                      sync_every=(target if isinstance(target, int)
+                                  else min(target)), previous=str(cur),
+                      max_sync_every=self.trainer.cfg.max_sync_every,
+                      **extra)
 
     def _retarget(self, target: int, direction: str,
                   st: SloState) -> None:
@@ -706,22 +750,46 @@ class SyncRelaxHook:
             # must not kill the doctor — log the refusal and stand down
             log_line(f"[monitor] sync relax refused: {e}")
             return
-        msg = (f"[monitor] request_sync_relax: sync_every {cur} -> "
-               f"{target} ({direction}, rule {st.rule.name})")
-        log_line(msg)
-        if self.log is not None:
-            try:
-                self.log(msg)
-            except Exception:
-                pass
-        tel = telemetry.active()
-        if tel is not None:
-            tel.event("request_sync_relax", phase="slo",
-                      rule=st.rule.name, direction=direction,
-                      sync_every=target, previous=cur,
-                      max_sync_every=self.trainer.cfg.max_sync_every)
+        self._emit(cur, target, direction, st)
+
+    def _retarget_slice(self, idx: int, direction: str,
+                        st: SloState) -> None:
+        cfg = self.trainer.cfg
+        per = getattr(cfg, "sync_every_per_slice", None)
+        cur = list(per if per is not None else self.base_slices)
+        if idx < 0 or idx >= len(cur):
+            log_line(f"[monitor] sync relax refused: slice {idx} out "
+                     f"of range for {len(cur)} slices")
+            return
+        prev = tuple(cur)
+        if direction == "widen":
+            cur[idx] = min(max(2 * cur[idx], 2),
+                           max(cfg.max_sync_every, 1))
+        else:
+            cur[idx] = self.base_slices[idx]
+        target = tuple(cur)
+        if target == prev:
+            # already at the ceiling/base (or a narrow on a trainer
+            # that never widened): no rebuild, no event
+            return
+        install = (None if (target == self.base_slices
+                            and not self.had_per) else target)
+        try:
+            # the base interval follows min(tuple): the checker's
+            # min(per_slice) == sync_every invariant, preserved because
+            # every slot moves in powers of two from a common base
+            self.trainer.rebuild(sync_every=min(target),
+                                 sync_every_per_slice=install)
+        except (TypeError, ValueError) as e:
+            log_line(f"[monitor] sync relax refused: {e}")
+            return
+        self._emit(prev, target, direction, st, slice_idx=idx)
 
     def breach(self, st: SloState) -> None:
+        if st.rule.name in self.slice_rules:
+            self._retarget_slice(self.slice_rules[st.rule.name],
+                                 "widen", st)
+            return
         if st.rule.name != self.rule:
             return
         cur = self.trainer.cfg.sync_every
@@ -730,6 +798,10 @@ class SyncRelaxHook:
                        "widen", st)
 
     def clear(self, st: SloState) -> None:
+        if st.rule.name in self.slice_rules:
+            self._retarget_slice(self.slice_rules[st.rule.name],
+                                 "narrow", st)
+            return
         if st.rule.name != self.rule:
             return
         self._retarget(self.base, "narrow", st)

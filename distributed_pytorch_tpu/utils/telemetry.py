@@ -450,6 +450,26 @@ def emit_sync_windows(tel: Telemetry, t0: float, step0: int, k: int,
     tel.counter("sync_windows", windows, phase=phase)
 
 
+def emit_window_plan(tel: Telemetry, *, step: int,
+                     sync_every_per_slice=None,
+                     outer_steps: int | None = None,
+                     phase: str = "train") -> None:
+    """Round-22 boundary gauges for the DiLoCo layer: one
+    ``sync_every_slice{i}`` gauge per WAN-attached slice (so the
+    RunDoctor timeline shows WHICH slice the per-slice SyncRelaxHook
+    widened, and when it narrowed back) and an ``outer_opt_steps``
+    gauge counting applied outer-optimizer steps.  Both are no-ops
+    when the feature is off — the uniform/plain-mean path emits
+    exactly what it emitted in round 18."""
+    if sync_every_per_slice is not None:
+        for i, h in enumerate(sync_every_per_slice):
+            tel.gauge(f"sync_every_slice{i}", float(h), phase=phase,
+                      step=int(step))
+    if outer_steps is not None:
+        tel.gauge("outer_opt_steps", float(outer_steps), phase=phase,
+                  step=int(step))
+
+
 # ---------------------------------------------------------------------------
 # exporter: merge every rank's files -> Chrome trace + run summary
 

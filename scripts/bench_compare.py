@@ -90,6 +90,15 @@ RULES: dict[str, tuple[str, float]] = {
     # dcn-int4 byte keys
     "moe_a2a_bytes_per_step": ("lower", 0.02),
     "moe_a2a_dispatch_ratio": ("lower", 0.02),
+    # round 22: DiLoCo WAN leg — the measured boundary-exchange bytes
+    # per optimizer step and the chooser's predicted WAN-hop figure are
+    # both deterministic accounting (inspector payloads / alpha-beta
+    # pricing of a fixed census), same tight band as the other byte
+    # keys; the plain-vs-outer wall-clock is a median like the other
+    # speedups (~1.0x expected — the outer step is off the wire)
+    "wan_diloco_speedup": ("higher", 0.10),
+    "wan_diloco_bytes_per_opt_step": ("lower", 0.02),
+    "wan_bytes_per_opt_step_predicted": ("lower", 0.02),
 }
 
 # absolute ceilings: gate on the NEW value alone (acceptance bounds,

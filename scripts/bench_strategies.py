@@ -475,6 +475,65 @@ def bench_hierarchical_localsgd(
     return sum(times) / len(times), comm, False
 
 
+def bench_wan_diloco(sync_every: int = 4) -> tuple[float, dict, bool]:
+    """The DiLoCo row (round 22): the hierarchical local-SGD window of
+    the row above with the Nesterov OUTER optimizer applied to the
+    averaged window delta at each boundary — same factored mesh, same
+    amortized per-axis wire accounting, so the dcn/ici MB column must
+    MATCH ``hierarchical_localsgd`` at equal H (outer momentum rides
+    the anchor update, not the exchange; the wire is identical).  The
+    s/step delta vs that row prices the outer step itself (one
+    O(params) momentum update per window).  s/step IS comparable to
+    the VGG rows above."""
+    from distributed_pytorch_tpu.train import make_multi_step
+
+    cfg = TrainConfig(strategy="hierarchical", dcn_size=2,
+                      sync_every=sync_every, max_sync_every=sync_every,
+                      outer_opt="nesterov", outer_momentum=0.9,
+                      steps_per_loop=sync_every,
+                      batch_size=PER_DEV_BATCH, augment=False)
+    tr = Trainer(cfg)
+    n = tr.n_replicas
+    rng = np.random.default_rng(0)
+    images = rng.integers(
+        0, 256,
+        (sync_every, PER_DEV_BATCH * n, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(
+        0, 10, (sync_every, PER_DEV_BATCH * n)).astype(np.int32)
+
+    tr.train_steps(images, labels)  # compile + warm-up (excluded)
+    img, lbl = tr._stage(images, labels)
+    args = tr._args(img, lbl)
+    if tr._multi_fn is None:
+        tr._multi_fn = make_multi_step(tr.cfg, tr.strategy, tr.mesh,
+                                       fault_sig=tr._fault_sig)
+    sched = dbg.op_schedule(tr._multi_fn, *args)
+    stats = dbg.collective_stats(sched)
+    per_axis = dbg.per_axis_collective_stats(sched)
+    hlo = dbg.hlo_collective_counts(tr._multi_fn.lower(*args).as_text())
+    comm = {"comm_bytes_per_step": stats["bytes_executed"] / sync_every,
+            "collective_count": stats["executions"],
+            "comm_bytes_static": stats["bytes"],
+            "collective_count_static": stats["total"],
+            "collectives_interleaved": stats["interleaved"],
+            "comm_bytes_by_axis": dbg.amortized_axis_bytes(
+                [(sched, 1)], sync_every),
+            "collective_count_by_axis": {a: s["executions"]
+                                         for a, s in per_axis.items()},
+            "hlo_collective_count": hlo.pop("total"),
+            "hlo_collectives": hlo,
+            "predicted_ms": None,
+            "sync_every": sync_every,
+            "outer_opt": "nesterov"}
+    times = []
+    for _ in range(WINDOW):
+        t0 = time.perf_counter()
+        losses = tr.train_steps(images, labels)
+        float(losses[-1])  # value fetch: the honest end-of-step barrier
+        times.append((time.perf_counter() - t0) / sync_every)
+    return sum(times) / len(times), comm, False
+
+
 def bench_lm_pp(pp_size: int = 2,
                 microbatches: int = 4) -> tuple[float, dict, bool]:
     """The interleaved-1F1B pipeline row (round 10): a small LM on the
@@ -554,6 +613,17 @@ def main() -> None:
     results["hierarchical_localsgd"] = t
     comms["hierarchical_localsgd"] = comm
     print(json.dumps({"strategy": "hierarchical_localsgd",
+                      "sec_per_step": round(t, 4), "window": WINDOW,
+                      "per_dev_batch": PER_DEV_BATCH, "overlap": False,
+                      **comm}), flush=True)
+    # the DiLoCo row (round 22): the same window with the Nesterov
+    # outer optimizer at the boundary — wire identical to the row
+    # above, the s/step delta prices the outer step
+    t, comm, _ = bench_wan_diloco()
+    names.append("wan_diloco")
+    results["wan_diloco"] = t
+    comms["wan_diloco"] = comm
+    print(json.dumps({"strategy": "wan_diloco",
                       "sec_per_step": round(t, 4), "window": WINDOW,
                       "per_dev_batch": PER_DEV_BATCH, "overlap": False,
                       **comm}), flush=True)

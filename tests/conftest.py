@@ -197,3 +197,18 @@ def pytest_configure(config):
         "are fast and ride tier-1 via `-m 'not slow'` (wired like the "
         "`faults`/`elastic`/`fleet`/`monitor`/`memory`/`localsgd`/"
         "`routing` lanes).")
+    config.addinivalue_line(
+        "markers",
+        "diloco: DiLoCo WAN-training lane (round 22) — `pytest -m "
+        "diloco` runs the outer-optimizer machinery (tests/"
+        "test_diloco.py: the trivial-outer == plain-mean bitwise pins "
+        "on both trainers, the masked per-slice exchange's exact "
+        "zero-delta + EF-ledger invariant, the per-hop interval "
+        "chooser matrix on uniform/wan_dcn/ici_dcn_wan with the "
+        "amortized WAN bytes/optimizer-step table, the convergence-"
+        "band claim (outer H=8 tracks H=1 at least as closely as "
+        "plain-mean H=4), require_sync_window refusals, and the "
+        "auto-vs-explicit outer_opt ambiguity pins).  All diloco "
+        "tests are fast and ride tier-1 via `-m 'not slow'` (wired "
+        "like the `faults`/`elastic`/`fleet`/`monitor`/`memory`/"
+        "`localsgd`/`routing`/`a2a` lanes).")

@@ -130,6 +130,58 @@ def test_parser_localsgd_flags():
         cli.main(["--strategy", "none", "--sync-every", "2"])
 
 
+def test_parser_diloco_flags():
+    """Round-22 surface: --outer-opt/--outer-momentum/--outer-lr/
+    --sync-every-per-slice reach both CLIs (defaults None/0.9/1.0/None
+    so historical invocations are byte-identical); malformed values and
+    incoherent combos refuse loudly at the parser through the SAME
+    require_sync_window check the trainers run."""
+    import pytest
+
+    from distributed_pytorch_tpu import lm_cli
+
+    for parser in (cli.build_parser(), lm_cli.build_parser()):
+        args = parser.parse_args([])
+        assert args.outer_opt is None
+        assert args.outer_momentum == 0.9 and args.outer_lr == 1.0
+        assert args.sync_every_per_slice is None
+
+    lm_args = lm_cli.build_parser().parse_args(
+        ["--dp", "4", "--dcn-size", "2", "--sync-every", "4",
+         "--outer-opt", "nesterov", "--outer-momentum", "0.5",
+         "--outer-lr", "0.7", "--sync-every-per-slice", "4,8"])
+    assert lm_args.outer_opt == "nesterov"
+    assert lm_args.outer_momentum == 0.5 and lm_args.outer_lr == 0.7
+    assert lm_args.sync_every_per_slice == "4,8"
+
+    # refusals (argparse SystemExit, pre-init — the one definition site)
+    with pytest.raises(SystemExit):  # unknown outer optimizer
+        lm_cli.build_parser().parse_args(["--outer-opt", "adamw"])
+    with pytest.raises(SystemExit):  # outer needs a window
+        lm_cli.main(["--dp", "4", "--dcn-size", "2",
+                     "--outer-opt", "nesterov"])
+    with pytest.raises(SystemExit):  # momentum bound
+        lm_cli.main(["--dp", "4", "--dcn-size", "2", "--sync-every",
+                     "4", "--outer-opt", "nesterov",
+                     "--outer-momentum", "1.5"])
+    with pytest.raises(SystemExit):  # malformed per-slice list
+        lm_cli.main(["--dp", "4", "--dcn-size", "2", "--sync-every",
+                     "4", "--sync-every-per-slice", "4,x"])
+    with pytest.raises(SystemExit):  # per-slice + staleness
+        lm_cli.main(["--dp", "4", "--dcn-size", "2", "--sync-every",
+                     "4", "--staleness", "1",
+                     "--sync-every-per-slice", "4,8"])
+    with pytest.raises(SystemExit):  # min(per-slice) must be the base
+        lm_cli.main(["--dp", "4", "--dcn-size", "2", "--sync-every",
+                     "4", "--sync-every-per-slice", "8,8"])
+    with pytest.raises(SystemExit):  # VGG windows are gang-wide
+        cli.main(["--strategy", "hierarchical", "--dcn-size", "2",
+                  "--sync-every", "2", "--sync-every-per-slice", "2,4"])
+    with pytest.raises(SystemExit):  # VGG: outer still needs a window
+        cli.main(["--strategy", "hierarchical", "--dcn-size", "2",
+                  "--outer-opt", "momentum"])
+
+
 def test_parser_memory_flags():
     """Round-17 surface: the LM CLI gains --loss-impl / --loss-chunk /
     --remat (defaults None so historical invocations are

@@ -669,3 +669,54 @@ def test_monitors_off_and_on_are_bitwise_free(tmp_path):
     assert off_compiles == on_compiles
     # the live leg really monitored: step spans fed the step_time rule
     assert doctor.states["step_time"].samples == 3
+
+
+def test_sync_relax_hook_per_slice_widen_narrow(tmp_path):
+    """Round 22: a rule mapped through ``slice_rules`` widens ONLY its
+    slice's window (uniform (2,2) -> per-slice (2,4) via the trainer's
+    own rebuild), training continues with the straggler amortized, the
+    clear narrows the slot back — restoring the uniform build (per-
+    slice None, the bitwise round-18 branch) — and both transitions
+    land as slice-tagged request_sync_relax events on the run's own
+    stream."""
+    from distributed_pytorch_tpu.lm import LMTrainConfig, LMTrainer
+    from distributed_pytorch_tpu.models import transformer as tfm
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, (8, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+    tgts[:, -1] = -100
+
+    tel = telemetry.enable(str(tmp_path), rank=0)
+    doctor = monitor.RunDoctor([monitor.SloRule(
+        name="site1_step_time", metric="site1_step_ms", threshold=100.0,
+        op="<=", window=4, agg="mean", record="gauge", min_samples=2)])
+    try:
+        model = tfm.TransformerConfig(vocab_size=256, d_model=64,
+                                      n_layers=2, n_heads=2,
+                                      head_dim=32, d_ff=128)
+        tr = LMTrainer(LMTrainConfig(model=model, compute_dtype=None,
+                                     dp=8, dcn_size=2, sync_every=2,
+                                     max_sync_every=8))
+        monitor.SyncRelaxHook(
+            tr, slice_rules={"site1_step_time": 1}).register(doctor)
+        assert doctor.attach(tel)
+        for _ in range(3):  # breach: slice 1's site is straggling
+            tel.gauge("site1_step_ms", 500.0, phase="train")
+        assert doctor.states["site1_step_time"].breached
+        assert tr.cfg.sync_every_per_slice == (2, 4)  # only slice 1
+        assert tr.cfg.sync_every == 2  # healthy slices keep their base
+        losses = [float(tr.train_step(toks, tgts)) for _ in range(4)]
+        assert np.isfinite(losses).all()  # the widened trainer trains
+        for _ in range(6):  # flush the window back under threshold
+            tel.gauge("site1_step_ms", 1.0, phase="train")
+        assert not doctor.states["site1_step_time"].breached
+        # narrow restores the UNIFORM build the config started with
+        assert tr.cfg.sync_every_per_slice is None
+        assert tr.cfg.sync_every == 2
+    finally:
+        doctor.detach()
+        telemetry.disable()
+    summary = telemetry.run_summary(str(tmp_path))
+    relax = summary["events"]["rank0/slo/request_sync_relax"]
+    assert relax["count"] == 2
